@@ -1,0 +1,33 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet-1.x capabilities.
+
+Usage mirrors the reference (`import mxnet as mx`):
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+
+Compute path: JAX/XLA (MXU matmuls, fused elementwise, Pallas custom calls);
+runtime semantics (async engine, Context, NDArray mutability, autograd tape,
+hybridize-to-compiled-graph) match the reference's programming model.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (Context, cpu, tpu, gpu, cpu_pinned, num_tpus, num_gpus,
+                      current_context)
+from . import engine
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "tpu", "gpu", "cpu_pinned", "num_tpus",
+    "num_gpus", "current_context", "engine", "random", "autograd", "nd",
+    "ndarray", "NDArray", "__version__",
+]

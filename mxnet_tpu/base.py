@@ -1,0 +1,83 @@
+"""Foundation helpers: dtype registry, error types, name managers.
+
+Role parity: `python/mxnet/base.py` in the reference (ctypes lib loading,
+dtype maps, MXNetError). Here the "backend" is JAX/XLA, so this module only
+keeps the pure-Python pieces: dtype canonicalisation, error types, and small
+utilities shared across the package.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: dmlc error -> MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Canonical dtype universe. bf16 is first-class on TPU (MXU native input type);
+# fp64 is supported on CPU meshes for numeric-gradient tests.
+_DTYPE_ALIASES = {
+    "float32": "float32",
+    "float64": "float64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "uint8": "uint8",
+    "int8": "int8",
+    "int32": "int32",
+    "int64": "int64",
+    "bool": "bool",
+}
+
+
+def canonical_dtype(dtype):
+    """Normalise a dtype-ish value to a numpy/ml_dtypes dtype object."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.bfloat16
+        if dtype not in _DTYPE_ALIASES:
+            raise TypeError(f"unsupported dtype {dtype!r}")
+        return _np.dtype(dtype)
+    if dtype is jnp.bfloat16:
+        return jnp.bfloat16
+    try:
+        d = _np.dtype(dtype)
+    except TypeError:
+        # jax weak types / ml_dtypes
+        return dtype
+    return d
+
+
+def dtype_name(dtype) -> str:
+    import jax.numpy as jnp
+
+    if dtype is jnp.bfloat16:
+        return "bfloat16"
+    return _np.dtype(dtype).name if not hasattr(dtype, "name") else str(getattr(dtype, "name"))
+
+
+class _NameManager(threading.local):
+    """Automatic unique-name generation (parity: mxnet.name.NameManager)."""
+
+    def __init__(self):
+        super().__init__()
+        self.counters = {}
+
+    def get(self, hint: str) -> str:
+        idx = self.counters.get(hint, 0)
+        self.counters[hint] = idx + 1
+        return f"{hint}{idx}"
+
+
+name_manager = _NameManager()

@@ -1,0 +1,96 @@
+"""Async execution engine facade.
+
+Parity target: the reference's dependency engine
+(`include/mxnet/engine.h:117-318`, `src/engine/threaded_engine.h`): every op is
+an async task with read/write variable dependencies; callers only block at
+explicit sync points (WaitToRead / WaitForVar / WaitForAll).
+
+TPU-native redesign: XLA/PJRT *is* the async engine. `jax` op dispatch is
+asynchronous (the Python caller gets a future-like Array immediately), data
+dependencies are tracked by the runtime at buffer granularity, and per-device
+execution lanes (compute / h2d / d2h streams) live inside PJRT. What remains
+for this layer is:
+
+  * the sync-point API (`wait_all`, NDArray.wait_to_read),
+  * deferred exception semantics — an op that fails inside the runtime
+    surfaces at the *next sync point*, like `ThreadedVar::var_exception`
+    (`src/engine/threaded_engine.cc:383-437`),
+  * the bulking knobs (`set_bulk_size`) which on TPU map to "how much work is
+    traced into one XLA executable" — kept for API parity, consumed by
+    CachedOp.
+
+A `NaiveEngine`-style fully synchronous mode (`MXNET_ENGINE_TYPE=NaiveEngine`)
+is honoured by blocking after every op — the same race-bisection debug tool
+the reference ships (`src/engine/naive_engine.cc`).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["wait_all", "is_naive", "set_bulk_size", "bulk", "bulk_size"]
+
+_tls = threading.local()
+
+
+def is_naive() -> bool:
+    return os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def wait_all() -> None:
+    """Block until all pending async work on all devices has finished.
+
+    Parity: ``Engine::WaitForAll`` / ``mx.nd.waitall``. Deferred runtime
+    errors (e.g. a failed TPU launch) are raised here, matching the
+    reference's exception-at-sync-point semantics.
+    """
+    import jax
+
+    # effects_barrier drains all dispatched computations on all backends.
+    jax.effects_barrier()
+
+
+def maybe_sync(arrays) -> None:
+    """NaiveEngine hook: block on freshly produced arrays when synchronous
+    debugging mode is requested."""
+    if not is_naive():
+        return
+    import jax
+
+    for a in arrays:
+        if isinstance(a, jax.Array):
+            a.block_until_ready()
+
+
+# -- bulking knobs (parity: MXEngineSetBulkSize / mx.engine.bulk) ------------
+
+def bulk_size() -> int:
+    return getattr(_tls, "bulk_size", int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15)))
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulking segment limit; returns the previous value.
+
+    On TPU, bulking (merging consecutive ops into one engine job,
+    `GraphExecutor::BulkOpSegs`) is subsumed by whole-trace XLA compilation;
+    the knob is kept so reference code runs unchanged and is consulted by the
+    imperative fast path when deciding how aggressively to fuse.
+    """
+    prev = bulk_size()
+    _tls.bulk_size = int(size)
+    return prev
+
+
+class bulk:
+    """Context manager parity for ``mx.engine.bulk(size)``."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self.size)
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._prev)

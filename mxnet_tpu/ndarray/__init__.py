@@ -1,0 +1,68 @@
+"""`mx.nd` — the imperative array API.
+
+Parity: `python/mxnet/ndarray/` (~19k LoC incl. generated op wrappers).
+Every registered op is exposed as a module-level function (the analogue of
+the install-time `gen_op.py` wrappers); arrays are positional, static
+hyper-parameters are keyword-only.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
+                      zeros_like, ones_like, concat, stack, split, waitall,
+                      invoke, dot, moveaxis, _invoke, _invoke_fn)
+from ..ops import registry as _registry
+from . import random  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import save, load  # noqa: F401
+from . import sparse  # noqa: F401
+
+_RANDOM_OPS = frozenset(n for n in _registry.list_ops() if n.startswith("_random")
+                        or n.startswith("_sample") or n == "_shuffle")
+
+
+def _make_wrapper(op_name):
+    def wrapper(*args, out=None, **kwargs):
+        nd_args = []
+        for a in args:
+            if isinstance(a, NDArray):
+                nd_args.append(a)
+            elif a is None:
+                continue
+            else:
+                nd_args.append(array(a))
+        return _invoke(op_name, nd_args, kwargs, out=out)
+
+    wrapper.__name__ = op_name
+    wrapper.__qualname__ = op_name
+    wrapper.__doc__ = (_registry.get(op_name).fn.__doc__ or
+                       f"auto-generated wrapper for op {op_name!r}")
+    return wrapper
+
+
+_mod = _sys.modules[__name__]
+for _name in _registry.list_ops():
+    _op = _registry.get(_name)
+    for _exposed in (_name,) + _op.aliases:
+        if not hasattr(_mod, _exposed):
+            setattr(_mod, _exposed, _make_wrapper(_name))
+
+# Dropout needs RNG threading: override the raw wrapper so imperative calls
+# draw from the global generator (parity: Resource kRandom).
+_raw_dropout = _registry.get("Dropout")
+
+
+def Dropout(data, p=0.5, mode="training", axes=(), **kwargs):  # noqa: N802
+    from .. import autograd as _ag
+    from .. import random as _rand
+
+    training = _ag.is_training() or mode == "always"
+    if not training or p <= 0:
+        return data.copy()
+    key = NDArray(_rand.next_key())
+    return _invoke("Dropout", [data, key],
+                   {"p": p, "axes": tuple(axes), "training": True})
+
+
+setattr(_mod, "Dropout", Dropout)

@@ -1,0 +1,695 @@
+"""NDArray: the imperative tensor.
+
+Parity target: `include/mxnet/ndarray.h:80` + `python/mxnet/ndarray/ndarray.py`
+— a ref-counted device buffer with an engine variable (async completion),
+autograd entry (AGInfo), lazy allocation, and mutable semantics
+(`a[:] = x`, `a += b`).
+
+TPU-native redesign: the buffer is a `jax.Array` — already asynchronous
+(dispatch returns a future; `wait_to_read` == `block_until_ready`), already
+dependency-tracked by PJRT, already device-resident. Mutation is realised by
+*rebinding* the underlying immutable array (the handle object is the mutable
+cell, exactly like the reference's `NDArray -> Chunk` indirection). The
+autograd entry is `(_tape_node, _tape_index)` set by `_invoke` when
+recording — the AGInfo analogue.
+
+Every op call routes through `_invoke`, which (a) looks up the registered op,
+(b) runs the per-(op, kwargs) cached XLA executable — the "eager op cache"
+replacing the reference's engine-push hot path — and (c) records a vjp tape
+node when autograd is active.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as _np
+
+from .. import autograd, engine
+from ..base import MXNetError, canonical_dtype
+from ..context import Context, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "zeros_like", "ones_like", "concat", "stack", "split", "waitall",
+           "invoke", "moveaxis", "dot", "eye"]
+
+
+def _ctx_of(data) -> Context:
+    try:
+        dev = list(data.devices())[0]
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+def _jax_put(value, ctx: Context | None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is not None:
+        dtype = canonical_dtype(dtype)
+    if ctx is None:
+        ctx = current_context()
+    arr = jnp.asarray(value, dtype=dtype)
+    return jax.device_put(arr, ctx.jax_device())
+
+
+class NDArray:
+    """An async, device-resident, mutable-by-rebinding tensor handle."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_tape_node", "_tape_index",
+                 "__weakref__")
+
+    _is_np_shape = False
+
+    def __init__(self, data, ctx=None, dtype=None):
+        import jax
+
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array) or ctx is not None or dtype is not None:
+            data = _jax_put(data, ctx, dtype)
+        self._data = data
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._tape_index = 0
+
+    # -------------------------------------------------- basic properties ---
+    @property
+    def data(self):
+        """The underlying jax.Array (read-only view of current value)."""
+        return self._data
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        dt = self._data.dtype
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if dt == jnp.bfloat16 else _np.dtype(dt.name)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return _ctx_of(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return f"\n{_np.asarray(self.asnumpy())}\n<NDArray {self.shape} @{self.context}>"
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(self.asnumpy().item())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # ------------------------------------------------------ sync points ----
+    def asnumpy(self) -> _np.ndarray:
+        """Copy to host, blocking (the reference's WaitToRead + copy,
+        `ndarray.h:370`). Deferred async errors surface here."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # ------------------------------------------------------ autograd -------
+    def attach_grad(self, grad_req="write", stype=None):
+        """parity: python/mxnet/ndarray/ndarray.py attach_grad."""
+        from . import zeros_like as _zl
+
+        self._grad = _zl(self)
+        self._grad_req = grad_req
+        self._tape_node = None
+
+    def detach(self) -> "NDArray":
+        return NDArray(self._data)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------ conversion -----
+    def astype(self, dtype, copy=True):
+        return _invoke("Cast", [self], {"dtype": dtype})
+
+    def copy(self) -> "NDArray":
+        return _invoke("copy", [self], {})
+
+    def copyto(self, other):
+        """Copy into another array (mutates other) or onto a Context."""
+        if isinstance(other, Context):
+            import jax
+
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        if isinstance(other, NDArray):
+            import jax
+
+            other._rebind(jax.device_put(
+                self._data.astype(other._data.dtype),
+                other.context.jax_device()))
+            return other
+        raise TypeError(f"copyto target must be NDArray or Context, got {type(other)}")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def to_device(self, ctx):
+        return self.as_in_context(ctx)
+
+    def asnumpy_or_self(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+
+        return cast_storage(self, stype)
+
+    # ------------------------------------------------------ mutation -------
+    def _rebind(self, new_data):
+        """Swap the underlying buffer (the mutation primitive).
+
+        Disallowed on tape-recorded values while recording — the same rule
+        the reference enforces ("Inplace operations ... not supported when
+        recording with autograd").
+        """
+        if autograd.is_recording() and self._tape_node is not None:
+            raise MXNetError(
+                "Inplace operations (+=, -=, x[:]=y) are not supported on "
+                "arrays produced while recording with autograd")
+        self._data = new_data
+        self._tape_node = None
+        engine.maybe_sync([new_data])
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, (numbers.Number, _np.ndarray, list, tuple)):
+            value = jnp.asarray(value, dtype=self._data.dtype)
+        if key is None or key == slice(None) or key is Ellipsis:
+            new = jnp.broadcast_to(value.astype(self._data.dtype), self.shape)
+            import jax
+
+            self._rebind(jax.device_put(new, self.context.jax_device()))
+        else:
+            key = _clean_key(key)
+            self._rebind(self._data.at[key].set(value.astype(self._data.dtype)))
+
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data
+        key = _clean_key(key)
+        return _invoke_fn(lambda x, k=key: x[k], "getitem", [self], {})
+
+    # ------------------------------------------------------ arithmetic -----
+    def _binary(self, other, op, rop=None, reverse=False):
+        if isinstance(other, NDArray):
+            return _invoke(op, [other, self] if reverse else [self, other], {})
+        if isinstance(other, numbers.Number):
+            return _invoke_scalar(op, self, other, reverse)
+        if isinstance(other, _np.ndarray):
+            other = NDArray(other, ctx=self.context)
+            return _invoke(op, [other, self] if reverse else [self, other], {})
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "broadcast_div", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod")
+
+    def __rmod__(self, o):
+        return self._binary(o, "broadcast_mod", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power")
+
+    def __rpow__(self, o):
+        return self._binary(o, "broadcast_power", reverse=True)
+
+    def __neg__(self):
+        return _invoke("negative", [self], {})
+
+    def __abs__(self):
+        return _invoke("abs", [self], {})
+
+    def __eq__(self, o):
+        return self._binary(o, "broadcast_equal")
+
+    def __ne__(self, o):
+        return self._binary(o, "broadcast_not_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal")
+
+    # in-place: rebind
+    def _inplace(self, other, op):
+        out = self._binary(other, op)
+        if out is NotImplemented:
+            return out
+        self._rebind(out._data)
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(o, "broadcast_add")
+
+    def __isub__(self, o):
+        return self._inplace(o, "broadcast_sub")
+
+    def __imul__(self, o):
+        return self._inplace(o, "broadcast_mul")
+
+    def __itruediv__(self, o):
+        return self._inplace(o, "broadcast_div")
+
+    # ------------------------------------------------------ methods --------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _invoke("reshape", [self], {"shape": tuple(shape)})
+
+    def reshape_like(self, other):
+        return _invoke("reshape_like", [self, other], {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", [self], {"axes": tuple(axes)})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return _invoke("Flatten", [self], {})
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", [self], {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return _invoke("broadcast_like", [self, other], {})
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return _invoke("one_hot", [self], {"depth": depth, **kw})
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return _invoke("abs", [self], {})
+
+    def sign(self):
+        return _invoke("sign", [self], {})
+
+    def sqrt(self):
+        return _invoke("sqrt", [self], {})
+
+    def square(self):
+        return _invoke("square", [self], {})
+
+    def exp(self):
+        return _invoke("exp", [self], {})
+
+    def log(self):
+        return _invoke("log", [self], {})
+
+    def relu(self):
+        return _invoke("relu", [self], {})
+
+    def sigmoid(self):
+        return _invoke("sigmoid", [self], {})
+
+    def tanh(self):
+        return _invoke("tanh", [self], {})
+
+    def softmax(self, axis=-1):
+        return _invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _invoke("log_softmax", [self], {"axis": axis})
+
+    def sum(self, axis=None, keepdims=False):
+        return _invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke("topk", [self], {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                        "is_ascend": is_ascend})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _invoke("dot", [self, other],
+                       {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def flip(self, axis):
+        return _invoke("flip", [self], {"axis": axis})
+
+    def tile(self, reps):
+        return _invoke("tile", [self], {"reps": tuple(reps)})
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return _invoke("pad", [self], {"mode": mode, "pad_width": tuple(pad_width),
+                                       "constant_value": constant_value})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke("SliceChannel", [self],
+                       {"num_outputs": num_outputs, "axis": axis,
+                        "squeeze_axis": squeeze_axis})
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def zeros_like(self):
+        return _invoke("zeros_like", [self], {})
+
+    def ones_like(self):
+        return _invoke("ones_like", [self], {})
+
+    def as_np_ndarray(self):
+        from .. import numpy as _mx_np
+
+        return _mx_np.ndarray(self._data)
+
+    def as_nd_ndarray(self):
+        return self
+
+
+def _clean_key(key):
+    """Convert NDArray / numpy indices inside a key to jax-friendly forms."""
+    import jax
+
+    if isinstance(key, NDArray):
+        return key._data.astype("int32") if key._data.dtype not in ("bool",) else key._data
+    if isinstance(key, tuple):
+        return tuple(_clean_key(k) for k in key)
+    if isinstance(key, jax.Array):
+        return key
+    return key
+
+
+# scalar-op dispatch: broadcast op name -> (scalar op, reversed scalar op)
+_SCALAR_MAP = {
+    "broadcast_add": ("_plus_scalar", "_plus_scalar"),
+    "broadcast_sub": ("_minus_scalar", "_rminus_scalar"),
+    "broadcast_mul": ("_mul_scalar", "_mul_scalar"),
+    "broadcast_div": ("_div_scalar", "_rdiv_scalar"),
+    "broadcast_mod": ("_mod_scalar", "_rmod_scalar"),
+    "broadcast_power": ("_power_scalar", "_rpower_scalar"),
+    "broadcast_maximum": ("_maximum_scalar", "_maximum_scalar"),
+    "broadcast_minimum": ("_minimum_scalar", "_minimum_scalar"),
+    "broadcast_equal": ("_equal_scalar", "_equal_scalar"),
+    "broadcast_not_equal": ("_not_equal_scalar", "_not_equal_scalar"),
+    "broadcast_greater": ("_greater_scalar", "_lesser_scalar"),
+    "broadcast_greater_equal": ("_greater_equal_scalar", "_lesser_equal_scalar"),
+    "broadcast_lesser": ("_lesser_scalar", "_greater_scalar"),
+    "broadcast_lesser_equal": ("_lesser_equal_scalar", "_greater_equal_scalar"),
+}
+
+
+def _invoke_scalar(op_name, nd, scalar, reverse):
+    fwd, rev = _SCALAR_MAP[op_name]
+    return _invoke(rev if reverse else fwd, [nd], {"scalar": scalar})
+
+
+# -------------------------------------------------------------- invoke -----
+
+def _wrap_outputs(op, raw_out):
+    if isinstance(raw_out, tuple):
+        return tuple(NDArray(r) for r in raw_out)
+    return NDArray(raw_out)
+
+
+def _invoke(op_name, nd_inputs, kwargs, out=None):
+    """The imperative dispatch path (parity: Imperative::Invoke,
+    `src/imperative/imperative.cc:89`)."""
+    op = _reg.get(op_name)
+    raws = [x._data for x in nd_inputs]
+    if autograd.is_recording() and op.differentiable and autograd.any_on_tape(nd_inputs):
+        import jax
+        import functools
+
+        fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
+        raw_out, vjp_fn = jax.vjp(fn, *raws)
+        outs = raw_out if isinstance(raw_out, tuple) else (raw_out,)
+        node = autograd.TapeNode(op_name, vjp_fn, autograd.make_entries(nd_inputs),
+                                 len(outs), [o.shape for o in outs],
+                                 [o.dtype for o in outs])
+        wrapped = tuple(NDArray(o) for o in outs)
+        for i, w in enumerate(wrapped):
+            w._tape_node = node
+            w._tape_index = i
+        result = wrapped if isinstance(raw_out, tuple) else wrapped[0]
+    else:
+        raw_out = op.bound(kwargs)(*raws)
+        result = _wrap_outputs(op, raw_out)
+    engine.maybe_sync([r._data for r in (result if isinstance(result, tuple) else (result,))])
+    if out is not None:
+        first = result[0] if isinstance(result, tuple) else result
+        out._rebind(first._data)
+        return out
+    return result
+
+
+def _invoke_fn(fn, name, nd_inputs, kwargs):
+    """Invoke an ad-hoc pure function as if it were an op (used by fancy
+    indexing and frontend helpers)."""
+    raws = [x._data for x in nd_inputs]
+    if autograd.is_recording() and autograd.any_on_tape(nd_inputs):
+        import jax
+
+        raw_out, vjp_fn = jax.vjp(fn, *raws)
+        outs = raw_out if isinstance(raw_out, tuple) else (raw_out,)
+        node = autograd.TapeNode(name, vjp_fn, autograd.make_entries(nd_inputs),
+                                 len(outs), [o.shape for o in outs],
+                                 [o.dtype for o in outs])
+        wrapped = tuple(NDArray(o) for o in outs)
+        for i, w in enumerate(wrapped):
+            w._tape_node = node
+            w._tape_index = i
+        return wrapped if isinstance(raw_out, tuple) else wrapped[0]
+    raw_out = fn(*raws)
+    if isinstance(raw_out, tuple):
+        return tuple(NDArray(r) for r in raw_out)
+    return NDArray(raw_out)
+
+
+def invoke(op_name, *nd_inputs, out=None, **kwargs):
+    """Public generic op invocation: mx.nd.invoke('dot', a, b)."""
+    return _invoke(op_name, list(nd_inputs), kwargs, out=out)
+
+
+# ------------------------------------------------------------ creation -----
+
+def array(source_array, ctx=None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    return NDArray(_np.asarray(source_array), ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.zeros(shape, canonical_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.ones(shape, canonical_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.full(shape, val, canonical_dtype(dtype)), ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    import jax.numpy as jnp
+
+    out = jnp.arange(start, stop, step, canonical_dtype(dtype or "float32"))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    import jax.numpy as jnp
+
+    return NDArray(jnp.eye(N, M or N, k=k, dtype=canonical_dtype(dtype)), ctx=ctx)
+
+
+def zeros_like(a: NDArray) -> NDArray:
+    return _invoke("zeros_like", [a], {})
+
+
+def ones_like(a: NDArray) -> NDArray:
+    return _invoke("ones_like", [a], {})
+
+
+def concat(*args, dim=1, **kwargs) -> NDArray:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _invoke("Concat", list(args), {"dim": dim})
+
+
+def stack(*args, axis=0, **kwargs) -> NDArray:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _invoke("stack", list(args), {"axis": axis})
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    return _invoke("SliceChannel", [data],
+                   {"num_outputs": num_outputs, "axis": axis,
+                    "squeeze_axis": squeeze_axis})
+
+
+def moveaxis(a, source, destination):
+    return _invoke_fn(
+        lambda x: __import__("jax.numpy", fromlist=["moveaxis"]).moveaxis(
+            x, source, destination), "moveaxis", [a], {})
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    return _invoke("dot", [lhs, rhs],
+                   {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+
+def waitall():
+    engine.wait_all()

@@ -1,0 +1,94 @@
+"""`mx.nd.random` — stateful sampling frontend.
+
+Parity: `python/mxnet/ndarray/random.py` over `src/operator/random/`.
+Draws keys from the global generator (`mxnet_tpu.random`), so repeated calls
+advance the stream and `mx.random.seed` reproduces sequences.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray, _invoke
+from .. import random as _rand
+from ..context import current_context
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "randint", "multinomial", "shuffle", "bernoulli"]
+
+
+def _key_nd():
+    return NDArray(_rand.next_key())
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_uniform", [_key_nd()],
+                   {"low": low, "high": high, "shape": tuple(shape), "dtype": dtype},
+                   out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_normal", [_key_nd()],
+                   {"loc": loc, "scale": scale, "shape": tuple(shape), "dtype": dtype},
+                   out=out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_gamma", [_key_nd()],
+                   {"alpha": alpha, "beta": beta, "shape": tuple(shape),
+                    "dtype": dtype}, out=out)
+
+
+def exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_exponential", [_key_nd()],
+                   {"lam": lam, "shape": tuple(shape), "dtype": dtype}, out=out)
+
+
+def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_poisson", [_key_nd()],
+                   {"lam": lam, "shape": tuple(shape), "dtype": dtype}, out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_negative_binomial", [_key_nd()],
+                   {"k": k, "p": p, "shape": tuple(shape), "dtype": dtype}, out=out)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_randint", [_key_nd()],
+                   {"low": low, "high": high, "shape": tuple(shape), "dtype": dtype},
+                   out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_sample_multinomial", [_key_nd(), data],
+                   {"shape": tuple(shape), "get_prob": get_prob, "dtype": dtype},
+                   out=out)
+
+
+def shuffle(data, out=None):
+    return _invoke("_shuffle", [_key_nd(), data], {}, out=out)
+
+
+def bernoulli(p=0.5, shape=(1,), dtype="float32", ctx=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _invoke("_random_bernoulli", [_key_nd()],
+                   {"p": p, "shape": tuple(shape), "dtype": dtype}, out=out)

@@ -1,0 +1,157 @@
+"""Sparse storage types: row_sparse and csr.
+
+Parity: `include/mxnet/ndarray.h:59-63` storage types +
+`python/mxnet/ndarray/sparse.py`. The reference uses sparse arrays for
+(a) large embedding gradients (`row_sparse`, kvstore.row_sparse_pull) and
+(b) sparse input features (`csr`, LibSVM iterator / linear classification).
+
+TPU-native: XLA has no native sparse storage; sparse here is a *host-side
+structural* representation (indices + dense values) whose ops lower to XLA
+gather/scatter — exactly what a row_sparse gradient needs (take/scatter_add
+on the MXU-adjacent VPU). Dense fallback mirrors the reference's
+`kFComputeFallback` + storage-fallback logging.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, _invoke_fn, array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage"]
+
+
+class RowSparseNDArray(NDArray):
+    """values `data` for the rows listed in `indices`; other rows are zero."""
+
+    __slots__ = ("_rs_data", "_rs_indices", "_dense_shape")
+
+    def __init__(self, data, indices, shape):
+        self._rs_data = data if isinstance(data, NDArray) else array(data)
+        idx = indices if isinstance(indices, NDArray) else array(indices, dtype="int64")
+        self._rs_indices = idx
+        self._dense_shape = tuple(shape)
+        super().__init__(self._densify()._data)
+
+    def _densify(self) -> NDArray:
+        import jax.numpy as jnp
+
+        def fn(vals, idx):
+            out = jnp.zeros(self._dense_shape, vals.dtype)
+            return out.at[idx.astype(jnp.int32)].set(vals)
+
+        return _invoke_fn(fn, "rowsparse_to_dense",
+                          [self._rs_data, self._rs_indices], {})
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return self._rs_data
+
+    @property
+    def indices(self):
+        return self._rs_indices
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "row_sparse":
+            return self
+        raise ValueError(f"cannot cast row_sparse to {stype}")
+
+    def retain(self, indices):
+        """Keep only the given rows (parity: sparse.retain)."""
+        keep = set(_np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                               else indices).astype(int).tolist())
+        cur = _np.asarray(self._rs_indices.asnumpy()).astype(int)
+        mask = _np.array([i in keep for i in cur])
+        new_idx = cur[mask]
+        new_data = _np.asarray(self._rs_data.asnumpy())[mask]
+        return RowSparseNDArray(new_data, new_idx, self._dense_shape)
+
+
+class CSRNDArray(NDArray):
+    """Compressed sparse row matrix (data, indices, indptr)."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr", "_dense_shape")
+
+    def __init__(self, data, indices, indptr, shape):
+        self._csr_data = data if isinstance(data, NDArray) else array(data)
+        self._csr_indices = indices if isinstance(indices, NDArray) \
+            else array(indices, dtype="int64")
+        self._csr_indptr = indptr if isinstance(indptr, NDArray) \
+            else array(indptr, dtype="int64")
+        self._dense_shape = tuple(shape)
+        super().__init__(self._densify_np())
+
+    def _densify_np(self):
+        vals = _np.asarray(self._csr_data.asnumpy())
+        idx = _np.asarray(self._csr_indices.asnumpy()).astype(int)
+        ptr = _np.asarray(self._csr_indptr.asnumpy()).astype(int)
+        out = _np.zeros(self._dense_shape, vals.dtype)
+        for r in range(self._dense_shape[0]):
+            cols = idx[ptr[r]:ptr[r + 1]]
+            out[r, cols] = vals[ptr[r]:ptr[r + 1]]
+        return out
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return self._csr_data
+
+    @property
+    def indices(self):
+        return self._csr_indices
+
+    @property
+    def indptr(self):
+        return self._csr_indptr
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "csr":
+            return self
+        raise ValueError(f"cannot cast csr to {stype}")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    nz_rows = _np.where(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows, dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indices, indptr, shape)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    indptr, indices, vals = [0], [], []
+    for r in range(dense.shape[0]):
+        cols = _np.where(dense[r] != 0)[0]
+        indices.extend(cols.tolist())
+        vals.extend(dense[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_np.asarray(vals, dense.dtype), indices, indptr, dense.shape)
+
+
+def cast_storage(arr: NDArray, stype: str):
+    """parity: src/operator/tensor/cast_storage-inl.h."""
+    if stype == "default":
+        return NDArray(arr._data)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        if arr.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        return csr_matrix(arr)
+    raise ValueError(f"unknown stype {stype!r}")

@@ -1,0 +1,75 @@
+"""NDArray serialization: `mx.nd.save` / `mx.nd.load`.
+
+Parity: `NDArray::Save/Load` (`src/ndarray/ndarray.cc:1746-2029`) and
+`python/mxnet/ndarray/utils.py:149-277` — list or dict of arrays to a single
+file; this is the `.params` checkpoint format consumed by Gluon
+`save_parameters` and Module `save_checkpoint`.
+
+Container format here is NPZ (zip of npy) with a name-mangling scheme that
+distinguishes list vs dict payloads; bfloat16 is stored as uint16 raw bits
+with a dtype tag (npy cannot hold bf16 natively).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+_LIST_PREFIX = "__list__:"
+_BF16_SUFFIX = ":bf16"
+
+
+def _to_numpy_for_save(arr: NDArray):
+    import jax.numpy as jnp
+
+    data = arr._data
+    if data.dtype == jnp.bfloat16:
+        return _np.asarray(data.view(jnp.uint16) if hasattr(data, "view")
+                           else data).astype(_np.uint16), True
+    if str(data.dtype) == "bfloat16":
+        return _np.asarray(data.astype(jnp.float32)).astype(_np.float32), True
+    return _np.asarray(data), False
+
+
+def save(fname: str, data) -> None:
+    """Save a list or str->NDArray dict (parity: mx.nd.save)."""
+    payload = {}
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        for i, arr in enumerate(data):
+            np_arr, is_bf16 = _to_numpy_for_save(arr)
+            payload[f"{_LIST_PREFIX}{i}{_BF16_SUFFIX if is_bf16 else ''}"] = np_arr
+    elif isinstance(data, dict):
+        for k, arr in data.items():
+            np_arr, is_bf16 = _to_numpy_for_save(arr)
+            payload[f"{k}{_BF16_SUFFIX if is_bf16 else ''}"] = np_arr
+    else:
+        raise TypeError(f"save expects list or dict of NDArray, got {type(data)}")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def _restore(np_arr, is_bf16):
+    import jax.numpy as jnp
+
+    if is_bf16:
+        if np_arr.dtype == _np.uint16:
+            return NDArray(jnp.asarray(np_arr).view(jnp.bfloat16))
+        return NDArray(jnp.asarray(np_arr, dtype=jnp.bfloat16))
+    return array(np_arr)
+
+
+def load(fname: str):
+    """Load arrays saved by `save` (returns list or dict, matching input)."""
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = list(z.files)
+        items = {}
+        for k in keys:
+            is_bf16 = k.endswith(_BF16_SUFFIX)
+            name = k[:-len(_BF16_SUFFIX)] if is_bf16 else k
+            items[name] = _restore(z[k], is_bf16)
+    if all(k.startswith(_LIST_PREFIX) for k in items):
+        ordered = sorted(items.items(), key=lambda kv: int(kv[0][len(_LIST_PREFIX):]))
+        return [v for _, v in ordered]
+    return items

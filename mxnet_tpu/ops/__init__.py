@@ -1,0 +1,15 @@
+"""Operator layer: registry + the op corpus.
+
+Parity: `src/operator/` in the reference (~550 NNVM_REGISTER_OP entries).
+Importing this package registers the full op set; consumers look ops up by
+name via `ops.get(name)` (nnvm `Op::Get` analogue).
+"""
+from .registry import Operator, register, get, list_ops, apply_op, infer_output
+
+from . import math  # noqa: F401  (registers elementwise/scalar/broadcast ops)
+from . import tensor  # noqa: F401  (reduce/linalg/indexing/shape ops)
+from . import nn  # noqa: F401  (FC/conv/pool/norm/softmax/rnn ops)
+from . import optimizer_op  # noqa: F401  (fused optimizer updates)
+from . import random_ops  # noqa: F401  (samplers)
+
+__all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]

@@ -1,0 +1,76 @@
+"""Random sampling ops.
+
+Parity target: `src/operator/random/` (uniform/normal/gamma/poisson/
+multinomial/negbinomial samplers over the per-device RandGenerator).
+
+Every op takes an explicit PRNG `key` as its first array argument; the
+imperative frontend supplies `mxnet_tpu.random.next_key()` and hybridized
+graphs thread keys as traced inputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import canonical_dtype
+
+
+@register("_random_uniform", differentiable=False, aliases=("uniform",))
+def _uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(key, tuple(shape), canonical_dtype(dtype), low, high)
+
+
+@register("_random_normal", differentiable=False, aliases=("normal",))
+def _normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(key, tuple(shape), canonical_dtype(dtype))
+
+
+@register("_random_gamma", differentiable=False)
+def _gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(key, alpha, tuple(shape), canonical_dtype(dtype))
+
+
+@register("_random_exponential", differentiable=False)
+def _exponential(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(key, tuple(shape), canonical_dtype(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False)
+def _poisson(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(key, lam, tuple(shape)).astype(canonical_dtype(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False)
+def _neg_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(canonical_dtype(dtype))
+
+
+@register("_random_randint", differentiable=False)
+def _randint(key, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, tuple(shape), low, high, canonical_dtype(dtype))
+
+
+@register("_sample_multinomial", differentiable=False)
+def _multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
+    logits = jnp.log(jnp.clip(data, 1e-30, None))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        out = out.reshape(tuple(shape) if shape else ())
+    else:
+        out = jax.random.categorical(key, logits[:, None, :].repeat(n, 1), axis=-1)
+        out = out.reshape((data.shape[0],) + (tuple(shape) if shape else ()))
+    return out.astype(canonical_dtype(dtype))
+
+
+@register("_shuffle", differentiable=False)
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_random_bernoulli", differentiable=False)
+def _bernoulli(key, p=0.5, shape=(), dtype="float32"):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(canonical_dtype(dtype))

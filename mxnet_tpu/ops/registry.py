@@ -1,0 +1,133 @@
+"""Operator registry — the op metadata layer.
+
+Parity target: nnvm op registration in the reference
+(``NNVM_REGISTER_OP`` + attributes ``FInferShape`` / ``FInferType`` /
+``FCompute`` / ``FGradient``, `include/mxnet/op_attr_types.h:294,304`).
+~550 ops are registered there, each hand-writing shape/type inference, CPU
+and GPU kernels, and a gradient composition.
+
+TPU-native redesign: every op is a *pure JAX function* ``fn(*arrays,
+**static_kwargs) -> array(s)``.  That single definition supplies all the
+nnvm attributes at once:
+
+  * FCompute        -> the function itself, lowered by XLA to the device
+  * FInferShape/Type-> ``jax.eval_shape`` on the function (no hand-written
+                       inference pass; shapes are inferred by tracing)
+  * FGradient       -> ``jax.vjp`` of the function (no hand-written grads)
+  * kernel dispatch -> a per-(op, static-kwargs) ``jax.jit`` executable
+                       cache: the "eager op cache" that makes imperative
+                       mode non-blocking + fast, replacing the reference's
+                       engine-push-per-op hot path
+                       (`src/imperative/imperative_utils.h:396`).
+
+Ops remain first-class registry entries (not bare Python functions) because
+the graph layer (Symbol), the imperative tape, the AMP pass and opperf all
+enumerate / look up ops by name, exactly as nnvm consumers do.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+__all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]
+
+_REGISTRY: Dict[str, "Operator"] = {}
+
+
+def _freeze(value):
+    """Make kwargs hashable for the executable cache key."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    if isinstance(value, set):
+        return tuple(sorted(_freeze(v) for v in value))
+    return value
+
+
+class Operator:
+    """A registered op: pure JAX fn + metadata.
+
+    Attributes
+    ----------
+    fn : the pure function. All array arguments positional; every keyword
+         argument is *static* (baked into the compiled executable) — the
+         analogue of dmlc::Parameter op hyper-parameters.
+    num_outputs : number of outputs (or None = single array).
+    differentiable : set False for ops with no gradient (e.g. argmax);
+         the tape records them as constants.
+    """
+
+    def __init__(self, name: str, fn: Callable, num_outputs: Optional[int] = None,
+                 differentiable: bool = True, aliases=()):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.aliases = tuple(aliases)
+        self._jit_cache: Dict = {}
+
+    def bound(self, kwargs: dict) -> Callable:
+        """A jitted executable for these static kwargs (cached)."""
+        import jax
+
+        key = _freeze(kwargs)
+        try:
+            return self._jit_cache[key]
+        except KeyError:
+            pass
+        except TypeError:
+            # unhashable kwarg (e.g. a traced array leaked in) — run eagerly
+            return functools.partial(self.fn, **kwargs)
+        fn = self.fn
+        if kwargs:
+            jitted = jax.jit(functools.partial(fn, **kwargs))
+        else:
+            jitted = jax.jit(fn)
+        self._jit_cache[key] = jitted
+        return jitted
+
+    def __call__(self, *arrays, **kwargs):
+        return self.bound(kwargs)(*arrays)
+
+    def __repr__(self):
+        return f"Operator({self.name})"
+
+
+def register(name: str, num_outputs: Optional[int] = None, differentiable: bool = True,
+             aliases=()):
+    """Decorator: register a pure JAX function as a named op."""
+
+    def deco(fn: Callable) -> Operator:
+        op = Operator(name, fn, num_outputs=num_outputs,
+                      differentiable=differentiable, aliases=aliases)
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return op
+
+    return deco
+
+
+def get(name: str) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"operator {name!r} is not registered "
+                       f"({len(set(_REGISTRY.values()))} ops available)") from None
+
+
+def list_ops():
+    return sorted({op.name for op in _REGISTRY.values()})
+
+
+def apply_op(name: str, *arrays, **kwargs):
+    return get(name)(*arrays, **kwargs)
+
+
+def infer_output(op: Operator, arrays, kwargs):
+    """Shape/dtype inference without execution (parity: FInferShape/FInferType,
+    `src/executor/infer_graph_attr_pass.cc:829`): trace with abstract values."""
+    import jax
+
+    return jax.eval_shape(functools.partial(op.fn, **kwargs), *arrays)

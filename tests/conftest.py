@@ -1,0 +1,13 @@
+"""Test harness configuration.
+
+Forces an 8-device virtual CPU mesh BEFORE jax initialises, so multi-device
+sharding/collective tests run on any host (parity trick: the reference tests
+multi-device logic with multiple cpu Contexts, SURVEY §4; TPU translation is
+XLA's --xla_force_host_platform_device_count).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
