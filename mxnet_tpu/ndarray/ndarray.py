@@ -254,7 +254,7 @@ class NDArray:
     def __getitem__(self, key):
         if isinstance(key, NDArray):
             key = key._data
-        key = _clean_key(key)
+        key = _clean_key(key, device=self._data.devices())
         return _invoke_fn(lambda x, k=key: x[k], "getitem", [self], {})
 
     # ------------------------------------------------------ arithmetic -----
@@ -499,15 +499,26 @@ class NDArray:
         return self
 
 
-def _clean_key(key):
-    """Convert NDArray / numpy indices inside a key to jax-friendly forms."""
+def _clean_key(key, device=None):
+    """Convert NDArray / numpy indices inside a key to jax-friendly forms.
+
+    MXNet array indices may be float (its default index dtype is float32);
+    jax requires integer/bool indexers, so non-bool array keys are cast.
+    Array keys are also moved to the indexed array's device — the analogue
+    of the reference's implicit index copy in gather kernels."""
     import jax
+    import jax.numpy as jnp
 
     if isinstance(key, NDArray):
-        return key._data.astype("int32") if key._data.dtype not in ("bool",) else key._data
+        key = key._data
     if isinstance(key, tuple):
-        return tuple(_clean_key(k) for k in key)
-    if isinstance(key, jax.Array):
+        return tuple(_clean_key(k, device=device) for k in key)
+    if isinstance(key, (jax.Array, _np.ndarray)):
+        if not (key.dtype == bool or jnp.issubdtype(key.dtype, jnp.integer)):
+            key = key.astype("int32")
+        if device is not None and isinstance(key, jax.Array) \
+                and key.devices() != device:
+            key = jax.device_put(key, next(iter(device)))
         return key
     return key
 
@@ -606,8 +617,16 @@ def invoke(op_name, *nd_inputs, out=None, **kwargs):
 # ------------------------------------------------------------ creation -----
 
 def array(source_array, ctx=None, dtype=None) -> NDArray:
+    """parity: python/mxnet/ndarray/utils.py array() — output dtype is
+    source.dtype when the source is an NDArray or numpy array, float32
+    otherwise (python lists/scalars never default to int64/float64)."""
     if isinstance(source_array, NDArray):
         source_array = source_array.asnumpy()
+    elif not isinstance(source_array, _np.ndarray) and dtype is None:
+        import jax
+
+        if not (isinstance(source_array, jax.Array)):
+            dtype = "float32"
     return NDArray(_np.asarray(source_array), ctx=ctx, dtype=dtype)
 
 

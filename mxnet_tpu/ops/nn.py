@@ -187,7 +187,7 @@ def _adaptive_avg_pool2d(data, output_size=(1, 1)):
 # ----------------------------------------------------------------- Norms ---
 
 @register("BatchNorm", num_outputs=3)
-def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                 fix_gamma=True, use_global_stats=False, output_mean_var=False,
                 axis=1, cudnn_off=False, training=True):
     """parity: src/operator/nn/batch_norm.cc.

@@ -1,13 +1,23 @@
 """Test harness configuration.
 
-Forces an 8-device virtual CPU mesh BEFORE jax initialises, so multi-device
-sharding/collective tests run on any host (parity trick: the reference tests
-multi-device logic with multiple cpu Contexts, SURVEY §4; TPU translation is
-XLA's --xla_force_host_platform_device_count).
+Forces an 8-device virtual CPU mesh so multi-device sharding/collective
+tests run on any host (parity trick: the reference tests multi-device logic
+with multiple cpu Contexts, SURVEY §4; the TPU translation is XLA's
+--xla_force_host_platform_device_count / jax_num_cpu_devices).
+
+jax may already be imported by the environment's sitecustomize with a TPU
+platform selected, so env vars are too late — use jax.config.update, which
+takes effect as long as no backend has been initialised yet.
+
+x64 is NOT enabled globally — production runs with it off, and the suite
+must see production dtype semantics. float64 numeric-gradient checks scope
+it locally via jax.experimental.enable_x64() (see test_utils).
+Set MXNET_TEST_DEVICE=tpu:0 to run the suite against the real chip instead.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+
+if os.environ.get("MXNET_TEST_DEVICE", "cpu").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,197 @@
+"""Autograd tape tests.
+
+Parity model: tests/python/unittest/test_autograd.py — record/pause
+semantics, backward through op chains, grad accumulation reqs, detach,
+autograd.grad, custom Function, exception-at-sync semantics.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_record_flags():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+        with ag.predict_mode():
+            assert ag.is_recording()
+            assert not ag.is_training()
+    assert not ag.is_recording()
+
+
+def test_simple_backward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * np.array([1.0, 2.0, 3.0]))
+
+
+def test_chain_rule():
+    x = mx.nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = (y * y + y).sum()
+    z.backward()
+    xn = x.asnumpy()
+    assert_almost_equal(x.grad, 8 * xn + 2)
+
+
+def test_multiple_uses():
+    # x used on two tape paths: grads must sum
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + x * 3
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2 * 2.0 + 3]))
+
+
+def test_grad_accumulation_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 3 * 2 * np.array([1.0, 2.0]))
+
+
+def test_grad_req_write_overwrites():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()  # write
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 2 * np.array([1.0, 2.0]))
+
+
+def test_detach():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # dz/dx = y.detach() = 9 (no flow through y)
+    assert_almost_equal(x.grad, np.array([9.0]))
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([20.0, 200.0]))
+
+
+def test_backward_non_scalar():
+    x = mx.nd.ones((2, 3))
+    x.attach_grad()
+    with ag.record():
+        y = x * 5
+    y.backward()  # default head grad = ones
+    assert_almost_equal(x.grad, 5 * np.ones((2, 3)))
+
+
+def test_autograd_grad():
+    x = mx.nd.array([2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 3).sum()
+    (g,) = ag.grad([y], [x])
+    assert_almost_equal(g, 3 * np.array([2.0, 3.0]) ** 2)
+
+
+def test_mark_variables():
+    x = mx.nd.array([4.0])
+    g = mx.nd.zeros((1,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert_almost_equal(g, np.array([8.0]))
+
+
+def test_no_record_no_grad():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    y = x * x  # not recording
+    with pytest.raises(ValueError):
+        y.backward()
+
+
+def test_inplace_on_recorded_raises():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            y += 1
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1 / (1 + (-x).exp())
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.5, -0.5])
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(y, sig)
+    assert_almost_equal(x.grad, sig * (1 - sig))
+
+
+def test_multi_output_op_grad():
+    x = mx.nd.array(np.random.rand(2, 6).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        parts = x.split(3, axis=1)
+        y = parts[0].sum() + (parts[2] * 2).sum()
+    y.backward()
+    expect = np.zeros((2, 6), np.float32)
+    expect[:, 0:2] = 1
+    expect[:, 4:6] = 2
+    assert_almost_equal(x.grad, expect)
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 2).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        y = mx.nd.dot(a, b).sum()
+    y.backward()
+    ones = np.ones((3, 2), np.float32)
+    assert_almost_equal(a.grad, ones @ b_np.T)
+    assert_almost_equal(b.grad, a_np.T @ ones)
+
+
+def test_training_flag_dropout_semantics():
+    # is_training drives Dropout behavior at the layer level; here check flag
+    with ag.record(train_mode=False):
+        assert ag.is_recording() and not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
