@@ -25,9 +25,34 @@ from . import autograd
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import initializer
+from . import initializer as init
+from . import gluon
 
 __all__ = [
     "MXNetError", "Context", "cpu", "tpu", "gpu", "cpu_pinned", "num_tpus",
     "num_gpus", "current_context", "engine", "random", "autograd", "nd",
-    "ndarray", "NDArray", "__version__",
+    "ndarray", "NDArray", "initializer", "init", "gluon", "__version__",
 ]
+
+
+def __getattr__(name):
+    # lazily exposed heavyweight subsystems
+    if name in ("optimizer", "lr_scheduler", "metric", "io", "image",
+                "symbol", "sym", "module", "mod", "kvstore", "kv",
+                "profiler", "recordio", "callback", "monitor", "model",
+                "test_utils", "amp", "parallel", "np", "npx", "visualization",
+                "contrib", "util", "runtime"):
+        import importlib
+
+        try:
+            mod = importlib.import_module(
+                "." + {"sym": "symbol", "mod": "module", "kv": "kvstore",
+                       "np": "numpy", "npx": "numpy_extension"}.get(name, name),
+                __name__)
+        except ImportError as e:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r} ({e})") from None
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -58,7 +58,7 @@ def maybe_sync(arrays) -> None:
     import jax
 
     for a in arrays:
-        if isinstance(a, jax.Array):
+        if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer):
             a.block_until_ready()
 
 

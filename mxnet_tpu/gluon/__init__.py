@@ -1,0 +1,25 @@
+"""Gluon: the imperative/hybrid high-level API (parity: python/mxnet/gluon)."""
+from . import loss, nn, utils
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+
+__all__ = ["nn", "loss", "utils", "Block", "HybridBlock", "SymbolBlock",
+           "Parameter", "ParameterDict", "Constant", "Trainer", "rnn", "data",
+           "model_zoo"]
+
+
+def __getattr__(name):
+    # lazy submodules (Trainer needs optimizer; data/model_zoo are heavier)
+    if name == "Trainer":
+        from .trainer import Trainer
+
+        return Trainer
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        import importlib
+
+        try:
+            return importlib.import_module(f".{name}", __name__)
+        except ImportError as e:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r} ({e})") from None
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

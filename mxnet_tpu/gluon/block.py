@@ -1,0 +1,353 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Parity target: `python/mxnet/gluon/block.py` — `Block` (:230, dynamic
+imperative container), `HybridBlock` (:970, traces to CachedOp via
+`_build_cache` :1067 / `hybridize` :1331), name scoping (`_BlockScope`),
+child registration by attribute assignment, save/load_parameters.
+
+TPU-native: `hybridize()` attaches a `mxnet_tpu.cached_op.CachedOp` that
+jits the block's imperative forward into one XLA executable per input
+signature (SURVEY §7.5 — "this is where TPU wins big"). Unhybridized blocks
+run op-by-op through the eager executable cache.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+from .. import autograd
+from ..base import MXNetError
+from ..cached_op import CachedOp, current_trace
+from ..context import current_context
+from ..ndarray import NDArray
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name-scope manager (parity: gluon/block.py:35-120)."""
+
+    _tls = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix and ParameterDict for a new Block."""
+        current = getattr(_BlockScope._tls, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..base import name_manager
+
+                prefix = name_manager.get(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, shared=None)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._tls, "value", None)
+        _BlockScope._tls.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._tls.value = self._old_scope
+
+
+class Block:
+    """Base container for layers & models (parity: gluon/block.py:230)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # --------------------------------------------------------- registry ----
+    def __setattr__(self, name, value):
+        """Registers Parameters and child Blocks (parity: block.py:279)."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(
+                    value, type(existing)) and not isinstance(existing, type(value)):
+                raise TypeError(f"Changing attribute type for {name} from "
+                                f"{type(existing)} to {type(value)} is not allowed")
+        if isinstance(value, Block):
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        return block
+
+    # -------------------------------------------------------- properties ---
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All Parameters of self + descendants, optionally regex-filtered
+        (parity: block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    # ------------------------------------------------------------- init ----
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    # ---------------------------------------------------------- save/load --
+    def save_parameters(self, filename, deduplicate=False):
+        """parity: gluon/block.py:418 — params keyed by attribute-path names
+        so load is prefix-independent."""
+        from ..ndarray import utils as nd_utils
+
+        arg_dict = {name: p.data() for name, p in
+                    self._collect_params_with_structure().items()}
+        nd_utils.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        from ..ndarray import utils as nd_utils
+
+        loaded = nd_utils.load(filename)
+        params = self._collect_params_with_structure()
+        if not allow_missing:
+            for name in params:
+                assert name in loaded, \
+                    f"Parameter {name!r} missing in {filename!r}"
+        for name, value in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise ValueError(f"Parameter {name!r} in file is not in Block")
+                continue
+            params[name].set_data(value)
+
+    def _collect_params_with_structure(self, prefix=""):
+        """Structural (attribute-path) parameter names."""
+        ret = OrderedDict()
+        for name, p in self._reg_params.items():
+            ret[prefix + name] = p
+        for cname, child in self._children.items():
+            ret.update(child._collect_params_with_structure(
+                prefix + cname + "."))
+        return ret
+
+    # ------------------------------------------------------------ forward --
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary after one forward (parity:
+        block.py summary)."""
+        lines = [f"{'Layer':<40}{'Output':<25}{'Params':<12}"]
+        total = [0]
+
+        def walk(block, depth=0):
+            own = sum(int(p.data().size) for p in block._reg_params.values()
+                      if p._data is not None)
+            total[0] += own
+            lines.append(f"{'  ' * depth + type(block).__name__:<40}"
+                         f"{'-':<25}{own:<12}")
+            for c in block._children.values():
+                walk(c, depth + 1)
+
+        walk(self)
+        lines.append(f"Total params: {total[0]}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for name, child in self._children.items():
+            s += f"  ({name}): {child!r}\n".replace("\n", "\n  ")[2:] + "\n"
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """A Block whose forward can be traced into one compiled executable
+    (parity: gluon/block.py:970)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = {}
+        self._cached_op = None
+
+    def hybridize(self, active=True, **kwargs):
+        """parity: block.py:1331 — recursively enable compiled execution.
+        static_alloc/static_shape flags are accepted and ignored (XLA always
+        memory-plans statically)."""
+        self._active = active
+        self._flags = kwargs
+        self._cached_op = None
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child.hybridize(active, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child._clear_cached_op()
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from inputs. Layers with
+        input-dependent parameter shapes override this (parity: the
+        _deferred_infer_shape symbolic pass, block.py:1143)."""
+        raise ValueError(
+            f"{type(self).__name__} has parameters with unknown shape. "
+            "Override infer_shape or provide in_units/in_channels.")
+
+    def _materialize_params(self, *args):
+        """Fetch own param values, finishing deferred init if needed."""
+        try:
+            return {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            return {name: p.data() for name, p in self._reg_params.items()}
+
+    def __call__(self, *args):
+        if self._active and current_trace() is None:
+            if self._cached_op is not None:  # hot path: no tree walk
+                return self._cached_op(*args)
+            tree_params = self.collect_params()
+            pending = [p for p in tree_params.values() if p._data is None]
+            if pending:
+                # first call resolves deferred shapes eagerly; compile from
+                # the next call (parity: dynamic-mode CachedOp re-planning)
+                return self.forward(*args)
+            self._build_cache(tree_params)
+            return self._cached_op(*args)
+        return self.forward(*args)
+
+    def _build_cache(self, tree_params=None):
+        """parity: block.py:1067 _build_cache → ndarray.CachedOp."""
+        tree_params = tree_params or self.collect_params()
+        handles = [p.data() for p in tree_params.values()]
+        self._cached_op = CachedOp(self.forward, handles,
+                                   flags=self._flags.items())
+
+    def forward(self, x, *args):
+        """Default forward: dispatch to hybrid_forward with this block's own
+        params (parity: block.py:1471 ndarray branch)."""
+        from .. import ndarray as F
+
+        params = self._materialize_params(x, *args)
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """parity: block.py:1416 — serialize for deployment. Emits
+        `path-symbol.json` (structural graph) + `path-%04d.params`."""
+        raise NotImplementedError(
+            "export() requires the symbol layer; use save_parameters for "
+            "weight checkpoints")
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize()
+        return self(x, *args)
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a Gluon block (parity: gluon/block.py:1533).
+    Implemented with the symbol layer (mxnet_tpu.symbol)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file:
+            block.collect_params().load(param_file, ctx=ctx)
+        return block
+
+    def forward(self, *args):
+        from .. import symbol as sym_mod
+
+        names = [getattr(i, "name", str(i)) for i in self._inputs]
+        feed = dict(zip(names, args))
+        param_feed = {name: p.data() for name, p in
+                      self.collect_params().items()}
+        return self._outputs.eval_with(feed, param_feed)

@@ -1,0 +1,360 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity target: `python/mxnet/gluon/parameter.py` (1072 LoC) — Parameter with
+deferred shape init (unknown dims = 0), per-context data copies, grad_req,
+and ParameterDict with prefix scoping, shared params, save/load.
+
+TPU-native redesign: a Parameter holds ONE logical NDArray. Multi-device
+replication/sharding is not done by materialising per-device copies (the
+reference's `_init_impl` list) but by the sharding layer (`mxnet_tpu.kvstore`
+/ `mxnet_tpu.parallel`) laying the same buffer out over a Mesh — so the
+Parameter API keeps `list_ctx`/`reset_ctx` semantics while the data path
+stays a single jax.Array (possibly device-sharded).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as _np
+
+from .. import autograd, initializer as init_mod
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, zeros as nd_zeros
+from ..ndarray import ndarray as _ndmod
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its deferred shape was known (parity:
+    gluon/parameter.py DeferredInitializationError)."""
+
+
+class Parameter:
+    """A trainable weight (parity: gluon/parameter.py:Parameter).
+
+    shape dims equal to 0 are unknown and resolved at first forward
+    (deferred initialization).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data = None  # NDArray
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._shared_with = None
+        self._stype = stype
+
+    # ------------------------------------------------------------ shape ----
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and all(
+            s == 0 or s == n for s, n in zip(self._shape, new_shape)), \
+            f"Expected shape {new_shape} incompatible with {self._shape}"
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    # ------------------------------------------------------------- init ----
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """parity: gluon/parameter.py initialize — materialise data, or stash
+        a deferred-init record when shape has unknown dims."""
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter {self.name!r}: unknown shape "
+                f"{self._shape} and allow_deferred_init=False")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx_list, default_init):
+        ctx = ctx_list[0]
+        # precedence parity (gluon/parameter.py _finish_deferred_init): the
+        # parameter's own init wins; the Block-level init is only a default.
+        # A param-specific init applies its weight rule unconditionally; a
+        # global init goes through name-suffix dispatch so bias/gamma/
+        # running stats keep their canonical values under e.g. Xavier.
+        own = self.init if self.init is not None else None
+        chosen = init_mod.create(own or init or default_init)
+        if own is not None:
+            data = chosen.init_array(self.name, self._shape, self.dtype) \
+                if hasattr(chosen, "init_array") \
+                else chosen(self.name, self._shape, self.dtype)
+        else:
+            data = chosen(init_mod.InitDesc(self.name), self._shape, self.dtype)
+        self._data = NDArray(_np.asarray(data), ctx=ctx, dtype=self.dtype)
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self, inferred_shape=None):
+        if self._deferred_init is None:
+            return
+        if inferred_shape is not None:
+            self.shape = inferred_shape
+        if self._shape is None or any(s == 0 for s in self._shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} has unknown shape {self._shape}")
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    # ------------------------------------------------------------- data ----
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} has not been initialized yet because "
+                "its shape is unknown; run a forward pass first")
+        raise RuntimeError(
+            f"Parameter {self.name!r} has not been initialized. You should "
+            "initialize parameters (e.g. net.initialize()) before use")
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return list(self._deferred_init[1])
+        self._check_initialized()
+        return [self._data.context]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._data._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter {self.name!r} "
+                "because grad_req='null'")
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            g = self._data._grad
+            g._rebind((g._data * 0))
+
+    def set_data(self, data):
+        """Overwrite the value in place (keeps grad buffer)."""
+        if self._data is None:
+            if self._deferred_init is not None:
+                self.shape = tuple(data.shape)
+                self._finish_deferred_init()
+            else:
+                self._check_initialized()
+        data = data if isinstance(data, NDArray) else NDArray(data)
+        self._data._rebind(
+            data.astype(self.dtype)._data if str(data.dtype) != str(self.dtype)
+            else data._data)
+
+    def reset_ctx(self, ctx):
+        """Move data to another context IN PLACE — the NDArray handle keeps
+        its identity so CachedOps holding it see the new buffer."""
+        import jax
+
+        self._check_initialized()
+        target = (ctx if isinstance(ctx, Context) else ctx[0]).jax_device()
+        self._data._rebind(jax.device_put(self._data._data, target))
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def cast(self, dtype):
+        from ..base import canonical_dtype
+
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._rebind(
+                self._data._data.astype(canonical_dtype(dtype)))
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    # -------------------------------------------------------------- misc ---
+    def var(self):
+        from .. import symbol as sym_mod
+
+        return sym_mod.var(self.name, shape=self._shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={getattr(self.dtype, '__name__', self.dtype)})"
+
+
+class Constant(Parameter):
+    """Non-trainable parameter holding a fixed value (parity:
+    gluon/parameter.py:Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = _np.asarray(value, dtype=_np.float32)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Load({name: value}, None))
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters (parity: gluon/parameter.py:1072
+    ParameterDict with `get` create-or-share semantics)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __repr__(self):
+        body = "\n".join(f"  {p!r}" for p in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{body}\n)"
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve `prefix+name` (parity semantics: attribute
+        conflicts raise; shared dict consulted first)."""
+        name = self._prefix + name
+        param = self._params.get(name)
+        if param is None and self._shared is not None and name in self._shared:
+            param = self._shared[name]
+            self._params[name] = param
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape":
+                    if v is not None:
+                        param.shape = tuple(v)
+                elif k == "dtype":
+                    pass
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._params.get(name)
+        if param is None:
+            if value is None:
+                raise ValueError(f"No constant named {name}")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"Cannot update self with other because they "
+                                 f"have different Parameters named {k!r}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import utils as nd_utils
+
+        arg_dict = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = p.data()
+        nd_utils.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import utils as nd_utils
+
+        loaded = nd_utils.load(filename)
+        loaded = {restore_prefix + k.replace("arg:", "").replace("aux:", ""): v
+                  for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in loaded, \
+                    f"Parameter {name!r} is missing in file {filename!r}"
+        for name, value in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise ValueError(
+                        f"Parameter {name!r} loaded from {filename!r} is not "
+                        "present in ParameterDict")
+                continue
+            self._params[name].set_data(value)

@@ -1,0 +1,102 @@
+"""Gluon utilities.
+
+Parity target: `python/mxnet/gluon/utils.py` — split_data/split_and_load
+(DP batch sharding), clip_global_norm, check_sha1, download.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..context import Context
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice chunks (parity:
+    gluon/utils.py:split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts (parity: gluon/utils.py:split_and_load).
+
+    On a sharded mesh this is where `jax.device_put(x, sharding)` would
+    replace per-device copies; for per-ctx lists we keep reference
+    semantics."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale so the global grad norm <= max_norm (parity:
+    gluon/utils.py:clip_global_norm)."""
+    assert len(arrays) > 0
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = total ** 0.5
+    if check_isfinite and not (total == total and abs(total) != float("inf")):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind((a * scale)._data)
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """parity: gluon/utils.py:download. This environment has no egress; only
+    file:// URLs and existing files are served."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise RuntimeError(
+        f"download({url!r}): network egress is unavailable in this "
+        "environment; place the file at the target path instead")

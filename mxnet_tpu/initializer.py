@@ -1,0 +1,291 @@
+"""Weight initializers.
+
+Parity target: `python/mxnet/initializer.py` (769 LoC) — registry of
+Initializer classes (Zero/One/Constant/Uniform/Normal/Orthogonal/Xavier/
+MSRAPrelu/Bilinear/LSTMBias), name-pattern dispatch (InitDesc), and the
+`@register` + string-alias mechanism used by `Block.initialize("xavier")`.
+
+TPU-native: initializers produce numpy arrays on host (they run once, off
+the hot path) which the Parameter then `device_put`s; random draws use the
+framework's stateful key stream so `mx.random.seed` reproduces init.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+
+__all__ = ["Initializer", "register", "create", "InitDesc", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "Load"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer class under its lowercased name (parity:
+    mx.init.register)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs):
+    """Resolve an initializer from an instance, class, or alias string."""
+    if init is None:
+        return Uniform()
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, type) and issubclass(init, Initializer):
+        return init(**kwargs)
+    if isinstance(init, str):
+        key = init.lower()
+        if key not in _INIT_REGISTRY:
+            raise ValueError(f"unknown initializer {init!r}; registered: "
+                             f"{sorted(_INIT_REGISTRY)}")
+        return _INIT_REGISTRY[key](**kwargs)
+    raise TypeError(f"cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint passed to initializers (parity:
+    mxnet.init.InitDesc — a str subclass carrying attrs)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer. Subclasses implement `_init_weight`.
+
+    Name-pattern dispatch (parity: initializer.py __call__): names ending in
+    `bias`/`beta`/`running_mean` get zeros, `gamma`/`running_var` ones,
+    unless the initializer is explicitly forced via init= on the Parameter.
+    """
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, shape, dtype=_np.float32):
+        name = str(name)
+        if name.endswith("bias") or name.endswith("beta") \
+                or name.endswith("moving_mean") or name.endswith("running_mean"):
+            return _np.zeros(shape, dtype)
+        if name.endswith("gamma") or name.endswith("moving_var") \
+                or name.endswith("running_var"):
+            return _np.ones(shape, dtype)
+        return self._init_weight(name, shape, dtype)
+
+    def init_array(self, name, shape, dtype=_np.float32):
+        """Force this initializer's weight rule regardless of name."""
+        return self._init_weight(name, shape, dtype)
+
+    def _init_weight(self, name, shape, dtype):
+        raise NotImplementedError
+
+    def _rng(self):
+        from . import random as _rand
+        import numpy as np
+
+        return np.random.default_rng(_np.uint32(_rand.next_key()).flatten())
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return _np.zeros(shape, dtype)
+
+
+_INIT_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return _np.ones(shape, dtype)
+
+
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, dtype):
+        return _np.full(shape, self.value, dtype)
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (parity: initializer.py Uniform, default 0.07)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        return self._rng().uniform(-self.scale, self.scale, shape).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (parity default sigma=0.01)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, dtype):
+        return (self._rng().standard_normal(shape) * self.sigma).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    """parity: initializer.py Orthogonal (scale, rand_type uniform|normal)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, shape, dtype):
+        rng = self._rng()
+        nout = shape[0]
+        nin = int(_np.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.standard_normal((nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        return (self.scale * q).reshape(shape).astype(dtype)
+
+
+@register
+class Xavier(Initializer):
+    """parity: initializer.py Xavier (rnd_type, factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, shape, dtype):
+        hw_scale = 1.0
+        if len(shape) < 2:
+            fan_in, fan_out = shape[0] if shape else 1, shape[0] if shape else 1
+        else:
+            if len(shape) > 2:
+                hw_scale = float(_np.prod(shape[2:]))
+            fan_in = shape[1] * hw_scale
+            fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError(f"bad factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / factor)
+        rng = self._rng()
+        if self.rnd_type == "uniform":
+            return rng.uniform(-scale, scale, shape).astype(dtype)
+        if self.rnd_type == "gaussian":
+            return (rng.standard_normal(shape) * scale).astype(dtype)
+        raise ValueError(f"bad rnd_type {self.rnd_type}")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """parity: initializer.py MSRAPrelu — Xavier variant for PReLU nets."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (parity: initializer.py Bilinear, used by
+    Deconvolution upsampling)."""
+
+    def _init_weight(self, name, shape, dtype):
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return weight.reshape(shape).astype(dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, rest 0 (parity: initializer.py
+    LSTMBias; bias layout [i, f, c, o] each of size h)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, shape, dtype):
+        b = _np.zeros(shape, dtype)
+        h = shape[0] // 4
+        b[h:2 * h] = self.forget_bias
+        return b
+
+
+class Mixed(Initializer):
+    """Pattern-dispatched initializer list (parity: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        assert len(patterns) == len(initializers)
+        self.map = [(re.compile(p), create(i)) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, shape, dtype=_np.float32):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                return init(name, shape, dtype)
+        raise ValueError(f"parameter {name} did not match any pattern")
+
+
+class Load(Initializer):
+    """Initialize from a dict of arrays, falling back to default_init
+    (parity: initializer.py Load, used by model loading)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, shape, dtype=_np.float32):
+        name = str(name)
+        if name in self.param:
+            arr = self.param[name]
+            arr = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+            if tuple(arr.shape) != tuple(shape):
+                raise ValueError(f"Parameter {name} cannot be initialized from "
+                                 f"loading: incompatible shape {arr.shape} vs {shape}")
+            return arr.astype(dtype)
+        if self.default_init is None:
+            raise ValueError(f"Cannot init parameter {name} from loaded dict")
+        return self.default_init(name, shape, dtype)
+
+    _init_weight = __call__

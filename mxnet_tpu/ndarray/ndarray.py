@@ -576,7 +576,15 @@ def _invoke(op_name, nd_inputs, kwargs, out=None):
             w._tape_index = i
         result = wrapped if isinstance(raw_out, tuple) else wrapped[0]
     else:
-        raw_out = op.bound(kwargs)(*raws)
+        import jax.core
+
+        if any(isinstance(r, jax.core.Tracer) for r in raws):
+            # inside a CachedOp/jit trace: emit into the surrounding trace
+            # directly — nesting the per-op jitted executable adds nothing
+            # and breaks vjp of some primitives (reduce_window)
+            raw_out = op.fn(*raws, **kwargs)
+        else:
+            raw_out = op.bound(kwargs)(*raws)
         result = _wrap_outputs(op, raw_out)
     engine.maybe_sync([r._data for r in (result if isinstance(result, tuple) else (result,))])
     if out is not None:

@@ -143,20 +143,24 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             pads.append((pad[i], max(needed, pad[i])))
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    # init values MUST be python scalar literals: array-valued inits break
+    # reduce_window's vjp under jit (jax 0.9 linearization bug)
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype), jax.lax.max,
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
+        return jax.lax.reduce_window(data, init, jax.lax.max,
                                      window, strides, pads)
     if pool_type in ("avg", "sum"):
-        summed = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype), jax.lax.add,
+        zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
+        summed = jax.lax.reduce_window(data, zero, jax.lax.add,
                                        window, strides, pads)
         if pool_type == "sum":
             return summed
         if count_include_pad:
-            denom = _np.prod(kernel)
+            denom = float(_np.prod(kernel))
             return summed / jnp.asarray(denom, data.dtype)
         ones = jnp.ones(data.shape, data.dtype)
-        counts = jax.lax.reduce_window(ones, jnp.asarray(0, data.dtype), jax.lax.add,
+        counts = jax.lax.reduce_window(ones, zero, jax.lax.add,
                                        window, strides, pads)
         return summed / counts
     if pool_type == "lp":
@@ -195,6 +199,7 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     Returns (out, batch_mean, batch_var); running-stat update is done by the
     caller (functional form — keeps the op pure for XLA).
     """
+    axis = axis % data.ndim
     red_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(-1 if i == axis else 1 for i in range(data.ndim))
     if training and not use_global_stats:
@@ -315,6 +320,37 @@ def _activation(data, act_type="relu"):
         "softrelu": jax.nn.softplus,
         "softsign": jax.nn.soft_sign,
     }[act_type](data)
+
+
+@register("LeakyReLU")
+def _leaky_relu(data, gamma=None, key=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334, training=False):
+    """parity: src/operator/leaky_relu.cc — multi-mode activation
+    (leaky/prelu/elu/selu/gelu/rrelu). `gamma` is the learned PReLU slope.
+    rrelu draws U(lower, upper) slopes per element in training (pass a PRNG
+    `key`); inference uses the deterministic midpoint slope."""
+    if act_type == "rrelu" and training and key is not None:
+        slopes = jax.random.uniform(key, data.shape, data.dtype,
+                                    lower_bound, upper_bound)
+        return jnp.where(data > 0, data, slopes * data)
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2)) if data.ndim > 1 else g
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data > 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, mid * data)
+    raise ValueError(f"unknown LeakyReLU act_type {act_type}")
 
 
 # --------------------------------------------------------------- Dropout ---

@@ -41,9 +41,17 @@ def current_seed() -> int:
 
 
 def next_key():
-    """Draw a fresh PRNG key, advancing the global stream."""
+    """Draw a fresh PRNG key, advancing the global stream.
+
+    Inside a CachedOp trace, keys come from the scope's traced key input so
+    compiled graphs stay pure yet advance with the global stream per call."""
     import jax
 
+    from . import cached_op
+
+    scope = cached_op.current_trace()
+    if scope is not None:
+        return scope.next_key()
     _ensure()
     _state.key, sub = jax.random.split(_state.key)
     return sub

@@ -1,0 +1,358 @@
+"""Gluon tests.
+
+Parity model: tests/python/unittest/test_gluon.py (3.3k LoC) — the core
+fixture: run every layer hybridized AND unhybridized and cross-assert
+outputs; parameter management; deferred init; save/load round trips.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.parameter import DeferredInitializationError, Parameter
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def check_layer_forward(layer, shape, dtype=np.float32):
+    """The central gluon fixture: eager forward == hybridized forward, and
+    grads flow (parity: test_gluon.py check_layer_forward)."""
+    layer.initialize()
+    x = mx.nd.array(np.random.uniform(-1, 1, shape).astype(dtype))
+    x.attach_grad()
+    with ag.record():
+        out1 = layer(x)
+    out1.backward()
+    np_out1 = out1.asnumpy()
+    np_dx1 = x.grad.asnumpy()
+
+    layer.hybridize()
+    with ag.record():
+        out2 = layer(x)
+    out2.backward()
+    assert_almost_equal(np_out1, out2.asnumpy(), rtol=1e-4, atol=1e-5,
+                        names=("eager", "hybrid"))
+    assert_almost_equal(np_dx1, x.grad.asnumpy(), rtol=1e-4, atol=1e-5,
+                        names=("eager_grad", "hybrid_grad"))
+    return np_out1
+
+
+def test_dense():
+    out = check_layer_forward(nn.Dense(8), (4, 16))
+    assert out.shape == (4, 8)
+    check_layer_forward(nn.Dense(8, activation="relu", use_bias=False), (4, 16))
+    check_layer_forward(nn.Dense(8, flatten=False), (4, 5, 16))
+    # flatten=True collapses trailing dims
+    out = check_layer_forward(nn.Dense(8), (4, 2, 8))
+    assert out.shape == (4, 8)
+
+
+def test_dense_deferred_and_explicit():
+    net = nn.Dense(4, in_units=6)
+    net.initialize()
+    assert net.weight.shape == (4, 6)
+    net2 = nn.Dense(4)
+    net2.initialize()
+    with pytest.raises(DeferredInitializationError):
+        net2.weight.data()
+    _ = net2(mx.nd.ones((2, 6)))
+    assert net2.weight.shape == (4, 6)
+
+
+def test_conv_layers():
+    check_layer_forward(nn.Conv1D(4, 3), (2, 3, 10))
+    check_layer_forward(nn.Conv2D(4, 3, padding=1), (2, 3, 8, 8))
+    check_layer_forward(nn.Conv2D(4, 3, strides=2, use_bias=False), (2, 3, 8, 8))
+    check_layer_forward(nn.Conv2D(4, (3, 5), padding=(1, 2), dilation=(2, 1)),
+                        (2, 3, 10, 10))
+    check_layer_forward(nn.Conv2D(4, 3, groups=1, activation="relu"), (2, 2, 8, 8))
+    check_layer_forward(nn.Conv3D(2, 3), (2, 2, 6, 6, 6))
+    check_layer_forward(nn.Conv2DTranspose(3, 3), (2, 4, 5, 5))
+    check_layer_forward(nn.Conv1DTranspose(3, 3, strides=2), (2, 4, 5))
+
+
+def test_conv2d_vs_numpy():
+    layer = nn.Conv2D(1, 2, in_channels=1, use_bias=False)
+    layer.initialize()
+    x = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = layer(x).asnumpy()
+    w = layer.weight.data().asnumpy()
+    ref = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = (x.asnumpy()[0, 0, i:i + 2, j:j + 2] * w[0, 0]).sum()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pool_layers():
+    check_layer_forward(nn.MaxPool2D(), (2, 3, 8, 8))
+    check_layer_forward(nn.MaxPool2D(3, 2, 1), (2, 3, 9, 9))
+    check_layer_forward(nn.AvgPool2D(), (2, 3, 8, 8))
+    check_layer_forward(nn.GlobalAvgPool2D(), (2, 3, 8, 8))
+    check_layer_forward(nn.GlobalMaxPool2D(), (2, 3, 8, 8))
+    check_layer_forward(nn.MaxPool1D(), (2, 3, 8))
+    check_layer_forward(nn.AvgPool3D(), (2, 3, 4, 4, 4))
+    out = nn.GlobalAvgPool2D()
+    out.initialize()
+    y = out(mx.nd.ones((2, 3, 5, 5)))
+    assert y.shape == (2, 3, 1, 1)
+
+
+def test_norm_layers():
+    check_layer_forward(nn.BatchNorm(), (4, 3, 8, 8))
+    check_layer_forward(nn.BatchNorm(axis=-1), (4, 8, 3))
+    check_layer_forward(nn.LayerNorm(), (4, 10))
+    check_layer_forward(nn.InstanceNorm(), (4, 3, 8, 8))
+    check_layer_forward(nn.GroupNorm(num_groups=2), (4, 4, 8, 8))
+
+
+def test_batchnorm_running_stats():
+    layer = nn.BatchNorm(momentum=0.5)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(8, 3, 4, 4).astype(np.float32) + 2.0)
+    with ag.record():
+        layer(x)
+    rm = layer.running_mean.data().asnumpy()
+    # after one update: 0.5*0 + 0.5*batch_mean
+    expect = 0.5 * x.asnumpy().mean(axis=(0, 2, 3))
+    assert_almost_equal(rm, expect, rtol=1e-3, atol=1e-4)
+    # inference uses running stats (not batch stats)
+    y = layer(x).asnumpy()
+    rv = layer.running_var.data().asnumpy()
+    ref = (x.asnumpy() - rm[None, :, None, None]) / np.sqrt(
+        rv[None, :, None, None] + 1e-5)
+    assert_almost_equal(y, ref * layer.gamma.data().asnumpy()[None, :, None, None]
+                        + layer.beta.data().asnumpy()[None, :, None, None],
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_activations():
+    for layer in [nn.Activation("relu"), nn.Activation("sigmoid"),
+                  nn.Activation("tanh"), nn.Activation("softrelu"),
+                  nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.GELU(),
+                  nn.Swish(), nn.PReLU()]:
+        check_layer_forward(layer, (4, 8))
+
+
+def test_embedding_flatten_dropout():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([1, 2, 3])
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    assert_almost_equal(out, emb.weight.data().asnumpy()[[1, 2, 3]])
+
+    check_layer_forward(nn.Flatten(), (2, 3, 4, 5))
+    d = nn.Dropout(0.5)
+    d.initialize()
+    x = mx.nd.ones((100, 100))
+    assert d(x).asnumpy().sum() == 100 * 100  # inference: identity
+    with ag.record():
+        y = d(x)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_sequential_variants():
+    for cls in (nn.Sequential, nn.HybridSequential):
+        net = cls()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+        net.initialize()
+        out = net(mx.nd.ones((2, 6)))
+        assert out.shape == (2, 4)
+        assert len(net) == 2
+        assert isinstance(net[0], nn.Dense)
+        sub = net[0:1]
+        assert len(sub) == 1
+
+
+def test_block_registration_and_params():
+    class Net(nn.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.fc1 = nn.Dense(8)
+                self.fc2 = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net(prefix="net_")
+    names = list(net.collect_params().keys())
+    assert names == ["net_dense0_weight", "net_dense0_bias",
+                     "net_dense1_weight", "net_dense1_bias"]
+    net.initialize()
+    out = net(mx.nd.ones((2, 5)))
+    assert out.shape == (2, 4)
+    net.hybridize()
+    out2 = net(mx.nd.ones((2, 5)))
+    assert_almost_equal(out, out2)
+    # regex select
+    weights = net.collect_params(".*weight")
+    assert len(weights) == 2
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.ones((2, 6))
+    ref = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net2.initialize()  # different random init
+    net2(x)
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x).asnumpy(), ref)
+
+
+def test_parameter_api():
+    p = Parameter("w", shape=(3, 4))
+    p.initialize()
+    assert p.data().shape == (3, 4)
+    p.set_data(mx.nd.ones((3, 4)))
+    assert p.data().asnumpy().sum() == 12
+    p.grad_req = "null"
+    assert p.data()._grad is None
+    p.grad_req = "write"
+    assert p.grad() is not None
+    p.zero_grad()
+    assert p.grad().asnumpy().sum() == 0
+
+
+def test_initializers_dispatch():
+    net = nn.Dense(16, in_units=16)
+    net.initialize(mx.init.Xavier())
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert (b == 0).all()          # bias stays zeros under global Xavier
+    assert w.std() > 0
+    bound = np.sqrt(3.0 / ((16 + 16) / 2))
+    assert np.abs(w).max() <= bound + 1e-6
+
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize(mx.init.Normal(1.0))
+    assert (bn.running_var.data().asnumpy() == 1).all()
+    assert (bn.gamma.data().asnumpy() == 1).all()
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.rand(4, 10).astype(np.float32))
+    label_idx = mx.nd.array(np.random.randint(0, 10, (4,)).astype(np.float32))
+
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label_idx)
+    lp = pred.asnumpy()
+    ls = np.exp(lp - lp.max(-1, keepdims=True))
+    ls = ls / ls.sum(-1, keepdims=True)
+    expect = -np.log(ls[np.arange(4), label_idx.asnumpy().astype(int)])
+    assert_almost_equal(l, expect, rtol=1e-4, atol=1e-5)
+
+    a = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+    b = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+    assert_almost_equal(gloss.L2Loss()(a, b),
+                        0.5 * ((a.asnumpy() - b.asnumpy()) ** 2).mean(-1))
+    assert_almost_equal(gloss.L1Loss()(a, b),
+                        np.abs(a.asnumpy() - b.asnumpy()).mean(-1))
+
+    # sigmoid BCE from logits vs manual
+    logits = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+    labels = mx.nd.array((np.random.rand(4, 3) > 0.5).astype(np.float32))
+    out = gloss.SigmoidBCELoss()(logits, labels).asnumpy()
+    z = logits.asnumpy()
+    ref = np.maximum(z, 0) - z * labels.asnumpy() + np.log1p(np.exp(-np.abs(z)))
+    assert_almost_equal(out, ref.mean(-1), rtol=1e-4, atol=1e-5)
+
+    # hinge / huber shapes + grads flow
+    for L in [gloss.HingeLoss(), gloss.SquaredHingeLoss(), gloss.LogisticLoss(),
+              gloss.HuberLoss(), gloss.KLDivLoss(from_logits=False)]:
+        la = mx.nd.ones((4, 3))
+        a2 = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+        a2.attach_grad()
+        with ag.record():
+            out = L(a2, la)
+        out.backward()
+        assert out.shape == (4,)
+        assert np.isfinite(a2.grad.asnumpy()).all()
+
+
+def test_loss_weight_and_sample_weight():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    l_plain = gloss.L2Loss()(a, b).asnumpy()
+    l_weighted = gloss.L2Loss(weight=4.0)(a, b).asnumpy()
+    assert_almost_equal(l_weighted, 4 * l_plain)
+    sw = mx.nd.array([[1.0], [0.0]])
+    l_sw = gloss.L2Loss()(a, b, sw).asnumpy()
+    assert l_sw[1] == 0
+
+
+def test_triplet_cosine():
+    a = mx.nd.array(np.random.rand(4, 8).astype(np.float32))
+    p = mx.nd.array(np.random.rand(4, 8).astype(np.float32))
+    n = mx.nd.array(np.random.rand(4, 8).astype(np.float32))
+    out = gloss.TripletLoss()(a, p, n)
+    assert out.shape == (4,)
+    lbl = mx.nd.array([1, -1, 1, -1])
+    out = gloss.CosineEmbeddingLoss()(a, p, lbl)
+    assert out.shape == (4,)
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda(lambda x: x * 2)
+    assert lam(mx.nd.ones((2, 2))).asnumpy().sum() == 8
+    hlam = nn.HybridLambda(lambda F, x: F.invoke("relu", x) + 1)
+    out = hlam(mx.nd.array([-1.0, 2.0]))
+    assert_almost_equal(out, np.array([1.0, 3.0]))
+
+
+def test_cast():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast(np.float16)
+    assert net.weight.dtype == np.float16
+    out = net(mx.nd.ones((2, 3), dtype=np.float16))
+    assert out.dtype == np.float16
+
+
+def test_reflection_pad():
+    layer = nn.ReflectionPad2D(1)
+    layer.initialize()
+    x = mx.nd.array(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+    out = layer(x)
+    assert out.shape == (1, 1, 5, 5)
+    ref = np.pad(x.asnumpy(), ((0, 0), (0, 0), (1, 1), (1, 1)), mode="reflect")
+    assert_almost_equal(out, ref)
+
+
+def test_grad_through_hybrid_params():
+    """Gradients reach parameters through the compiled path and match the
+    eager path (parity: the check_consistency idea applied to hybridize)."""
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(1, in_units=16))
+        return net
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net_e = build()
+    net_e.initialize()
+    net_h = build()
+    net_h.initialize()
+    # copy weights
+    for pe, ph in zip(net_e.collect_params().values(),
+                      net_h.collect_params().values()):
+        ph.set_data(pe.data())
+    net_h.hybridize()
+
+    x = mx.nd.array(np.random.rand(4, 8).astype(np.float32))
+    grads = []
+    for net in (net_e, net_h):
+        with ag.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        grads.append([p.grad().asnumpy() for p in net.collect_params().values()])
+    for ge, gh in zip(*grads):
+        assert_almost_equal(ge, gh, rtol=1e-4, atol=1e-5)
