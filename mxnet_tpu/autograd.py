@@ -244,6 +244,7 @@ def _accumulate_leaf(leaf, g, written):
     req = leaf._grad_req
     if req == "null" or leaf._grad is None:
         return
+    leaf._fresh_grad = True  # stale-grad tracking (parity: Parameter._fresh_grad)
     g = g.astype(leaf._grad._data.dtype)
     if req == "write" and id(leaf) not in written:
         # 'write': first contribution this backward overwrites; further

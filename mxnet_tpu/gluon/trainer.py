@@ -1,0 +1,179 @@
+"""Gluon Trainer.
+
+Parity target: `python/mxnet/gluon/trainer.py` (`Trainer` :28 —
+`_init_kvstore` :174 decision table, `step` :320, `allreduce_grads` :349,
+`update` :397, save/load_states :468/:497).
+
+TPU-native: gradient aggregation across devices rides the kvstore layer
+(`mxnet_tpu.kvstore`), which maps `device`/`dist_device_sync` onto XLA
+collectives. With a single logical copy per parameter (sharded or
+replicated by the mesh layer), allreduce is only engaged when a kvstore is
+explicitly provided.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._states = [None] * len(self._params)
+        self._states_created = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = None
+
+    def _create_states(self):
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and self._states[i] is None:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(i, param.data())
+        self._states_created = True
+
+    def _init_kvstore(self):
+        """parity: trainer.py:174 — resolve the kvstore; 'device'/'local' on
+        a single process needs no store at all (grads already aggregated by
+        the mesh layer)."""
+        if isinstance(self._kvstore_type, str):
+            if self._kvstore_type in ("device", "local", "nccl") \
+                    or self._kvstore_type.startswith("local"):
+                self._kvstore = None  # single-process: no reduction needed
+            else:
+                from .. import kvstore as kv_mod
+
+                self._kvstore = kv_mod.create(self._kvstore_type)
+        else:
+            self._kvstore = self._kvstore_type
+        if self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.init(i, param.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step scaled by 1/batch_size (parity:
+        trainer.py:320)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if not self._states_created:
+            self._create_states()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.grad())
+                self._kvstore.pull(i, param.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if not self._states_created:
+            self._create_states()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            data = param.data()
+            if not data._fresh_grad:
+                if ignore_stale_grad:
+                    continue  # param unused this iteration: skip its update
+                # parity: trainer.py:393 raises UserWarning on stale grads
+                raise UserWarning(
+                    f"Gradient of Parameter `{param.name}` has not been "
+                    "updated by backward since last `step`. This could mean "
+                    "a bug in your model that made it only use a subset of "
+                    "the Parameters for this iteration. If you are "
+                    "intentionally only using a subset, call step with "
+                    "ignore_stale_grad=True to suppress this warning and "
+                    "skip updating of Parameters with stale gradient")
+            self._optimizer.update_multi_precision(
+                i, data, param.grad(), self._states[i])
+            data._fresh_grad = False
+
+    def save_states(self, fname):
+        """parity: trainer.py:468."""
+        assert self._optimizer is not None
+        if not self._states_created:
+            self._create_states()
+        import pickle
+
+        with open(fname, "wb") as f:
+            pickle.dump((self._states, self._optimizer.__getstate__()), f)
+
+    def load_states(self, fname):
+        """parity: trainer.py:497."""
+        import pickle
+
+        with open(fname, "rb") as f:
+            states, opt_state = pickle.load(f)
+        self._states_created = True
+        self._states = states
+        self._optimizer.__setstate__({**self._optimizer.__getstate__(),
+                                      **{k: v for k, v in opt_state.items()
+                                         if k not in ("param_dict",)}})

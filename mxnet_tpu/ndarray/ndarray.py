@@ -60,7 +60,7 @@ class NDArray:
     """An async, device-resident, mutable-by-rebinding tensor handle."""
 
     __slots__ = ("_data", "_grad", "_grad_req", "_tape_node", "_tape_index",
-                 "__weakref__")
+                 "_fresh_grad", "__weakref__")
 
     _is_np_shape = False
 
@@ -76,6 +76,7 @@ class NDArray:
         self._grad_req = "null"
         self._tape_node = None
         self._tape_index = 0
+        self._fresh_grad = False  # set by backward; cleared by Trainer update
 
     # -------------------------------------------------- basic properties ---
     @property
@@ -138,6 +139,11 @@ class NDArray:
 
     def __hash__(self):
         return id(self)
+
+    def __reduce__(self):
+        # pickle via host numpy; context is stripped, like NDArray::Save
+        # (src/ndarray/ndarray.cc:1746 — ctx-stripped serialization)
+        return (NDArray, (self.asnumpy(),))
 
     def __iter__(self):
         for i in range(self.shape[0]):

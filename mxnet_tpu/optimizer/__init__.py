@@ -1,0 +1,3 @@
+"""Optimizers (parity: python/mxnet/optimizer/)."""
+from .optimizer import *
+from .optimizer import __all__  # noqa: F401

@@ -1,0 +1,715 @@
+"""Optimizers.
+
+Parity target: `python/mxnet/optimizer/optimizer.py` (17 optimizers: SGD
+:526, Signum, FTML, LARS :797, LBSGD, LAMB :1250, DCASGD, NAG, SGLD, Adam
+:1547, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam, Test) — each
+dispatching to fused update *ops* (`src/operator/optimizer_op.cc:49-970`),
+with lr/wd multipliers, num_update-driven schedules, multi-precision master
+weights, and the `Updater` used by update-on-kvstore.
+
+TPU-native: update ops are jitted XLA computations (ops/optimizer_op.py);
+one executable per (op, hyper-param) pair serves every parameter shape via
+the registry's executable cache.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["Optimizer", "register", "create", "SGD", "Signum", "SignSGD",
+           "FTML", "LARS", "LBSGD", "LAMB", "DCASGD", "NAG", "SGLD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+           "Test", "Updater", "get_updater"]
+
+
+class Optimizer:
+    """Base optimizer (parity: optimizer.py:36)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # ----------------------------------------------------------- registry --
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}; registered: "
+                         f"{sorted(Optimizer.opt_registry)}")
+
+    # -------------------------------------------------------------- state --
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp16/bf16 weights get an fp32 master copy prepended to the state
+        (parity: optimizer.py create_state_multi_precision)."""
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            weight_master_copy = weight.astype(_np.float32)
+            return (weight_master_copy, self.create_state(index, weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            weight32, base_state = state
+            grad32 = grad.astype(_np.float32)
+            self.update(index, weight32, grad32, base_state)
+            weight._rebind(weight32.astype(weight.dtype)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    # ------------------------------------------------------------- mults ---
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            pass
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    @property
+    def learning_rate(self):
+        """Base (scheduled) lr without per-param multipliers (parity:
+        optimizer.py learning_rate property)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        # do not serialize live Parameters (parity: optimizer.py:510-514)
+        del ret["param_dict"]
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.__dict__.setdefault("param_dict", {})
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _invoke_update(op_name, weight, arrays, kwargs):
+    """Run a fused update op and write results back into (weight, *states)."""
+    outs = nd.invoke(op_name, weight, *arrays, **kwargs)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    weight._rebind(outs[0]._data)
+    return outs[1:]
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum & multi-precision (parity: optimizer.py:526)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0}
+        if self.momentum != 0.0 and state is not None:
+            (mom_new,) = _invoke_update("sgd_mom_update", weight, [grad, state],
+                                        {**kwargs, "momentum": self.momentum})
+            state._rebind(mom_new._data)
+        else:
+            _invoke_update("sgd_update", weight, [grad], kwargs)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: optimizer.py SGLD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=weight.dtype, ctx=weight.context)
+        weight._rebind((weight - lr / 2 * (g + wd * weight) + noise)._data)
+
+
+@register
+class Signum(Optimizer):
+    """parity: optimizer.py Signum — sign of momentum."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kwargs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0}
+        if state is not None:
+            (mom,) = _invoke_update("signum_update", weight, [grad, state],
+                                    {**kwargs, "momentum": self.momentum,
+                                     "wd_lh": self.wd_lh})
+            state._rebind(mom._data)
+        else:
+            _invoke_update("signsgd_update", weight, [grad], kwargs)
+
+
+SignSGD = Signum
+
+
+@register
+class FTML(Optimizer):
+    """parity: optimizer.py FTML."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        d = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        v = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (d, v, z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        outs = _invoke_update("ftml_update", weight, [grad, d, v, z],
+                              {"lr": lr, "wd": wd, "beta1": self.beta1,
+                               "beta2": self.beta2, "epsilon": self.epsilon,
+                               "rescale_grad": self.rescale_grad,
+                               "clip_grad": self.clip_gradient if self.clip_gradient else -1.0,
+                               "t": t})
+        for s, o in zip((d, v, z), outs):
+            s._rebind(o._data)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (parity: optimizer.py:797)."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w_norm = float(weight.norm().asscalar())
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g_norm = float(g.norm().asscalar())
+        if w_norm > 0 and g_norm > 0:
+            lars_lr = lr * self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
+        else:
+            lars_lr = lr
+        kwargs = {"lr": lars_lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0}
+        if state is not None:
+            (mom,) = _invoke_update("sgd_mom_update", weight, [grad, state],
+                                    {**kwargs, "momentum": self.momentum})
+            state._rebind(mom._data)
+        else:
+            _invoke_update("sgd_update", weight, [grad], kwargs)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with warmup (parity: optimizer.py LBSGD; realized as
+    SGD + LARS-style scaling is handled by LARS — kept for API parity)."""
+
+
+@register
+class LAMB(Optimizer):
+    """parity: optimizer.py:1250 — layerwise adaptive moments."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g_update = nd.invoke("lamb_update_phase1", weight, grad, mean, var,
+                             beta1=self.beta1, beta2=self.beta2,
+                             epsilon=self.epsilon, t=t,
+                             bias_correction=self.bias_correction, wd=wd,
+                             rescale_grad=self.rescale_grad,
+                             clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+        g, mean_new, var_new = g_update
+        mean._rebind(mean_new._data)
+        var._rebind(var_new._data)
+        r1 = weight.norm()
+        r2 = g.norm()
+        new_w = nd.invoke("lamb_update_phase2", weight, g, r1, r2, lr=lr,
+                          lower_bound=self.lower_bound if self.lower_bound else -1.0,
+                          upper_bound=self.upper_bound if self.upper_bound else -1.0)
+        weight._rebind(new_w._data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (g + wd * weight
+                       + self.lamda * g * g * (weight - previous_weight))
+        if mom is not None:
+            mom._rebind((self.momentum * mom + delta)._data)
+            delta = mom
+        previous_weight._rebind(weight._data)
+        weight._rebind((weight + delta)._data)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (parity: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kwargs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0}
+        if state is not None:
+            (mom,) = _invoke_update("nag_mom_update", weight, [grad, state],
+                                    {**kwargs, "momentum": self.momentum})
+            state._rebind(mom._data)
+        else:
+            _invoke_update("sgd_update", weight, [grad], kwargs)
+
+
+@register
+class Adam(Optimizer):
+    """parity: optimizer.py:1547 — bias-corrected via lr scaling like the
+    reference (coef1/coef2 applied to lr)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        outs = _invoke_update("adam_update", weight, [grad, mean, var],
+                              {"lr": lr, "wd": wd, "beta1": self.beta1,
+                               "beta2": self.beta2, "epsilon": self.epsilon,
+                               "rescale_grad": self.rescale_grad,
+                               "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0})
+        mean._rebind(outs[0]._data)
+        var._rebind(outs[1]._data)
+
+
+@register
+class AdaGrad(Optimizer):
+    """parity: optimizer.py AdaGrad."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        (hist,) = _invoke_update(
+            "adagrad_update", weight, [grad, state],
+            {"lr": lr, "wd": wd, "epsilon": self.float_stable_eps,
+             "rescale_grad": self.rescale_grad,
+             "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0})
+        state._rebind(hist._data)
+
+
+@register
+class RMSProp(Optimizer):
+    """parity: optimizer.py RMSProp (centered=True → rmspropalex)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        def z():
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+        if self.centered:
+            return (z(), z(), z())  # n, g, delta
+        return (z(),)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        common = {"lr": lr, "wd": wd, "gamma1": self.gamma1,
+                  "epsilon": self.epsilon, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
+                  "clip_weights": self.clip_weights if self.clip_weights else -1.0}
+        if self.centered:
+            n, g, delta = state
+            outs = _invoke_update("rmspropalex_update", weight,
+                                  [grad, n, g, delta],
+                                  {**common, "gamma2": self.gamma2})
+            for s, o in zip((n, g, delta), outs):
+                s._rebind(o._data)
+        else:
+            (n,) = state
+            outs = _invoke_update("rmsprop_update", weight, [grad, n], common)
+            n._rebind(outs[0]._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    """parity: optimizer.py AdaDelta."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        outs = _invoke_update("adadelta_update", weight, [grad, acc_g, acc_delta],
+                              {"rho": self.rho, "epsilon": self.epsilon,
+                               "wd": wd, "rescale_grad": self.rescale_grad,
+                               "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0})
+        acc_g._rebind(outs[0]._data)
+        acc_delta._rebind(outs[1]._data)
+
+
+@register
+class Ftrl(Optimizer):
+    """parity: optimizer.py Ftrl."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        outs = _invoke_update("ftrl_update", weight, [grad, z, n],
+                              {"lr": lr, "wd": wd, "lamda1": self.lamda1,
+                               "beta": self.beta,
+                               "rescale_grad": self.rescale_grad,
+                               "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0})
+        z._rebind(outs[0]._data)
+        n._rebind(outs[1]._data)
+
+
+@register
+class Adamax(Optimizer):
+    """parity: optimizer.py Adamax (infinity-norm Adam)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t._rebind((self.beta1 * m_t + (1.0 - self.beta1) * g)._data)
+        u_t._rebind(nd.invoke("broadcast_maximum", self.beta2 * u_t, g.abs())._data)
+        weight._rebind((weight - lr * m_t / (u_t + 1e-8))._data)
+
+
+@register
+class Nadam(Optimizer):
+    """parity: optimizer.py Nadam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._rebind((self.beta1 * m_t + (1.0 - self.beta1) * g)._data)
+        v_t._rebind((self.beta2 * v_t + (1.0 - self.beta2) * g * g)._data)
+        grad_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight._rebind(
+            (weight - lr * m_t_bar / ((v_t_prime.sqrt()) + self.epsilon))._data)
+
+
+@register
+class Test(Optimizer):
+    """parity: optimizer.py Test — plain accumulation, for unit tests."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._rebind((weight - grad * self.rescale_grad * self.lr)._data)
+        state._rebind(weight._data)
+
+
+class Updater:
+    """Wraps an Optimizer for kvstore update-on-server (parity:
+    optimizer.py:2070)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        if dump_optimizer:
+            return pickle.dumps((self.states, self.optimizer))
+        return pickle.dumps(self.states)
+
+    def set_states(self, states):
+        import pickle
+
+        loaded = pickle.loads(states)
+        if isinstance(loaded, tuple) and len(loaded) == 2 and not isinstance(
+                loaded[0], int):
+            states, self.optimizer = loaded
+        else:
+            states = loaded
+        self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,118 @@
+"""Metric tests (parity model: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2.0 / 3)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)  # both labels in top-2
+    m.reset()
+    label = mx.nd.array([1, 2])  # row1 top-2 = {0,1}, misses 2
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_mcc():
+    pred = mx.nd.array([[0.7, 0.3], [0.2, 0.8], [0.1, 0.9], [0.6, 0.4]])
+    label = mx.nd.array([0, 1, 1, 1])
+    f1 = metric.F1()
+    f1.update([label], [pred])
+    # tp=2 fp=0 fn=1 -> p=1, r=2/3, f1=0.8
+    assert f1.get()[1] == pytest.approx(0.8)
+    mcc = metric.MCC()
+    mcc.update([label], [pred])
+    assert -1 <= mcc.get()[1] <= 1
+
+
+def test_mae_mse_rmse():
+    pred = mx.nd.array([1.0, 2.0, 3.0])
+    label = mx.nd.array([1.5, 2.0, 2.5])
+    mae = metric.MAE()
+    mae.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(1.0 / 3)
+    mse = metric.MSE()
+    mse.update([label], [pred])
+    assert mse.get()[1] == pytest.approx(0.25 * 2 / 3)
+    rmse = metric.RMSE()
+    rmse.update([label], [pred])
+    assert rmse.get()[1] == pytest.approx(np.sqrt(0.25 * 2 / 3))
+
+
+def test_perplexity_crossentropy():
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    ce = metric.CrossEntropy()
+    ce.update([label], [pred])
+    expect = -(np.log(0.75) + np.log(0.5)) / 2
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    ppl = metric.Perplexity()
+    ppl.update([label], [pred])
+    assert ppl.get()[1] == pytest.approx(np.exp(expect), rel=1e-5)
+
+
+def test_pearson():
+    m = metric.PearsonCorrelation()
+    pred = mx.nd.array([1.0, 2.0, 3.0, 4.0])
+    label = mx.nd.array([2.0, 4.0, 6.0, 8.0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_composite_create_custom():
+    comp = metric.create(["acc", "mae"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert "accuracy" in names and "mae" in names
+
+    custom = metric.np(lambda label, pred: float(np.abs(label - pred.argmax(1)).sum()))
+    custom.update([label], [pred])
+    assert custom.get()[1] == 0.0
+
+    m = metric.create("acc")
+    assert isinstance(m, metric.Accuracy)
+    with pytest.raises(ValueError):
+        metric.create("unknown_metric")
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, [mx.nd.array([1.0, 2.0, 3.0])])
+    assert m.get()[1] == pytest.approx(2.0)
+
+
+def test_accuracy_column_labels():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = mx.nd.array([[1], [0]])  # (N,1) column labels
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_perplexity_axis():
+    pred = mx.nd.array(np.moveaxis(np.array([[[0.25, 0.75], [0.5, 0.5]]]), -1, 1))
+    label = mx.nd.array([[1, 0]])
+    ppl = metric.Perplexity(axis=1)
+    ppl.update([label], [pred])
+    expect = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert ppl.get()[1] == pytest.approx(expect, rel=1e-5)

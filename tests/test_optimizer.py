@@ -1,0 +1,212 @@
+"""Optimizer / Trainer / lr_scheduler tests.
+
+Parity model: tests/python/unittest/test_optimizer.py — each optimizer
+checked against a pure-numpy reference implementation over several steps.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import lr_scheduler
+from mxnet_tpu.gluon import nn, Trainer, loss as gloss
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def run_optimizer(opt, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(5)]
+    out = run_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.01), w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(5)]
+    out = run_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9), w0, grads)
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    assert_almost_equal(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.rand(5).astype(np.float32)
+    grads = [np.random.rand(5).astype(np.float32) for _ in range(5)]
+    out = run_optimizer(mx.optimizer.Adam(learning_rate=0.01), w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_all_optimizers_step():
+    """Every registered optimizer takes a step without error and changes w."""
+    for name, klass in mx.optimizer.Optimizer.opt_registry.items():
+        opt = klass()
+        w = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+        w0 = w.asnumpy().copy()
+        state = opt.create_state(0, w)
+        opt.update(0, w, mx.nd.array(np.random.rand(4, 3).astype(np.float32) + 0.1),
+                   state)
+        assert not np.allclose(w.asnumpy(), w0), f"{name} did not update"
+
+
+def test_multi_precision():
+    opt = mx.optimizer.SGD(learning_rate=0.1, multi_precision=True)
+    w = mx.nd.array(np.random.rand(4).astype(np.float16), dtype=np.float16)
+    state = opt.create_state_multi_precision(0, w)
+    assert state[0].dtype == np.float32  # master weights
+    g = mx.nd.array(np.random.rand(4).astype(np.float16), dtype=np.float16)
+    opt.update_multi_precision(0, w, g, state)
+    assert w.dtype == np.float16
+
+
+def test_lr_mult_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           param_idx2name={0: "w_weight", 1: "b_bias"}, wd=0.1)
+    opt.set_lr_mult({"w_weight": 0.5})
+    assert opt._get_lr(0) == 0.5
+    assert opt._get_lr(1) == 1.0
+    # bias gets wd 0 by default rule
+    assert opt._get_wd(1) == 0.0
+
+
+def test_create_by_name():
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    assert isinstance(opt, mx.optimizer.Adam)
+    assert opt.lr == 0.1
+    with pytest.raises(ValueError):
+        mx.optimizer.create("nope")
+
+
+def test_trainer_training_decreases_loss():
+    np.random.seed(1)
+    mx.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9})
+    L = gloss.L2Loss()
+    x_np = np.random.rand(64, 8).astype(np.float32)
+    y_np = (x_np.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+    losses = []
+    for _ in range(40):
+        with ag.record():
+            out = net(x)
+            loss = L(out, y)
+        loss.backward()
+        trainer.step(64)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.2, f"loss did not decrease: {losses[::10]}"
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 3))
+    with ag.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer2 = Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    trainer2.load_states(fname)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+
+def test_learning_rate_property():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+
+def test_factor_scheduler():
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_multifactor_scheduler():
+    s = lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert s(2) == 1.0
+    assert abs(s(7) - 0.1) < 1e-9
+    assert abs(s(12) - 0.01) < 1e-9
+
+
+def test_poly_cosine_schedulers():
+    p = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert p(100) == 0.0
+    c = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert abs(c(0) - 1.0) < 1e-9
+    assert abs(c(100) - 0.1) < 1e-9
+    assert 0.1 < c(50) < 1.0
+
+
+def test_warmup():
+    s = lr_scheduler.FactorScheduler(step=100, factor=1.0, base_lr=1.0,
+                                     warmup_steps=10, warmup_begin_lr=0.0)
+    assert s(0) == 0.0
+    assert abs(s(5) - 0.5) < 1e-9
+    assert s(10) == 1.0
+
+
+def test_optimizer_with_scheduler():
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.ones((2,))
+    state = opt.create_state(0, w)
+    for _ in range(6):
+        opt.update(0, w, mx.nd.ones((2,)), state)
+    assert opt._get_lr(0) < 1.0
+
+
+def test_stale_grad_detection():
+    """parity: trainer.py raises UserWarning on stale grads; skip with
+    ignore_stale_grad=True."""
+    d1 = nn.Dense(4, in_units=3)
+    d2 = nn.Dense(4, in_units=3)
+    d1.initialize()
+    d2.initialize()
+    params = list(d1.collect_params().values()) + list(d2.collect_params().values())
+    from mxnet_tpu.gluon import Trainer as T
+
+    trainer = T(params, "sgd", {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 3))
+    with ag.record():
+        loss = d1(x).sum()  # d2 unused
+    loss.backward()
+    with pytest.raises(UserWarning):
+        trainer.step(2)
+    w2_before = d2.weight.data().asnumpy().copy()
+    trainer.step(2, ignore_stale_grad=True)
+    assert np.allclose(d2.weight.data().asnumpy(), w2_before)  # skipped
