@@ -1,0 +1,9 @@
+"""Gluon data API (parity: python/mxnet/gluon/data/)."""
+from .dataset import (ArrayDataset, Dataset, RecordFileDataset, SimpleDataset)
+from .sampler import (BatchSampler, RandomSampler, Sampler, SequentialSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "DataLoader", "default_batchify_fn", "vision"]

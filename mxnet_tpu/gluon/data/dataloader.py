@@ -1,0 +1,96 @@
+"""Gluon DataLoader.
+
+Parity target: `python/mxnet/gluon/data/dataloader.py` — batchify
+(default_batchify_fn), multi-worker loading, pin_memory. The reference ships
+samples between processes via a shared-memory forking pickler over
+`cpu_shared` storage (:27-143); here workers are THREADS doing host-side
+numpy work (decode/augment release the GIL in numpy/PIL) and the final
+device_put happens once per batch — the idiomatic TPU host-input pipeline.
+A `num_workers>0` pool therefore still overlaps input processing with device
+compute without IPC copies.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    """parity: dataloader.py:DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=True):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch or 2 * max(self._num_workers, 1))
+        if batchify_fn is None:
+            batchify_fn = default_batchify_fn
+        self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            def load(batch):
+                return self._batchify_fn([self._dataset[idx] for idx in batch])
+
+            pending = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch):
+                    pending.append(pool.submit(load, next(it)))
+            except StopIteration:
+                pass
+            while pending:
+                fut = pending.pop(0)
+                try:
+                    pending.append(pool.submit(load, next(it)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

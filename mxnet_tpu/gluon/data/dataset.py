@@ -1,0 +1,128 @@
+"""Gluon datasets.
+
+Parity target: `python/mxnet/gluon/data/dataset.py` — Dataset, SimpleDataset,
+ArrayDataset, RecordFileDataset, transform/transform_first lazy wrappers.
+"""
+from __future__ import annotations
+
+from ...ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "_LazyTransformDataset"]
+
+
+class Dataset:
+    """parity: dataset.py:Dataset."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        from . import SimpleDataset as _SD
+
+        kept = []
+        for i in range(len(self)):
+            v = self[i]
+            if fn(v):
+                kept.append(v)
+        return _SD(kept)
+
+    def take(self, count):
+        from . import SimpleDataset as _SD
+
+        count = min(count, len(self))
+        return _SD([self[i] for i in range(count)])
+
+    def transform(self, fn, lazy=True):
+        """parity: dataset.py transform — lazy per-sample transform."""
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn, lazy=True):
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+
+class SimpleDataset(Dataset):
+    """Wrap any indexable (parity: dataset.py:SimpleDataset)."""
+
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _TransformFirstClosure:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays (parity: dataset.py:ArrayDataset)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, \
+                f"All arrays must have the same length; " \
+                f"array[0] has length {self._length} while array[{i}] has " \
+                f"length {len(data)}."
+            if isinstance(data, NDArray) and data.ndim == 1:
+                data = data.asnumpy()
+            self._data.append(data)
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(data[idx] for data in self._data)
+
+    def __len__(self):
+        return self._length
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over an indexed RecordIO file (parity:
+    dataset.py:RecordFileDataset)."""
+
+    def __init__(self, filename):
+        from ... import recordio
+
+        self._filename = filename
+        idx_file = filename[:filename.rfind(".")] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

@@ -1,0 +1,6 @@
+"""Vision data (parity: python/mxnet/gluon/data/vision/)."""
+from . import transforms
+from .datasets import *
+from .datasets import __all__ as _ds_all
+
+__all__ = ["transforms"] + list(_ds_all)

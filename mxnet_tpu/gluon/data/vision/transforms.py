@@ -1,0 +1,293 @@
+"""Vision transforms.
+
+Parity target: `python/mxnet/gluon/data/vision/transforms.py` — Compose,
+Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/Saturation/Hue/
+ColorJitter, RandomLighting — over the image ops (`src/operator/image/`).
+
+Transforms are Blocks so they compose into Datasets via transform_first and
+into HybridSequential pipelines.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import nn
+from ...block import Block, HybridBlock
+from .... import ndarray as nd
+from ....ndarray import NDArray
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomHue",
+           "RandomLighting", "ColorJitter"]
+
+
+class Compose(nn.Sequential):
+    """parity: transforms.py:Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.invoke("Cast", x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (parity: transforms.py:ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.invoke("Cast", x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW (parity: transforms.py:Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        # constant device arrays built ONCE, not per sample in the hot path
+        self._mean = nd.array(
+            _np.asarray(mean, dtype=_np.float32).reshape(-1, 1, 1))
+        self._std = nd.array(
+            _np.asarray(std, dtype=_np.float32).reshape(-1, 1, 1))
+
+    def hybrid_forward(self, F, x):
+        mean, std = self._mean, self._std
+        if x.ndim == 4:
+            mean = mean.expand_dims(0)
+            std = std.expand_dims(0)
+        return (x - mean) / std
+
+
+def _resize_hwc(img_np, size, interp="bilinear"):
+    """Bilinear resize on host numpy (decode/augment are host-side work)."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size  # reference convention: (width, height)
+    src_h, src_w = img_np.shape[:2]
+    ys = _np.linspace(0, src_h - 1, h)
+    xs = _np.linspace(0, src_w - 1, w)
+    y0 = _np.floor(ys).astype(int)
+    x0 = _np.floor(xs).astype(int)
+    y1 = _np.minimum(y0 + 1, src_h - 1)
+    x1 = _np.minimum(x0 + 1, src_w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img = img_np.astype(_np.float32)
+    out = (img[y0][:, x0] * (1 - wy) * (1 - wx)
+           + img[y0][:, x1] * (1 - wy) * wx
+           + img[y1][:, x0] * wy * (1 - wx)
+           + img[y1][:, x1] * wy * wx)
+    return out.astype(img_np.dtype)
+
+
+class Resize(Block):
+    """parity: transforms.py:Resize (HWC input)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        size = self._size
+        if self._keep and isinstance(self._size, int):
+            h, w = img.shape[:2]
+            if h < w:
+                size = (int(w * self._size / h), self._size)
+            else:
+                size = (self._size, int(h * self._size / w))
+        out = _resize_hwc(img, size)
+        return nd.array(out, dtype=out.dtype)
+
+
+class CenterCrop(Block):
+    """parity: transforms.py:CenterCrop."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        w, h = self._size
+        src_h, src_w = img.shape[:2]
+        if src_h < h or src_w < w:
+            img = _resize_hwc(img, (max(w, src_w), max(h, src_h)))
+            src_h, src_w = img.shape[:2]
+        y0 = (src_h - h) // 2
+        x0 = (src_w - w) // 2
+        return nd.array(img[y0:y0 + h, x0:x0 + w], dtype=img.dtype)
+
+
+class RandomResizedCrop(Block):
+    """parity: transforms.py:RandomResizedCrop."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        src_h, src_w = img.shape[:2]
+        area = src_h * src_w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            w = int(round(_np.sqrt(target_area * aspect)))
+            h = int(round(_np.sqrt(target_area / aspect)))
+            if w <= src_w and h <= src_h:
+                x0 = _np.random.randint(0, src_w - w + 1)
+                y0 = _np.random.randint(0, src_h - h + 1)
+                crop = img[y0:y0 + h, x0:x0 + w]
+                return nd.array(_resize_hwc(crop, self._size), dtype=img.dtype)
+        return CenterCrop(self._size).forward(nd.array(img, dtype=img.dtype))
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _np.random.rand() < self._p:
+            img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            return nd.array(img[:, ::-1].copy(), dtype=img.dtype)
+        return x if isinstance(x, NDArray) else nd.array(x)
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _np.random.rand() < self._p:
+            img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            return nd.array(img[::-1].copy(), dtype=img.dtype)
+        return x if isinstance(x, NDArray) else nd.array(x)
+
+
+class _RandomColor(Block):
+    def __init__(self, change):
+        super().__init__()
+        self._change = change
+
+    def _alpha(self):
+        return 1.0 + _np.random.uniform(-self._change, self._change)
+
+
+class RandomBrightness(_RandomColor):
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        out = _np.clip(img.astype(_np.float32) * self._alpha(), 0,
+                       255 if img.dtype == _np.uint8 else _np.inf)
+        return nd.array(out.astype(img.dtype), dtype=img.dtype)
+
+
+class RandomContrast(_RandomColor):
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        alpha = self._alpha()
+        gray = img.astype(_np.float32).mean()
+        out = _np.clip(img.astype(_np.float32) * alpha + gray * (1 - alpha), 0,
+                       255 if img.dtype == _np.uint8 else _np.inf)
+        return nd.array(out.astype(img.dtype), dtype=img.dtype)
+
+
+class RandomSaturation(_RandomColor):
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        alpha = self._alpha()
+        gray = img.astype(_np.float32).mean(axis=-1, keepdims=True)
+        out = _np.clip(img.astype(_np.float32) * alpha + gray * (1 - alpha), 0,
+                       255 if img.dtype == _np.uint8 else _np.inf)
+        return nd.array(out.astype(img.dtype), dtype=img.dtype)
+
+
+class RandomHue(_RandomColor):
+    """Rotate hue by U(-hue, hue) via the YIQ rotation matrix (parity:
+    src/operator/image/image_random-inl.h RandomHue)."""
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        alpha = _np.random.uniform(-self._change, self._change)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]])
+        tyiq = _np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]])
+        ityiq = _np.array([[1.0, 0.95617, 0.62143],
+                           [1.0, -0.27269, -0.64681],
+                           [1.0, -1.10744, 1.70062]])
+        t = ityiq @ bt @ tyiq
+        out = img.astype(_np.float32) @ t.T.astype(_np.float32)
+        if img.dtype == _np.uint8:
+            out = _np.clip(out, 0, 255)
+        return nd.array(out.astype(img.dtype), dtype=img.dtype)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (parity: transforms.py:RandomLighting)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148])
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]])
+
+    def __init__(self, alpha=0.1):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        alpha = _np.random.normal(0, self._alpha, 3)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        out = img.astype(_np.float32) + rgb
+        if img.dtype == _np.uint8:
+            out = _np.clip(out, 0, 255)
+        return nd.array(out.astype(img.dtype), dtype=img.dtype)
+
+
+class ColorJitter(Block):
+    """parity: transforms.py:RandomColorJitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = _np.random.permutation(len(self._transforms))
+        for i in order:
+            x = self._transforms[i].forward(x)
+        return x

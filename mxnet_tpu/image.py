@@ -1,0 +1,341 @@
+"""Image decode / augmentation utilities.
+
+Parity target: `python/mxnet/image/image.py` (pure-Python ImageIter +
+augmenters) and the C++ decode path (`src/io/image_recordio_2.cc` — OMP
+JPEG decode). Host-side decode uses PIL (libjpeg-turbo under the hood);
+augmented batches are shipped to device once per batch.
+"""
+from __future__ import annotations
+
+import io as _io
+import random as _pyrandom
+
+import numpy as _np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter",
+           "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image to an HWC uint8 NDArray (parity:
+    mx.image.imdecode)."""
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(buf if isinstance(buf, (bytes, bytearray))
+                                 else bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        arr = _np.asarray(img)[..., None]
+    else:
+        img = img.convert("RGB")
+        arr = _np.asarray(img)
+        if not to_rgb:
+            arr = arr[..., ::-1]  # BGR like OpenCV default
+    return nd.array(arr.copy(), dtype=_np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from .gluon.data.vision.transforms import _resize_hwc
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    return nd.array(_resize_hwc(arr, (w, h)), dtype=arr.dtype)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size` (parity: image.py resize_short)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = int((w - new_w) / 2)
+    y0 = int((h - new_h) / 2)
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = _pyrandom.randint(0, max(0, w - new_w))
+    y0 = _pyrandom.randint(0, max(0, h - new_h))
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    """parity: image.py Augmenter base."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy()
+            return nd.array(arr[:, ::-1].copy(), dtype=arr.dtype)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    """parity: image.py ColorNormalizeAug."""
+
+    def __init__(self, mean, std):
+        super().__init__(mean=None, std=None)
+        self.mean = nd.array(mean) if mean is not None and not isinstance(
+            mean, NDArray) else mean
+        self.std = nd.array(std) if std is not None and not isinstance(
+            std, NDArray) else std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class _JitterAug(Augmenter):
+    """Wrap a gluon vision transform as an image Augmenter."""
+
+    def __init__(self, transform, **kwargs):
+        super().__init__(**kwargs)
+        self._t = transform
+
+    def __call__(self, src):
+        return self._t(src)
+
+
+class RandomGrayAug(Augmenter):
+    """parity: image.py RandomGrayAug."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy().astype(_np.float32)
+            gray = arr @ _np.array([0.299, 0.587, 0.114], _np.float32)
+            out = _np.repeat(gray[..., None], 3, axis=-1)
+            return nd.array(out.astype(src.asnumpy().dtype))
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """parity: image.py CreateAugmenter — the standard augmentation list,
+    honouring every argument (resize/crop/mirror/color jitter/pca/gray/
+    normalize)."""
+    from .gluon.data.vision import transforms as T
+
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(_JitterAug(T.RandomResizedCrop(
+            (crop_size[0], crop_size[1]))))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(_JitterAug(T.ColorJitter(brightness, contrast,
+                                                saturation)))
+    if hue:
+        auglist.append(_JitterAug(T.RandomHue(hue)))
+    if pca_noise > 0:
+        auglist.append(_JitterAug(T.RandomLighting(pca_noise)))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Pure-python image iterator over .rec or .lst+folder (parity:
+    python/mxnet/image/image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, **kwargs):
+        from .io import DataBatch, DataDesc
+
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.imgrec = None
+        self.imglist = None
+        if path_imgrec:
+            from . import recordio
+
+            idx_path = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+            self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.imgrec.keys)
+        elif path_imglist:
+            self.imglist = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = _np.asarray(parts[1:-1], dtype=_np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        else:
+            raise ValueError("Either path_imgrec or path_imglist is required")
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            _pyrandom.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            from . import recordio
+
+            header, img_bytes = recordio.unpack(self.imgrec.read_idx(idx))
+            return header.label, imdecode(img_bytes)
+        label, fname = self.imglist[idx]
+        import os
+
+        return label, imread(os.path.join(self.path_root, fname))
+
+    def next(self):
+        from .io import DataBatch
+
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((self.batch_size, h, w, c), _np.float32)
+        batch_label = _np.zeros((self.batch_size, self.label_width), _np.float32)
+        i = 0
+        while i < self.batch_size:
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                break
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy()
+            if arr.shape[:2] != (h, w):
+                arr = imresize(nd.array(arr, dtype=arr.dtype), w, h).asnumpy()
+            batch_data[i] = arr.astype(_np.float32)
+            batch_label[i] = label
+            i += 1
+        data = nd.array(batch_data[:i].transpose(0, 3, 1, 2))
+        label = nd.array(batch_label[:i])
+        return DataBatch(data=[data], label=[label], pad=self.batch_size - i)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()

@@ -1,0 +1,6 @@
+"""Data IO (parity: python/mxnet/io/)."""
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, MNISTIter, CSVIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MNISTIter", "CSVIter"]

@@ -1,0 +1,427 @@
+"""Data iterators.
+
+Parity target: `python/mxnet/io/io.py:115-223` (DataIter/DataBatch/DataDesc/
+NDArrayIter/ResizeIter/PrefetchingIter) and the C++ registered iterators
+(`src/io/`): MNISTIter (`iter_mnist.cc:260`), CSVIter (`iter_mnist.cc:218`).
+
+TPU-native: the iterator yields host numpy-backed NDArrays; double-buffered
+device transfer (the reference's `iter_prefetcher.h`) is provided by
+PrefetchingIter running a background thread that stages `device_put` one
+batch ahead — the standard TPU input-pipeline overlap.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+from collections import namedtuple
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MNISTIter", "CSVIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """parity: io.py:DataDesc — name/shape/dtype/layout of one input."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """parity: io.py:DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return f"{self.__class__.__name__}: data shapes: {data_shapes} " \
+               f"label shapes: {label_shapes}"
+
+
+class DataIter:
+    """Base iterator (parity: io.py:DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Convert data into a canonical [(name, numpy)] list (parity:
+    io.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) <= 1:
+            data = {default_name: d for d in data} or {}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.py:NDArrayIter — pad/
+    discard/roll_over last-batch handling, shuffle)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label", rng=None):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self._rng = rng if rng is not None else _np.random
+        self.cursor = -batch_size
+        self._residual = _np.array([], dtype=self.idx.dtype)  # roll_over carry
+        self._order = self.idx
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            self._rng.shuffle(self.idx)
+        # roll_over: leftover samples from last epoch lead the new epoch
+        if self.last_batch_handle == "roll_over" and len(self._residual):
+            self._order = _np.concatenate([self._residual, self.idx])
+            self._residual = _np.array([], dtype=self.idx.dtype)
+        else:
+            self._order = self.idx
+        self.num_batch_data = len(self._order)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.cursor >= self.num_batch_data:
+            return False
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor + self.batch_size > self.num_batch_data:
+            # partial tail: carry to next epoch instead of yielding. COPY —
+            # a view of self.idx would be corrupted by reset()'s in-place
+            # shuffle
+            self._residual = self._order[self.cursor:].copy()
+            return False
+        return True
+
+    def _getdata(self, data_source):
+        end = self.cursor + self.batch_size
+        if end <= self.num_batch_data:
+            sel = self._order[self.cursor:end]
+            return [nd.array(v[sel], dtype=v.dtype) for _, v in data_source]
+        # final partial batch
+        if self.last_batch_handle == "discard":
+            return None
+        pad = end - self.num_batch_data
+        sel = _np.concatenate([self._order[self.cursor:], self._order[:pad]])
+        return [nd.array(v[sel], dtype=v.dtype) for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label) if self.label else []
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_batch_data:
+            return self.cursor + self.batch_size - self.num_batch_data
+        return 0
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        data = self.getdata()
+        if data is None:  # discard partial batch
+            raise StopIteration
+        return DataBatch(data=data, label=self.getlabel(), pad=self.getpad(),
+                         index=None, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (parity:
+    io.py:ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        for attr in ("provide_data", "provide_label", "default_bucket_key"):
+            if hasattr(data_iter, attr):
+                setattr(self, attr, getattr(data_iter, attr))
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (parity: io.py:PrefetchingIter /
+    `src/io/iter_prefetcher.h` double buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = iters[0].batch_size
+        self._lock = threading.Lock()
+        self._next_batches = [None] * self.n_iter
+        self._started = False
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r[x.name], str) else r[x.name]
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r[x.name], str) else r[x.name]
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _fetch(self):
+        def worker(i):
+            try:
+                self._next_batches[i] = self.iters[i].next()
+            except StopIteration:
+                self._next_batches[i] = None
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(self.n_iter)]
+        for t in threads:
+            t.start()
+        self._threads = threads
+
+    def _join(self):
+        for t in getattr(self, "_threads", []):
+            t.join()
+
+    def reset(self):
+        self._join()
+        for it in self.iters:
+            it.reset()
+        self._fetch()
+        self._started = True
+
+    def _advance(self):
+        """Collect the staged batch and stage the next one, or None at end."""
+        if not self._started:
+            self._fetch()
+            self._started = True
+        self._join()
+        batches = list(self._next_batches)
+        if any(b is None for b in batches):
+            assert all(b is None for b in batches), \
+                "Number of batches mismatch between iterators"
+            return None
+        self._fetch()  # stage the next batch while caller computes
+        if self.n_iter == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([(b.label or []) for b in batches], []),
+            pad=batches[0].pad)
+
+    def iter_next(self):
+        """Stage the next batch for retrieval by next()/getdata() (parity:
+        io.py PrefetchingIter — iter_next fills current_batch)."""
+        self.current_batch = self._advance()
+        return self.current_batch is not None
+
+    def next(self):
+        if getattr(self, "current_batch", None) is None:
+            if not self.iter_next():
+                raise StopIteration
+        batch, self.current_batch = self.current_batch, None
+        return batch
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _read_mnist_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad MNIST image magic {magic} in {path}"
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(num, rows, cols)
+
+
+def _read_mnist_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad MNIST label magic {magic} in {path}"
+        return _np.frombuffer(f.read(), dtype=_np.uint8)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST iterator (parity: `src/io/iter_mnist.cc:260` MXNET_REGISTER_IO_ITER
+    MNISTIter — reads the idx-format image/label files, optional flat)."""
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, seed=0,
+                 silent=False, num_parts=1, part_index=0, **kwargs):
+        images = _read_mnist_images(image).astype(_np.float32) / 255.0
+        labels = _read_mnist_labels(label).astype(_np.float32)
+        if num_parts > 1:  # data-parallel sharding (parity: num_parts/part_index)
+            images = images[part_index::num_parts]
+            labels = labels[part_index::num_parts]
+        if flat:
+            images = images.reshape(len(images), -1)
+        else:
+            images = images[:, None, :, :]  # NCHW
+        super().__init__(images, labels, batch_size=batch_size, shuffle=shuffle,
+                         last_batch_handle="discard",
+                         data_name="data", label_name="label",
+                         rng=_np.random.RandomState(seed))
+
+
+class CSVIter(NDArrayIter):
+    """CSV iterator (parity: `src/io/iter_mnist.cc:218` CSVIter)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=128, round_batch=True, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch else "discard")

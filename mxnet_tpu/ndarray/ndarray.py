@@ -52,6 +52,13 @@ def _jax_put(value, ctx: Context | None, dtype=None):
         dtype = canonical_dtype(dtype)
     if ctx is None:
         ctx = current_context()
+    if not jax.config.jax_enable_x64:
+        # silent-by-contract 64->32 narrowing (jax would warn per call)
+        req = dtype if dtype is not None else getattr(value, "dtype", None)
+        if req is not None and _np.dtype(req) in (_np.dtype(_np.int64),
+                                                  _np.dtype(_np.float64)):
+            dtype = _np.dtype(_np.int32) if _np.dtype(req).kind == "i" \
+                else _np.dtype(_np.float32)
     arr = jnp.asarray(value, dtype=dtype)
     return jax.device_put(arr, ctx.jax_device())
 

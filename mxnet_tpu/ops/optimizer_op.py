@@ -166,6 +166,34 @@ def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5, wd=0.
     return weight - delta, acc_g_new, acc_delta_new
 
 
+@register("lars_sgd_update")
+def lars_sgd_update(weight, grad, lr=0.01, eta=0.001, epsilon=1e-8, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    """LARS scaling + SGD in ONE executable — norms computed on device
+    (parity: optimizer.py LARS, without the reference's host round trip)."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(weight)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    lars_lr = jnp.where((w_norm > 0) & (g_norm > 0),
+                        lr * eta * w_norm / (g_norm + wd * w_norm + epsilon),
+                        lr)
+    return weight - lars_lr * (g + wd * weight)
+
+
+@register("lars_sgd_mom_update", num_outputs=2)
+def lars_sgd_mom_update(weight, grad, mom, lr=0.01, eta=0.001, epsilon=1e-8,
+                        momentum=0.0, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(weight)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    lars_lr = jnp.where((w_norm > 0) & (g_norm > 0),
+                        lr * eta * w_norm / (g_norm + wd * w_norm + epsilon),
+                        lr)
+    mom_new = momentum * mom - lars_lr * (g + wd * weight)
+    return weight + mom_new, mom_new
+
+
 @register("lamb_update_phase1", num_outputs=3)
 def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,

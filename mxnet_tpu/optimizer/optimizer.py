@@ -111,10 +111,11 @@ class Optimizer:
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
+        """parity: optimizer.py set_wd_mult — only *_weight and *_gamma
+        receive weight decay by default."""
         self.wd_mult = {}
         for n in self.idx2name.values():
-            is_weight = n.endswith("_weight")
-            if not is_weight:
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
                 self.wd_mult[n] = 0.0
         self.wd_mult.update(args_wd_mult)
 
@@ -323,23 +324,18 @@ class LARS(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        w_norm = float(weight.norm().asscalar())
-        g = grad * self.rescale_grad
-        if self.clip_gradient:
-            g = g.clip(-self.clip_gradient, self.clip_gradient)
-        g_norm = float(g.norm().asscalar())
-        if w_norm > 0 and g_norm > 0:
-            lars_lr = lr * self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
-        else:
-            lars_lr = lr
-        kwargs = {"lr": lars_lr, "wd": wd, "rescale_grad": self.rescale_grad,
+        # layerwise scaling fused into the update executable — no host
+        # norm round trips (2 blocking syncs/param/step in the naive form)
+        kwargs = {"lr": lr, "eta": self.eta, "epsilon": self.epsilon,
+                  "wd": wd, "rescale_grad": self.rescale_grad,
                   "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0}
         if state is not None:
-            (mom,) = _invoke_update("sgd_mom_update", weight, [grad, state],
+            (mom,) = _invoke_update("lars_sgd_mom_update", weight,
+                                    [grad, state],
                                     {**kwargs, "momentum": self.momentum})
             state._rebind(mom._data)
         else:
-            _invoke_update("sgd_update", weight, [grad], kwargs)
+            _invoke_update("lars_sgd_update", weight, [grad], kwargs)
 
 
 @register

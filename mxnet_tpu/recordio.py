@@ -1,0 +1,224 @@
+"""RecordIO: the reference's packed-record file format.
+
+Parity target: `python/mxnet/recordio.py` (508 LoC) + dmlc-core's seekable
+record format (`src/io/image_recordio.h`). The on-disk format is kept
+BINARY-COMPATIBLE with the reference so existing `.rec`/`.idx` datasets
+(packed by tools/im2rec) load unchanged:
+
+  record  := magic(4B) | lrecord(4B) | data | pad-to-4B
+  magic   = 0xced7230a
+  lrecord = cflag(3 bits) << 29 | length(29 bits)   (cflag 0 = complete)
+  IRHeader := flag(u32) label(f32|f32[flag]) id(u64) id2(u64)   ('IfQQ')
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LREC_BITS = 29
+_CFLAG_MASK = (1 << _LREC_BITS) - 1
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (parity: recordio.py:MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.record is not None
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["is_open"] = is_open
+        d.pop("_lock", None)  # locks are not picklable; recreated by open()
+        return d
+
+    def __setstate__(self, d):
+        is_open = d.pop("is_open", False)
+        self.__dict__.update(d)
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        """Forked DataLoader workers must reopen their own handle (parity:
+        recordio.py _check_pid)."""
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in multiple processes")
+
+    def close(self):
+        if self.record is not None and not self.record.closed:
+            self.record.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Append one record."""
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        length = len(buf)
+        assert length <= _CFLAG_MASK, "record too large"
+        self.record.write(struct.pack("<II", _MAGIC, length))
+        self.record.write(buf)
+        pad = (-length) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        """Read the next record, or None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        assert magic == _MAGIC, f"corrupt record file {self.uri}"
+        length = lrec & _CFLAG_MASK
+        buf = self.record.read(length)
+        pad = (-length) % 4
+        if pad:
+            self.record.read(pad)
+        return buf
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via an .idx file of `key\\toffset` lines
+    (parity: recordio.py:MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        import threading
+
+        super().open()
+        # seek+read must be atomic: the thread-pool DataLoader shares this
+        # handle across workers (the reference forks processes instead)
+        self._lock = threading.Lock()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.exists(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        with self._lock:
+            self.seek(idx)
+            return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload into a record body (parity: recordio.py
+    pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                             header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                             header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    """Unpack a record body into (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 image and pack it (parity: recordio.py pack_img)."""
+    import io as _io
+
+    from PIL import Image
+
+    arr = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
+    pil = Image.fromarray(arr.astype(np.uint8))
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a record and decode the image (parity: recordio.py unpack_img)."""
+    from . import image as img_mod
+
+    header, img_bytes = unpack(s)
+    return header, img_mod.imdecode(img_bytes, flag=iscolor, to_rgb=True)

@@ -1,0 +1,282 @@
+"""IO + gluon.data tests (parity model: tests/python/unittest/test_io.py,
+test_gluon_data.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter, PrefetchingIter, ResizeIter
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, SequentialSampler,
+                                  SimpleDataset)
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), data[:5])
+    # reset + iterate again
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad_discard():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(40).reshape(40, 1).astype(np.float32)
+    it = NDArrayIter(data, batch_size=10, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(40))
+
+
+def test_ndarray_iter_dict_multi_input():
+    it = NDArrayIter({"a": np.zeros((10, 2)), "b": np.ones((10, 3))},
+                     batch_size=5)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), np.float32)
+    base = NDArrayIter(data, batch_size=5)
+    it = ResizeIter(base, size=7)
+    assert len(list(it)) == 7  # wraps around
+
+
+def test_prefetching_iter():
+    data = np.arange(20).reshape(20, 1).astype(np.float32)
+    it = PrefetchingIter(NDArrayIter(data, batch_size=5))
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_mnist_iter_from_files(tmp_path):
+    """Write idx-format files and read via MNISTIter (parity:
+    src/io/iter_mnist.cc)."""
+    imgs = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
+    labels = np.random.randint(0, 10, 50).astype(np.uint8)
+    img_path = str(tmp_path / "train-images-idx3-ubyte")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 50, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 50))
+        f.write(labels.tobytes())
+    from mxnet_tpu.io import MNISTIter
+
+    it = MNISTIter(image=img_path, label=lbl_path, batch_size=10, shuffle=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (10, 1, 28, 28)
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               imgs[:10, None] / 255.0, rtol=1e-5)
+    flat = MNISTIter(image=img_path, label=lbl_path, batch_size=10, flat=True,
+                     shuffle=False)
+    assert next(iter(flat)).data[0].shape == (10, 784)
+    # data-parallel sharding
+    part = MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                     num_parts=2, part_index=0, shuffle=False)
+    assert part.num_data == 25
+
+
+def test_datasets_and_samplers():
+    ds = SimpleDataset(list(range(10)))
+    assert len(ds) == 10 and ds[3] == 3
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    pairs = ArrayDataset(np.arange(10), np.arange(10) * 10)
+    x, y = pairs[2]
+    assert x == 2 and y == 20
+    tf = pairs.transform_first(lambda x: x + 100)
+    x, y = tf[2]
+    assert x == 102 and y == 20
+
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = BatchSampler(SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = BatchSampler(SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    assert len(bs) == 2
+
+
+def test_dataloader():
+    x = np.random.rand(20, 3).astype(np.float32)
+    y = np.arange(20).astype(np.float32)
+    ds = ArrayDataset(x, y)
+    for workers in (0, 2):
+        loader = DataLoader(ds, batch_size=6, last_batch="keep",
+                            num_workers=workers)
+        batches = list(loader)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert xb.shape == (6, 3)
+        assert yb.shape == (6,)
+        total = np.concatenate([b[1].asnumpy() for b in batches])
+        assert sorted(total.tolist()) == list(range(20))
+    assert len(loader) == 4
+
+
+def test_dataloader_shuffle_batchify():
+    ds = SimpleDataset([(np.full((2, 2), i, np.float32), i) for i in range(12)])
+    loader = DataLoader(ds, batch_size=4, shuffle=True)
+    xs, ys = zip(*list(loader))
+    labels = np.concatenate([y.asnumpy() for y in ys])
+    assert sorted(labels.tolist()) == list(range(12))
+    assert xs[0].shape == (4, 2, 2)
+
+
+def test_transforms():
+    img = (np.random.rand(10, 8, 3) * 255).astype(np.uint8)
+    x = mx.nd.array(img, dtype=np.uint8)
+    out = transforms.ToTensor()(x)
+    assert out.shape == (3, 10, 8)
+    assert out.dtype == np.float32
+    assert float(out.max().asscalar()) <= 1.0
+
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(2, 2, 2))
+    normed = norm(out)
+    np.testing.assert_allclose(normed.asnumpy(),
+                               (out.asnumpy() - 0.5) / 2, rtol=1e-5)
+
+    resized = transforms.Resize((4, 6))(x)  # (w=4, h=6)
+    assert resized.shape == (6, 4, 3)
+    cropped = transforms.CenterCrop((4, 6))(x)
+    assert cropped.shape == (6, 4, 3)
+    rrc = transforms.RandomResizedCrop(4)(x)
+    assert rrc.shape == (4, 4, 3)
+
+    comp = transforms.Compose([transforms.ToTensor(),
+                               transforms.Normalize(0.5, 0.5)])
+    assert comp(x).shape == (3, 10, 8)
+
+    flipped = transforms.RandomFlipLeftRight(p=1.0)(x)
+    np.testing.assert_array_equal(flipped.asnumpy(), img[:, ::-1])
+
+    bright = transforms.RandomBrightness(0.5)(x)
+    assert bright.shape == img.shape
+
+
+def test_dataset_with_dataloader_transform():
+    imgs = [(np.random.rand(8, 8, 3) * 255).astype(np.uint8) for _ in range(8)]
+    ds = SimpleDataset([(img, i) for i, img in enumerate(imgs)])
+    ds = ds.transform_first(lambda im: transforms.ToTensor()(mx.nd.array(im, dtype=np.uint8)))
+    loader = DataLoader(ds, batch_size=4)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (4, 3, 8, 8)
+
+
+def test_roll_over():
+    """roll_over carries the partial tail into the next epoch (parity:
+    io.py NDArrayIter last_batch_handle)."""
+    data = np.arange(23).reshape(23, 1).astype(np.float32)
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="roll_over")
+    ep1 = list(it)
+    assert len(ep1) == 4  # 20 samples, 3 left over
+    it.reset()
+    ep2 = list(it)
+    assert len(ep2) == 5  # 3 carried + 23 = 26 -> 5 full batches
+    first = ep2[0].data[0].asnumpy().ravel()
+    np.testing.assert_array_equal(first[:3], [20, 21, 22])  # carried samples
+
+
+def test_prefetching_iter_protocol():
+    data = np.arange(20).reshape(20, 1).astype(np.float32)
+    it = PrefetchingIter(NDArrayIter(data, batch_size=5))
+    count = 0
+    while it.iter_next():
+        assert it.getdata()[0].shape == (5, 1)
+        count += 1
+    assert count == 4
+
+
+def test_mnist_seed_reproducible(tmp_path):
+    imgs = (np.random.rand(30, 28, 28) * 255).astype(np.uint8)
+    labels = np.random.randint(0, 10, 30).astype(np.uint8)
+    img_path = str(tmp_path / "img")
+    lbl_path = str(tmp_path / "lbl")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 30, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 30))
+        f.write(labels.tobytes())
+    from mxnet_tpu.io import MNISTIter
+
+    a = next(iter(MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                            shuffle=True, seed=3))).label[0].asnumpy()
+    b = next(iter(MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                            shuffle=True, seed=3))).label[0].asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_recordio_roundtrip(tmp_path):
+    """RecordIO format round trip (parity: python/mxnet/recordio.py)."""
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    payloads = []
+    for i in range(5):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        body = recordio.pack(header, bytes([i] * (i + 1)))
+        payloads.append(bytes([i] * (i + 1)))
+        w.write_idx(i, body)
+    w.close()
+
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r.keys == [0, 1, 2, 3, 4]
+    for i in [3, 0, 4]:
+        header, content = recordio.unpack(r.read_idx(i))
+        assert header.label == float(i)
+        assert content == payloads[i]
+    # sequential read
+    r2 = recordio.MXRecordIO(rec_path, "r")
+    n = 0
+    while r2.read() is not None:
+        n += 1
+    assert n == 5
+
+
+def test_image_module(tmp_path):
+    """imdecode/imresize + pack_img round trip."""
+    from mxnet_tpu import image as img_mod, recordio
+
+    arr = (np.random.rand(12, 10, 3) * 255).astype(np.uint8)
+    body = recordio.pack_img(recordio.IRHeader(0, 7.0, 0, 0), arr,
+                             img_fmt=".png")
+    header, decoded = recordio.unpack_img(body)
+    assert header.label == 7.0
+    np.testing.assert_array_equal(decoded.asnumpy(), arr)  # png lossless
+    resized = img_mod.imresize(mx.nd.array(arr, dtype=np.uint8), 5, 6)
+    assert resized.shape == (6, 5, 3)
+    short = img_mod.resize_short(mx.nd.array(arr, dtype=np.uint8), 5)
+    assert min(short.shape[:2]) == 5
+
+
+def test_hue_jitter():
+    img = mx.nd.array((np.random.rand(8, 8, 3) * 255).astype(np.uint8),
+                      dtype=np.uint8)
+    out = transforms.RandomHue(0.5)(img)
+    assert out.shape == (8, 8, 3)
+    jitter = transforms.ColorJitter(brightness=0.1, hue=0.3)
+    assert len(jitter._transforms) == 2
