@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 batched inference throughput on one chip.
+
+Reference baseline (BASELINE.md / docs perf.md:196): ResNet-50 bs=128 fp32
+inference = 1233.15 img/s on 1x V100 (measured via
+example/image-classification/benchmark_score.py). This reproduces that
+benchmark's methodology — hybridized (compiled) scoring, batch 128, timed
+over repeated batches after warmup — on the TPU chip, in bfloat16 (the MXU's
+native input type; the fp16-on-V100 analogue is 2355.04 img/s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
+BENCH_ITERS, BENCH_MODEL.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    model = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    baseline = 1233.15  # ResNet-50 bs=128 fp32 on V100 (perf.md:196)
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    net = vision.get_model(model, classes=1000)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if dtype != "float32":
+        net.cast(dtype)
+    net.hybridize(static_alloc=True, static_shape=True)
+
+    x = mx.nd.random.uniform(shape=(batch, 3, 224, 224), ctx=ctx)
+    if dtype != "float32":
+        x = x.astype(dtype)
+
+    # warmup: trigger deferred init (eager) + compile (first hybrid call)
+    net(x).wait_to_read()
+    net(x).wait_to_read()
+
+    start = time.perf_counter()
+    outs = []
+    for _ in range(iters):
+        outs.append(net(x))
+    outs[-1].wait_to_read()
+    elapsed = time.perf_counter() - start
+    throughput = batch * iters / elapsed
+
+    print(json.dumps({
+        "metric": f"{model}_infer_bs{batch}_{dtype}",
+        "value": round(throughput, 2),
+        "unit": "img/s",
+        "vs_baseline": round(throughput / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

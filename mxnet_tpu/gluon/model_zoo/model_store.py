@@ -1,0 +1,39 @@
+"""Pretrained-weight store.
+
+Parity target: `python/mxnet/gluon/model_zoo/model_store.py` — downloads
+pretrained `.params` by (name, sha1) into `~/.mxnet/models`.
+
+This environment has no network egress, so weights are served from a local
+root directory only; `get_model_file` resolves `<root>/<name>.params` and
+errors with instructions otherwise. Checkpoints saved by this framework's
+`save_parameters` load directly.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "load_pretrained", "purge"]
+
+
+def get_model_file(name, root=None):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    path = os.path.join(root, f"{name}.params")
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        f"Pretrained weights for {name!r} not found at {path}. Network "
+        "download is unavailable in this environment; place a .params file "
+        "(saved via save_parameters) at that path.")
+
+
+def load_pretrained(net, name, ctx=None, root=None):
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net
+
+
+def purge(root=None):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,41 @@
+"""Model zoo (parity: python/mxnet/gluon/model_zoo/vision/__init__.py:112
+get_model registry: alexnet, densenet, inception-v3, resnet v1/v2 18-152,
+squeezenet, vgg(+bn), mobilenet v1/v2)."""
+# submodule imports must precede star imports: `alexnet` etc. are both a
+# module and a factory function name, and the function must win in this
+# namespace (as in the reference)
+from . import alexnet as _a
+from . import densenet as _d
+from . import inception as _i
+from . import mobilenet as _m
+from . import resnet as _r
+from . import squeezenet as _s
+from . import vgg as _v
+
+_models = {}
+for _mod in (_a, _d, _i, _m, _r, _s, _v):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower() \
+                and not _name.startswith("get_"):
+            _models[_name] = _obj
+
+from .alexnet import *
+from .densenet import *
+from .inception import *
+from .mobilenet import *
+from .resnet import *
+from .squeezenet import *
+from .vgg import *
+
+
+def get_model(name, **kwargs):
+    """parity: vision/__init__.py get_model — create by registry name."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"Model {name!r} is not supported. Available: {sorted(_models)}")
+    return _models[name](**kwargs)
+
+
+__all__ = ["get_model"] + sorted(_models)

@@ -2,8 +2,11 @@
 
 Parity target: `python/mxnet/image/image.py` (pure-Python ImageIter +
 augmenters) and the C++ decode path (`src/io/image_recordio_2.cc` — OMP
-JPEG decode). Host-side decode uses PIL (libjpeg-turbo under the hood);
-augmented batches are shipped to device once per batch.
+JPEG decode). Host-side decode uses PIL (libjpeg-turbo under the hood).
+
+Augmentation runs numpy-native: every helper/augmenter is polymorphic
+(NDArray in -> NDArray out for API parity; numpy in -> numpy out), and the
+batch pipeline stays on host until ONE device transfer per assembled batch.
 """
 from __future__ import annotations
 
@@ -18,12 +21,22 @@ from .ndarray import NDArray
 __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "ImageIter",
            "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
-           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug"]
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "RandomGrayAug"]
 
 
-def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an encoded image to an HWC uint8 NDArray (parity:
-    mx.image.imdecode)."""
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+
+
+def _like(src, out_np):
+    """Return out_np as the same container type as src."""
+    if isinstance(src, NDArray):
+        return nd.array(out_np, dtype=out_np.dtype)
+    return out_np
+
+
+def _decode_np(buf, flag=1, to_rgb=True):
     from PIL import Image
 
     img = Image.open(_io.BytesIO(buf if isinstance(buf, (bytes, bytearray))
@@ -36,7 +49,13 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         arr = _np.asarray(img)
         if not to_rgb:
             arr = arr[..., ::-1]  # BGR like OpenCV default
-    return nd.array(arr.copy(), dtype=_np.uint8)
+    return arr.copy()
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image to an HWC uint8 NDArray (parity:
+    mx.image.imdecode)."""
+    return nd.array(_decode_np(buf, flag, to_rgb), dtype=_np.uint8)
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -44,56 +63,72 @@ def imread(filename, flag=1, to_rgb=True):
         return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
 
 
-def imresize(src, w, h, interp=1):
+def _resize_np(arr, w, h):
     from .gluon.data.vision.transforms import _resize_hwc
 
-    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
-    return nd.array(_resize_hwc(arr, (w, h)), dtype=arr.dtype)
+    return _resize_hwc(arr, (w, h))
+
+
+def imresize(src, w, h, interp=1):
+    return _like(src, _resize_np(_to_np(src), w, h))
 
 
 def resize_short(src, size, interp=2):
     """Resize shorter edge to `size` (parity: image.py resize_short)."""
-    h, w = src.shape[:2]
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
     if h > w:
         new_w, new_h = size, int(size * h / w)
     else:
         new_w, new_h = int(size * w / h), size
-    return imresize(src, new_w, new_h, interp)
+    return _like(src, _resize_np(arr, new_w, new_h))
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
-    out = src[y0:y0 + h, x0:x0 + w]
+    arr = _to_np(src)[y0:y0 + h, x0:x0 + w]
     if size is not None and (w, h) != size:
-        out = imresize(out, size[0], size[1], interp)
+        arr = _resize_np(arr, size[0], size[1])
+    return _like(src, arr)
+
+
+def _crop_np(arr, x0, y0, w, h, size=None):
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize_np(out, size[0], size[1])
     return out
 
 
 def center_crop(src, size, interp=2):
-    h, w = src.shape[:2]
+    arr = _to_np(src)  # converted once; crop on the numpy view
+    h, w = arr.shape[:2]
     new_w, new_h = size
     x0 = int((w - new_w) / 2)
     y0 = int((h - new_h) / 2)
-    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+    return _like(src, _crop_np(arr, x0, y0, new_w, new_h)), \
+        (x0, y0, new_w, new_h)
 
 
 def random_crop(src, size, interp=2):
-    h, w = src.shape[:2]
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
     new_w, new_h = size
     x0 = _pyrandom.randint(0, max(0, w - new_w))
     y0 = _pyrandom.randint(0, max(0, h - new_h))
-    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+    return _like(src, _crop_np(arr, x0, y0, new_w, new_h)), \
+        (x0, y0, new_w, new_h)
 
 
 def color_normalize(src, mean, std=None):
+    arr = _to_np(src).astype(_np.float32)
     if mean is not None:
-        src = src - mean
+        arr = arr - _to_np(mean)
     if std is not None:
-        src = src / std
-    return src
+        arr = arr / _to_np(std)
+    return _like(src, arr)
 
 
 class Augmenter:
-    """parity: image.py Augmenter base."""
+    """parity: image.py Augmenter base. Polymorphic: numpy in -> numpy out."""
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
@@ -101,7 +136,10 @@ class Augmenter:
     def dumps(self):
         import json
 
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        return json.dumps([self.__class__.__name__.lower(),
+                           {k: v for k, v in self._kwargs.items()
+                            if isinstance(v, (int, float, str, list, tuple,
+                                              bool, type(None)))}])
 
     def __call__(self, src):
         raise NotImplementedError
@@ -154,8 +192,7 @@ class HorizontalFlipAug(Augmenter):
 
     def __call__(self, src):
         if _pyrandom.random() < self.p:
-            arr = src.asnumpy()
-            return nd.array(arr[:, ::-1].copy(), dtype=arr.dtype)
+            return _like(src, _to_np(src)[:, ::-1].copy())
         return src
 
 
@@ -165,32 +202,23 @@ class CastAug(Augmenter):
         self.typ = typ
 
     def __call__(self, src):
-        return src.astype(self.typ)
+        if isinstance(src, NDArray):
+            return src.astype(self.typ)
+        return _np.asarray(src, dtype=self.typ)
 
 
 class ColorNormalizeAug(Augmenter):
     """parity: image.py ColorNormalizeAug."""
 
     def __init__(self, mean, std):
-        super().__init__(mean=None, std=None)
-        self.mean = nd.array(mean) if mean is not None and not isinstance(
-            mean, NDArray) else mean
-        self.std = nd.array(std) if std is not None and not isinstance(
-            std, NDArray) else std
+        super().__init__()
+        self.mean = None if mean is None else _np.asarray(_to_np(mean),
+                                                          _np.float32)
+        self.std = None if std is None else _np.asarray(_to_np(std),
+                                                        _np.float32)
 
     def __call__(self, src):
         return color_normalize(src, self.mean, self.std)
-
-
-class _JitterAug(Augmenter):
-    """Wrap a gluon vision transform as an image Augmenter."""
-
-    def __init__(self, transform, **kwargs):
-        super().__init__(**kwargs)
-        self._t = transform
-
-    def __call__(self, src):
-        return self._t(src)
 
 
 class RandomGrayAug(Augmenter):
@@ -202,11 +230,25 @@ class RandomGrayAug(Augmenter):
 
     def __call__(self, src):
         if _pyrandom.random() < self.p:
-            arr = src.asnumpy().astype(_np.float32)
-            gray = arr @ _np.array([0.299, 0.587, 0.114], _np.float32)
-            out = _np.repeat(gray[..., None], 3, axis=-1)
-            return nd.array(out.astype(src.asnumpy().dtype))
+            arr = _to_np(src)
+            gray = arr.astype(_np.float32) @ _np.array([0.299, 0.587, 0.114],
+                                                       _np.float32)
+            return _like(src, _np.repeat(gray[..., None], 3,
+                                         axis=-1).astype(arr.dtype))
         return src
+
+
+class _JitterAug(Augmenter):
+    """Wrap a gluon vision transform as an image Augmenter (numpy-safe)."""
+
+    def __init__(self, transform, **kwargs):
+        super().__init__(**kwargs)
+        self._t = transform
+
+    def __call__(self, src):
+        out = self._t(nd.array(_to_np(src)) if not isinstance(src, NDArray)
+                      else src)
+        return _to_np(out) if not isinstance(src, NDArray) else out
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
@@ -253,12 +295,19 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
 
 class ImageIter:
     """Pure-python image iterator over .rec or .lst+folder (parity:
-    python/mxnet/image/image.py ImageIter)."""
+    python/mxnet/image/image.py ImageIter).
+
+    The final partial batch is padded to full batch_size with wrapped
+    samples and `pad` reports the filler count, exactly like the reference
+    — so batch shape is constant (no XLA recompilation on the last batch)
+    and pad-aware consumers can slice filler off.
+    """
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
-                 shuffle=False, aug_list=None, **kwargs):
-        from .io import DataBatch, DataDesc
+                 shuffle=False, aug_list=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        from .io import DataDesc
 
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
@@ -266,6 +315,11 @@ class ImageIter:
         self._shuffle = shuffle
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape)
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape,
+                                      _np.float32)]
+        self.provide_label = [DataDesc(label_name, (batch_size, label_width),
+                                       _np.float32)]
         self.imgrec = None
         self.imglist = None
         if path_imgrec:
@@ -294,6 +348,7 @@ class ImageIter:
         self.cur = 0
 
     def next_sample(self):
+        """Return (label, numpy HWC image) for the next sample."""
         if self.cur >= len(self.seq):
             raise StopIteration
         idx = self.seq[self.cur]
@@ -302,11 +357,12 @@ class ImageIter:
             from . import recordio
 
             header, img_bytes = recordio.unpack(self.imgrec.read_idx(idx))
-            return header.label, imdecode(img_bytes)
+            return header.label, _decode_np(img_bytes)
         label, fname = self.imglist[idx]
         import os
 
-        return label, imread(os.path.join(self.path_root, fname))
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, _decode_np(f.read())
 
     def next(self):
         from .io import DataBatch
@@ -317,22 +373,30 @@ class ImageIter:
         i = 0
         while i < self.batch_size:
             try:
-                label, img = self.next_sample()
+                label, arr = self.next_sample()
             except StopIteration:
                 if i == 0:
                     raise
                 break
             for aug in self.auglist:
-                img = aug(img)
-            arr = img.asnumpy()
+                arr = aug(arr)
+            arr = _to_np(arr)
             if arr.shape[:2] != (h, w):
-                arr = imresize(nd.array(arr, dtype=arr.dtype), w, h).asnumpy()
+                arr = _resize_np(arr, w, h)
             batch_data[i] = arr.astype(_np.float32)
             batch_label[i] = label
             i += 1
-        data = nd.array(batch_data[:i].transpose(0, 3, 1, 2))
-        label = nd.array(batch_label[:i])
-        return DataBatch(data=[data], label=[label], pad=self.batch_size - i)
+        pad = self.batch_size - i
+        if pad:  # wrap-pad to keep a constant batch shape (ref semantics)
+            for j in range(pad):
+                batch_data[i + j] = batch_data[j % max(i, 1)]
+                batch_label[i + j] = batch_label[j % max(i, 1)]
+        # ONE device transfer for the whole batch
+        data = nd.array(batch_data.transpose(0, 3, 1, 2))
+        label = nd.array(batch_label)
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
     def __iter__(self):
         return self

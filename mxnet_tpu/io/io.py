@@ -310,6 +310,8 @@ class PrefetchingIter(DataIter):
                 self._next_batches[i] = self.iters[i].next()
             except StopIteration:
                 self._next_batches[i] = None
+            except BaseException as e:  # surface at next sync, don't hang
+                self._next_batches[i] = e
 
         threads = [threading.Thread(target=worker, args=(i,))
                    for i in range(self.n_iter)]
@@ -335,6 +337,11 @@ class PrefetchingIter(DataIter):
             self._started = True
         self._join()
         batches = list(self._next_batches)
+        for b in batches:
+            if isinstance(b, BaseException):
+                # deferred worker error (parity: engine exceptions surface
+                # at the next sync point)
+                raise b
         if any(b is None for b in batches):
             assert all(b is None for b in batches), \
                 "Number of batches mismatch between iterators"

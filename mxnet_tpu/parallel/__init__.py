@@ -1,0 +1,32 @@
+"""Parallelism layer: device meshes + sharded compiled training steps.
+
+Role parity: this subsumes the reference's multi-device execution stack —
+`DataParallelExecutorGroup` (`python/mxnet/module/executor_group.py:144`,
+batch split `decide_slices` :282), KVStore `device` gradient reduction
+(`src/kvstore/comm.h:503` merge-buffer + ElementwiseSum), and the
+`group2ctx` model-parallel placement (`src/executor/graph_executor.cc:1044`).
+
+TPU-native design (the scaling-book recipe): pick a Mesh, annotate
+shardings, let XLA insert collectives.
+
+  * `DeviceMesh` — named axes over `jax.devices()`: dp (data), tp (tensor),
+    pp (pipeline stages), sp (sequence/context). The reference's per-GPU
+    executor list becomes ONE jitted computation laid out over the mesh.
+  * sharding rules — per-parameter PartitionSpecs (replicated under dp;
+    split output/input dims under tp), the GSPMD analogue of `group2ctx`.
+  * `ShardedTrainer` — the whole training step (forward, loss, backward,
+    optimizer update, BatchNorm stat update) compiled into ONE XLA
+    executable with donated parameter buffers. Cross-device gradient
+    reduction is emitted by XLA as all-reduces over ICI — replacing
+    kvstore 'device' mode's copy-to-merge-buffer/ElementwiseSum/broadcast
+    round trip (`src/kvstore/kvstore_local.h:239`).
+
+Single-chip users win too: the per-step Python/dispatch overhead of the
+imperative Trainer collapses into one executable launch.
+"""
+from __future__ import annotations
+
+from .mesh import DeviceMesh, current_mesh
+from .sharded_trainer import ShardedTrainer, sharding_rules
+
+__all__ = ["DeviceMesh", "current_mesh", "ShardedTrainer", "sharding_rules"]

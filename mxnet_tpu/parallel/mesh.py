@@ -1,0 +1,98 @@
+"""Device mesh management.
+
+The mesh replaces the reference's explicit device lists (`ctx=[mx.gpu(i) for
+i in ...]` handed to Module/Trainer). Axis names follow the scaling-book
+convention: dp (data), tp (tensor/model), pp (pipeline), sp (sequence/
+context), ep (experts). Unused axes have size 1 so sharding rules can always
+reference them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+__all__ = ["DeviceMesh", "current_mesh"]
+
+_tls = threading.local()
+
+AXIS_ORDER = ("dp", "pp", "tp", "sp", "ep")
+
+
+class DeviceMesh:
+    """A named-axis mesh over jax devices.
+
+    Examples
+    --------
+    DeviceMesh()                      # all devices on the dp axis
+    DeviceMesh({"dp": 4, "tp": 2})    # 8 devices, 4-way data x 2-way tensor
+    """
+
+    def __init__(self, axes: Optional[Dict[str, int]] = None, devices=None):
+        import jax
+        import numpy as np
+
+        if devices is None:
+            devices = jax.devices()
+        self.devices = list(devices)
+        n = len(self.devices)
+        if axes is None:
+            axes = {"dp": n}
+        sizes = dict(axes)
+        prod = 1
+        for v in sizes.values():
+            prod *= v
+        if prod > n:
+            raise ValueError(
+                f"mesh axes {sizes} require {prod} devices, have {n}")
+        self.devices = self.devices[:prod]  # smaller meshes use a prefix
+        # canonical axis order so PartitionSpecs are stable
+        self.axis_names = tuple(a for a in AXIS_ORDER if a in sizes) + tuple(
+            a for a in sizes if a not in AXIS_ORDER)
+        self.axis_sizes = {a: sizes[a] for a in self.axis_names}
+        shape = tuple(self.axis_sizes[a] for a in self.axis_names)
+        dev_array = np.array(self.devices).reshape(shape)
+        self._jax_mesh = jax.sharding.Mesh(dev_array, self.axis_names)
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    def size(self, axis: str) -> int:
+        return self.axis_sizes.get(axis, 1)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def sharding(self, *spec):
+        """A NamedSharding for a PartitionSpec over this mesh. Axis names not
+        present in the mesh are treated as replicated (None)."""
+        import jax
+
+        P = jax.sharding.PartitionSpec
+        clean = tuple(s if (s is None or s in self.axis_names) else None
+                      for s in spec)
+        return jax.sharding.NamedSharding(self._jax_mesh, P(*clean))
+
+    def replicated(self):
+        import jax
+
+        return jax.sharding.NamedSharding(self._jax_mesh,
+                                          jax.sharding.PartitionSpec())
+
+    def __enter__(self):
+        if not hasattr(_tls, "stack"):
+            _tls.stack = []
+        _tls.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+
+    def __repr__(self):
+        return f"DeviceMesh({self.axis_sizes})"
+
+
+def current_mesh() -> Optional[DeviceMesh]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None

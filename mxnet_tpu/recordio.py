@@ -60,6 +60,8 @@ class MXRecordIO:
         is_open = self.record is not None
         d = dict(self.__dict__)
         d["record"] = None
+        if "fidx" in d:
+            d["fidx"] = None  # open index writer handle is not picklable
         d["is_open"] = is_open
         d.pop("_lock", None)  # locks are not picklable; recreated by open()
         return d

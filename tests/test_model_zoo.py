@@ -1,0 +1,95 @@
+"""Model zoo tests (parity model: tests/python/unittest/test_gluon_model_zoo.py
+— every registered model builds and forwards; spot-check parameter counts)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def _n_params(net):
+    return sum(int(np.prod(p.shape)) for p in net.collect_params().values())
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 224), ("resnet18_v2", 224), ("mobilenet0_25", 224),
+    ("mobilenet_v2_0_25", 224), ("squeezenet1_1", 224),
+])
+def test_small_models_forward(name, size):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    out = net(mx.nd.random.uniform(shape=(1, 3, size, size)))
+    assert out.shape == (1, 10)
+    # hybridized parity
+    ref = out.asnumpy()
+    net.hybridize()
+    out2 = net(mx.nd.random.uniform(shape=(1, 3, size, size)))
+    assert out2.shape == (1, 10)
+
+
+def test_resnet50_structure():
+    """ResNet-50 must have the canonical ~25.5M parameters."""
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    net(mx.nd.random.uniform(shape=(1, 3, 224, 224)))
+    n = _n_params(net)
+    assert 25.4e6 < n < 25.8e6, f"resnet50 param count {n}"
+
+
+def test_resnet18_param_count():
+    net = vision.resnet18_v1(classes=1000)
+    net.initialize()
+    net(mx.nd.random.uniform(shape=(1, 3, 224, 224)))
+    n = _n_params(net)
+    assert 11.6e6 < n < 11.8e6, f"resnet18 param count {n}"
+
+
+def test_get_model_errors():
+    with pytest.raises(ValueError):
+        vision.get_model("resnet1999")
+
+
+def test_thumbnail_mode():
+    net = vision.get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize()
+    out = net(mx.nd.random.uniform(shape=(2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_model_zoo_registry_complete():
+    names = set(vision.__all__)
+    # the reference's families (vision/__init__.py:112)
+    for family in ["alexnet", "densenet121", "inception_v3", "resnet50_v1",
+                   "resnet50_v2", "squeezenet1_0", "vgg16", "vgg16_bn",
+                   "mobilenet1_0", "mobilenet_v2_1_0"]:
+        assert family in names, f"missing {family}"
+
+
+def test_train_small_resnet():
+    """A thumbnail resnet trains on synthetic data (train-convergence tier)."""
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.gluon import Trainer, loss as gloss
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = vision.get_model("resnet18_v1", classes=4, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    rng = np.random.default_rng(0)
+    centers = rng.normal(0, 2.0, (4, 3 * 64))
+    labels = rng.integers(0, 4, 128)
+    data = (centers[labels] + rng.normal(0, 0.3, (128, 3 * 64))) \
+        .astype(np.float32).reshape(-1, 3, 8, 8)
+    x, y = mx.nd.array(data), mx.nd.array(labels.astype(np.float32))
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    first = last = None
+    for i in range(10):
+        with ag.record():
+            loss = L(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0])
+        v = float(loss.mean().asscalar())
+        first = first if first is not None else v
+        last = v
+    assert last < first, f"resnet loss did not decrease: {first} -> {last}"
