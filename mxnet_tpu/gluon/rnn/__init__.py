@@ -1,0 +1,8 @@
+"""Gluon RNN API (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *
+
+from .rnn_cell import __all__ as _cell_all
+from .rnn_layer import __all__ as _layer_all
+
+__all__ = list(_cell_all) + list(_layer_all)

@@ -1,0 +1,403 @@
+"""Gluon RNN cells.
+
+Parity target: `python/mxnet/gluon/rnn/rnn_cell.py:125-554` —
+RecurrentCell base (begin_state/unroll), RNNCell, LSTMCell, GRUCell,
+SequentialRNNCell, BidirectionalCell, DropoutCell, ResidualCell,
+ZoneoutCell.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import ndarray as F
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """parity: rnn_cell.py:RecurrentCell."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial zero states (parity: rnn_cell.py begin_state)."""
+        assert not self._modified
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            if func is None:
+                states.append(F.zeros(shape, **kwargs))
+            else:
+                states.append(func(shape=shape, **kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return self.forward(inputs, states)
+
+    def forward(self, inputs, states):
+        params = self._materialize_params(inputs, states)
+        return self.hybrid_forward(F, inputs, states, **params)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Run the cell over `length` steps (parity: rnn_cell.py unroll)."""
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, NDArray):
+            batch_size = inputs.shape[batch_axis]
+            seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis)
+                   for i in range(length)]
+        else:
+            seq = list(inputs)
+            batch_size = seq[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(seq[i], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = F.stack(*outputs, axis=axis)
+            stacked = F.invoke("SequenceMask", stacked.swapaxes(0, axis)
+                               if axis != 0 else stacked, valid_length,
+                               use_sequence_length=True, value=0.0)
+            if axis != 0:
+                stacked = stacked.swapaxes(0, axis)
+            outputs = stacked
+            merge_outputs = True
+        if merge_outputs:
+            if not isinstance(outputs, NDArray):
+                outputs = F.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def _get_activation(self, F_, inputs, activation):
+        if activation in ("tanh", "relu", "sigmoid", "softrelu", "softsign"):
+            return F_.invoke("Activation", inputs, act_type=activation)
+        if callable(activation):
+            return activation(inputs)
+        return F_.invoke("Activation", inputs, act_type=str(activation))
+
+
+class _BaseUnitCell(RecurrentCell):
+    """Shared weight plumbing for RNN/LSTM/GRU single cells."""
+
+    def __init__(self, hidden_size, ngates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = ngates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ng * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, inputs, states, *args):
+        ng_h = self.i2h_weight.shape[0]
+        self.i2h_weight.shape = (ng_h, inputs.shape[-1])
+
+    def _materialize_params(self, inputs, states):
+        from ..parameter import DeferredInitializationError
+
+        try:
+            return {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(inputs, states)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            return {name: p.data() for name, p in self._reg_params.items()}
+
+
+class RNNCell(_BaseUnitCell):
+    """Elman cell (parity: rnn_cell.py:RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h = F_.invoke("FullyConnected", inputs, i2h_weight, i2h_bias,
+                        num_hidden=self._hidden_size)
+        h2h = F_.invoke("FullyConnected", states[0], h2h_weight, h2h_bias,
+                        num_hidden=self._hidden_size)
+        output = self._get_activation(F_, i2h + h2h, self._activation)
+        return output, [output]
+
+
+class LSTMCell(_BaseUnitCell):
+    """parity: rnn_cell.py:LSTMCell (gate order i, f, c, o)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        h = self._hidden_size
+        i2h = F_.invoke("FullyConnected", inputs, i2h_weight, i2h_bias,
+                        num_hidden=4 * h)
+        h2h = F_.invoke("FullyConnected", states[0], h2h_weight, h2h_bias,
+                        num_hidden=4 * h)
+        gates = i2h + h2h
+        in_gate = gates.slice_axis(-1, 0, h).sigmoid()
+        forget_gate = gates.slice_axis(-1, h, 2 * h).sigmoid()
+        in_transform = gates.slice_axis(-1, 2 * h, 3 * h).tanh()
+        out_gate = gates.slice_axis(-1, 3 * h, 4 * h).sigmoid()
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * next_c.tanh()
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_BaseUnitCell):
+    """parity: rnn_cell.py:GRUCell (gate order r, z, n; cuDNN convention)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        h = self._hidden_size
+        prev = states[0]
+        i2h = F_.invoke("FullyConnected", inputs, i2h_weight, i2h_bias,
+                        num_hidden=3 * h)
+        h2h = F_.invoke("FullyConnected", prev, h2h_weight, h2h_bias,
+                        num_hidden=3 * h)
+        i2h_r = i2h.slice_axis(-1, 0, h)
+        i2h_z = i2h.slice_axis(-1, h, 2 * h)
+        i2h_n = i2h.slice_axis(-1, 2 * h, 3 * h)
+        h2h_r = h2h.slice_axis(-1, 0, h)
+        h2h_z = h2h.slice_axis(-1, h, 2 * h)
+        h2h_n = h2h.slice_axis(-1, 2 * h, 3 * h)
+        reset = (i2h_r + h2h_r).sigmoid()
+        update = (i2h_z + h2h_z).sigmoid()
+        next_h_tmp = (i2h_n + reset * h2h_n).tanh()
+        next_h = (1.0 - update) * next_h_tmp + update * prev
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells (parity: rnn_cell.py:SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.begin_state(batch_size, **kwargs))
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, new_states = cell(inputs, states[pos:pos + n])
+            pos += n
+            next_states.extend(new_states)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    """parity: rnn_cell.py:DropoutCell."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import autograd, random as _rand
+
+        if self._rate > 0 and autograd.is_training():
+            key = NDArray(_rand.next_key())
+            inputs = F.invoke("Dropout", inputs, key, p=self._rate,
+                              axes=self._axes, training=True)
+        return inputs, states
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_")
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ResidualCell(_ModifierCell):
+    """out = cell(x) + x (parity: rnn_cell.py:ResidualCell)."""
+
+    def _alias(self):
+        return "residual"
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    """parity: rnn_cell.py:ZoneoutCell — randomly keep previous states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def forward(self, inputs, states):
+        from ... import autograd
+
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        if not autograd.is_training():
+            return next_output, next_states
+
+        def mask(p, like):
+            import mxnet_tpu as mx
+
+            return F.invoke("_random_bernoulli",
+                            NDArray(__import__("mxnet_tpu.random",
+                                               fromlist=["next_key"]).next_key()),
+                            p=1 - p, shape=tuple(like.shape))
+
+        po, ps = self.zoneout_outputs, self.zoneout_states
+        prev_output = self._prev_output if self._prev_output is not None \
+            else F.zeros(next_output.shape)
+        output = F.invoke("where", mask(po, next_output), next_output,
+                          prev_output) if po > 0 else next_output
+        new_states = [F.invoke("where", mask(ps, ns), ns, s) if ps > 0 else ns
+                      for ns, s in zip(next_states, states)]
+        self._prev_output = output
+        return output, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """parity: rnn_cell.py:BidirectionalCell — unroll-only container."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        lc, rc = self._children["l_cell"], self._children["r_cell"]
+        return lc.state_info(batch_size) + rc.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        lc, rc = self._children["l_cell"], self._children["r_cell"]
+        return lc.begin_state(batch_size, **kwargs) + \
+            rc.begin_state(batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        lc, rc = self._children["l_cell"], self._children["r_cell"]
+        if isinstance(inputs, NDArray):
+            batch_size = inputs.shape[layout.find("N")]
+            seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis)
+                   for i in range(length)]
+        else:
+            seq = list(inputs)
+            batch_size = seq[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        n_l = len(lc.state_info())
+        l_out, l_states = lc.unroll(length, seq, begin_state[:n_l],
+                                    layout="TNC" if axis == 0 else layout,
+                                    merge_outputs=False)
+        r_out, r_states = rc.unroll(length, list(reversed(seq)),
+                                    begin_state[n_l:],
+                                    layout="TNC" if axis == 0 else layout,
+                                    merge_outputs=False)
+        r_out = list(reversed(r_out))
+        outputs = [F.concat(l, r, dim=-1) for l, r in zip(l_out, r_out)]
+        if merge_outputs:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

@@ -1,0 +1,184 @@
+"""Fused multi-layer RNN/LSTM/GRU layers.
+
+Parity target: `python/mxnet/gluon/rnn/rnn_layer.py:307-535` — RNN, LSTM,
+GRU over the fused RNN op (`src/operator/rnn.cc:303` cuDNN path). Parameters
+are kept as per-layer/direction i2h/h2h weights+biases with the reference's
+names and packed into the fused op's flat cuDNN-order vector at forward —
+so checkpoints are interchangeable per-parameter.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import ndarray as F
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import DeferredInitializationError
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), f"invalid layout {layout!r}"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    self._register_param(
+                        f"{j}{i}_i2h_weight", (ng * nh, ni if i == 0 else
+                                               nh * self._dir),
+                        i2h_weight_initializer)
+                    self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                                         h2h_weight_initializer)
+                    self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
+                                         i2h_bias_initializer)
+                    self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
+                                         h2h_bias_initializer)
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        self._reg_params[name] = p
+        object.__setattr__(self, name, p)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers}"
+                + (", bidirectional" if self._dir == 2 else "") + ")")
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, inputs, *args):
+        ni = inputs.shape[2 if self._layout == "NTC" else 2] if False else \
+            inputs.shape[-1]
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = self._reg_params[f"{j}{i}_i2h_weight"]
+                p.shape = (self._gates * self._hidden_size,
+                           ni if i == 0 else self._hidden_size * self._dir)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            if func is None:
+                states.append(F.zeros(info["shape"], **kwargs))
+            else:
+                states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def _collect_params_ordered(self):
+        """Pack order: all weights (layer-major, l then r), then all biases
+        — the fused op's cuDNN layout."""
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(self._reg_params[f"{j}{i}_i2h_weight"].data())
+                ws.append(self._reg_params[f"{j}{i}_h2h_weight"].data())
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(self._reg_params[f"{j}{i}_i2h_bias"].data())
+                bs.append(self._reg_params[f"{j}{i}_h2h_bias"].data())
+        return ws, bs
+
+    def forward(self, inputs, states=None):
+        try:
+            _ = [p.data() for p in self._reg_params.values()]
+        except DeferredInitializationError:
+            self.infer_shape(inputs)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+        skip_states = states is None
+        if skip_states:
+            batch = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch)
+        if isinstance(states, NDArray):
+            states = [states]
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        ws, bs = self._collect_params_ordered()
+        flat = F.concat(*[w.reshape(-1) for w in ws + bs], dim=0)
+        args = [inputs, flat, states[0]]
+        if self._mode == "lstm":
+            args.append(states[1])
+        out = F.invoke("RNN", *args, state_size=self._hidden_size,
+                       num_layers=self._num_layers, mode=self._mode,
+                       bidirectional=self._dir == 2, p=self._dropout,
+                       state_outputs=True)
+        outputs = out[0]
+        # the fused op always emits (out, h, c); c is meaningful for lstm only
+        out_states = list(out[1:3]) if self._mode == "lstm" else [out[1]]
+        if self._layout == "NTC":
+            outputs = outputs.swapaxes(0, 1)
+        if skip_states:
+            return outputs
+        return outputs, list(out_states)
+
+
+class RNN(_RNNLayer):
+    """parity: rnn_layer.py:RNN (relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """parity: rnn_layer.py:LSTM."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """parity: rnn_layer.py:GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

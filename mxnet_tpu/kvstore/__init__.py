@@ -1,0 +1,5 @@
+"""KVStore (parity: python/mxnet/kvstore/ + src/kvstore/)."""
+from .base import KVStoreBase
+from .kvstore import KVStore, create
+
+__all__ = ["KVStore", "KVStoreBase", "create"]

@@ -1,0 +1,58 @@
+"""KVStoreBase: the pluggable store interface.
+
+Parity target: `python/mxnet/kvstore/base.py:74,220` — the abstract
+init/push/pull/pushpull/broadcast surface plus `KVStoreBase.register`, the
+mechanism by which external backends (the reference lists 'horovod',
+'byteps') plug in. Here the same registry carries 'local'/'device' (in-
+process), 'dist_*' (jax.distributed-backed), and any user backend.
+"""
+from __future__ import annotations
+
+__all__ = ["KVStoreBase"]
+
+
+class KVStoreBase:
+    """Abstract key-value store (parity: kvstore/base.py:KVStoreBase)."""
+
+    kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        """Register a kvstore backend under its lowercased class name
+        (parity: base.py:432)."""
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    # -- capability strings (parity: base.py OPTIMIZER/...) -----------------
+    OPTIMIZER = "optimizer"
+
+    def is_capable(self, capability):
+        raise NotImplementedError
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @property
+    def type(self):
+        return type(self).__name__.lower()

@@ -62,6 +62,14 @@ class RowSparseNDArray(NDArray):
             return self
         raise ValueError(f"cannot cast row_sparse to {stype}")
 
+    def _update(self, rows, indices):
+        """Replace contents with `rows` at `indices` (kvstore
+        row_sparse_pull writeback)."""
+        self._rs_data = rows if isinstance(rows, NDArray) else array(rows)
+        self._rs_indices = indices if isinstance(indices, NDArray) \
+            else array(indices, dtype="int64")
+        self._rebind(self._densify()._data)
+
     def retain(self, indices):
         """Keep only the given rows (parity: sparse.retain)."""
         keep = set(_np.asarray(indices.asnumpy() if isinstance(indices, NDArray)

@@ -27,6 +27,8 @@ imperative Trainer collapses into one executable launch.
 from __future__ import annotations
 
 from .mesh import DeviceMesh, current_mesh
+from .ring_attention import attention, ring_attention, ring_attention_sharded
 from .sharded_trainer import ShardedTrainer, sharding_rules
 
-__all__ = ["DeviceMesh", "current_mesh", "ShardedTrainer", "sharding_rules"]
+__all__ = ["DeviceMesh", "current_mesh", "ShardedTrainer", "sharding_rules",
+           "attention", "ring_attention", "ring_attention_sharded"]

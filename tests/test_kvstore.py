@@ -1,0 +1,147 @@
+"""KVStore tests (parity model: tests/python/unittest/test_kvstore.py —
+init/push/pull aggregation semantics, update-on-kvstore, row_sparse_pull)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kv_mod
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kv_type="local"):
+    kv = kv_mod.create(kv_type)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device"])
+def test_single_kv_pair(kv_type):
+    kv = init_kv(kv_type)
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(SHAPE) * 4)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o, np.ones(SHAPE) * 4)
+
+
+def test_aggregation():
+    """Multiple device values pushed for one key sum (parity:
+    CommDevice::Reduce)."""
+    kv = init_kv()
+    vals = [mx.nd.ones(SHAPE), mx.nd.ones(SHAPE) * 2, mx.nd.ones(SHAPE) * 3]
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(SHAPE) * 6)
+
+
+def test_update_on_kvstore():
+    """Optimizer-on-store: push applies the update to the stored weight
+    (parity: kvstore_dist_server ApplyUpdates + Updater)."""
+    kv = kv_mod.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.push(0, mx.nd.ones(SHAPE))  # grad = 1 -> w -= 0.1
+    out = mx.nd.empty(SHAPE)
+    kv.pull(0, out=out)
+    assert_almost_equal(out, np.ones(SHAPE) * 0.9, rtol=1e-5, atol=1e-6)
+
+
+def test_row_sparse_pull():
+    kv = kv_mod.create("local")
+    w = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((5, 4))
+    rows = mx.nd.array([1, 3])
+    kv.row_sparse_pull("emb", out=out, row_ids=rows)
+    expect = np.zeros((5, 4), np.float32)
+    expect[[1, 3]] = w[[1, 3]]
+    assert_almost_equal(out, expect)
+
+
+def test_broadcast_and_pushpull():
+    kv = kv_mod.create("device")
+    out = mx.nd.zeros(SHAPE)
+    kv.broadcast(9, mx.nd.ones(SHAPE) * 2, out=out)
+    assert_almost_equal(out, np.ones(SHAPE) * 2)
+    out2 = mx.nd.zeros(SHAPE)
+    kv.pushpull(9, mx.nd.ones(SHAPE), out=out2)
+    assert float(out2.asnumpy().sum()) != 0
+
+
+def test_str_keys():
+    kv = kv_mod.create("local")
+    kv.init("a", mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull("a", out=out)
+    assert_almost_equal(out, np.ones(SHAPE))
+
+
+def test_dist_sync_single_worker():
+    """dist_device_sync degenerates to 1-worker group without a cluster
+    (rank 0, num_workers 1) and still aggregates correctly."""
+    kv = kv_mod.create("dist_device_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init(0, mx.nd.zeros(SHAPE))
+    kv.push(0, mx.nd.ones(SHAPE) * 3)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(0, out=out)
+    assert_almost_equal(out, np.ones(SHAPE) * 3)
+    kv.barrier()
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = kv_mod.create("local")
+    kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.1))
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.push(0, mx.nd.ones(SHAPE))
+    f = str(tmp_path / "states")
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)
+
+
+def test_gradient_compression_api():
+    kv = kv_mod.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert kv.gradient_compression["type"] == "2bit"
+
+
+def test_unknown_type():
+    with pytest.raises(ValueError):
+        kv_mod.create("zookeeper")
+
+
+def test_trainer_with_explicit_kvstore():
+    """Trainer wired through a kvstore still trains (parity:
+    update_on_kvstore=False path: push grads, pull aggregate)."""
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.gluon import Trainer, nn, loss as gloss
+
+    net = nn.Dense(1, in_units=4)
+    net.initialize()
+    kv = kv_mod.create("dist_sync")
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      kvstore=kv)
+    x = mx.nd.ones((8, 4))
+    y = mx.nd.ones((8, 1))
+    L = gloss.L2Loss()
+    prev = float(L(net(x), y).mean().asscalar())
+    for _ in range(10):
+        with ag.record():
+            loss = L(net(x), y)
+        loss.backward()
+        trainer.step(8)
+    final = float(L(net(x), y).mean().asscalar())
+    assert final < prev
