@@ -175,13 +175,18 @@ class NDArrayIter(DataIter):
         self.cursor += self.batch_size
         if self.cursor >= self.num_batch_data:
             return False
-        if self.last_batch_handle == "roll_over" and \
-                self.cursor + self.batch_size > self.num_batch_data:
-            # partial tail: carry to next epoch instead of yielding. COPY —
-            # a view of self.idx would be corrupted by reset()'s in-place
-            # shuffle
-            self._residual = self._order[self.cursor:].copy()
-            return False
+        if self.cursor + self.batch_size > self.num_batch_data:
+            if self.last_batch_handle == "roll_over":
+                # partial tail: carry to next epoch instead of yielding.
+                # COPY — a view of self.idx would be corrupted by reset()'s
+                # in-place shuffle
+                self._residual = self._order[self.cursor:].copy()
+                return False
+            if self.last_batch_handle == "discard":
+                # epoch ends; the while iter.iter_next(): getdata() protocol
+                # must never see a None-data batch (ref io.py discard
+                # semantics)
+                return False
         return True
 
     def _getdata(self, data_source):
@@ -189,9 +194,9 @@ class NDArrayIter(DataIter):
         if end <= self.num_batch_data:
             sel = self._order[self.cursor:end]
             return [nd.array(v[sel], dtype=v.dtype) for _, v in data_source]
-        # final partial batch
-        if self.last_batch_handle == "discard":
-            return None
+        # final partial batch — only reachable with last_batch_handle='pad'
+        # (iter_next() already ended the epoch for discard/roll_over)
+        assert self.last_batch_handle == "pad", self.last_batch_handle
         pad = end - self.num_batch_data
         sel = _np.concatenate([self._order[self.cursor:], self._order[:pad]])
         return [nd.array(v[sel], dtype=v.dtype) for _, v in data_source]
@@ -212,8 +217,6 @@ class NDArrayIter(DataIter):
         if not self.iter_next():
             raise StopIteration
         data = self.getdata()
-        if data is None:  # discard partial batch
-            raise StopIteration
         return DataBatch(data=data, label=self.getlabel(), pad=self.getpad(),
                          index=None, provide_data=self.provide_data,
                          provide_label=self.provide_label)

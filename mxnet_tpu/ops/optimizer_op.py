@@ -30,6 +30,21 @@ def _prep_grad(grad, rescale_grad, clip_gradient):
     return g
 
 
+def _prep_grad_wd(grad, weight, rescale_grad, clip_gradient, wd):
+    """Adam-family gradient prep: fold wd*weight in BEFORE clipping.
+
+    The reference's AdamUpdateKernel (and ftml/rmsprop/rmspropalex,
+    optimizer_op-inl.h:1215,1303,1966,2064) computes
+    grad = rescale*grad + wd*weight and clips the sum; the SGD-family
+    kernels clip first. Preserving the ordering keeps numerics identical
+    whenever clip_gradient is set with nonzero wd.
+    """
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
 @register("sgd_update")
 def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                lazy_update=True):
@@ -91,7 +106,7 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
-    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    g = _prep_grad_wd(grad, weight, rescale_grad, clip_gradient, wd)
     mean_new = beta1 * mean + (1 - beta1) * g
     var_new = beta2 * var + (1 - beta2) * jnp.square(g)
     w = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
@@ -101,7 +116,7 @@ def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
 @register("ftml_update", num_outputs=4)
 def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
-    g = _prep_grad(grad, rescale_grad, clip_grad) + wd * weight
+    g = _prep_grad_wd(grad, weight, rescale_grad, clip_grad, wd)
     v_new = beta2 * v + (1 - beta2) * jnp.square(g)
     d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
     sigma = d_new - beta1 * d
@@ -112,7 +127,7 @@ def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
 @register("rmsprop_update", num_outputs=2)
 def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
-    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    g = _prep_grad_wd(grad, weight, rescale_grad, clip_gradient, wd)
     n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
     w = weight - lr * g / jnp.sqrt(n_new + epsilon)
     if clip_weights is not None and clip_weights > 0:
@@ -124,7 +139,7 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
 def rmspropalex_update(weight, grad, n, g_st, delta, lr=0.001, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
-    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    g = _prep_grad_wd(grad, weight, rescale_grad, clip_gradient, wd)
     n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n
     g_new = (1 - gamma1) * g + gamma1 * g_st
     delta_new = gamma2 * delta - lr * g / jnp.sqrt(n_new - jnp.square(g_new) + epsilon)
@@ -159,11 +174,13 @@ def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
 @register("adadelta_update", num_outputs=3)
 def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
-    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    """Reference AdaDelta is a Python optimizer (optimizer.py:1802-1824):
+    clip rescale*grad WITHOUT wd, then weight -= delta + wd*weight."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
     acc_g_new = rho * acc_g + (1 - rho) * jnp.square(g)
     delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(acc_g_new + epsilon) * g
     acc_delta_new = rho * acc_delta + (1 - rho) * jnp.square(delta)
-    return weight - delta, acc_g_new, acc_delta_new
+    return weight - delta - wd * weight, acc_g_new, acc_delta_new
 
 
 @register("lars_sgd_update")

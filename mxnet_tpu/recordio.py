@@ -205,13 +205,20 @@ def unpack(s):
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Encode an HWC uint8 image and pack it (parity: recordio.py pack_img)."""
+    """Encode an HWC uint8 image and pack it (parity: recordio.py pack_img).
+
+    Input is BGR channel order, matching the reference's cv2.imencode
+    contract; `unpack_img` returns BGR, so pack/unpack round-trips.
+    """
     import io as _io
 
     from PIL import Image
 
     arr = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
-    pil = Image.fromarray(arr.astype(np.uint8))
+    arr = arr.astype(np.uint8)
+    if arr.ndim == 3 and arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]  # BGR -> RGB for PIL
+    pil = Image.fromarray(arr)
     buf = _io.BytesIO()
     fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
     pil.save(buf, format=fmt, quality=quality)
@@ -219,8 +226,12 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
 
 
 def unpack_img(s, iscolor=1):
-    """Unpack a record and decode the image (parity: recordio.py unpack_img)."""
+    """Unpack a record and decode the image (parity: recordio.py unpack_img).
+
+    Returns BGR channel order, matching the reference's cv2.imdecode result
+    (mx.image.imdecode keeps RGB as its own documented default).
+    """
     from . import image as img_mod
 
     header, img_bytes = unpack(s)
-    return header, img_mod.imdecode(img_bytes, flag=iscolor, to_rgb=True)
+    return header, img_mod.imdecode(img_bytes, flag=iscolor, to_rgb=False)

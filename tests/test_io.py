@@ -280,3 +280,16 @@ def test_hue_jitter():
     assert out.shape == (8, 8, 3)
     jitter = transforms.ColorJitter(brightness=0.1, hue=0.3)
     assert len(jitter._transforms) == 2
+
+
+def test_ndarray_iter_discard_protocol():
+    """`while it.iter_next(): it.getdata()` must never yield a None batch
+    under last_batch_handle='discard' (ref io.py: epoch ends instead)."""
+    data = np.arange(10 * 2, dtype=np.float32).reshape(10, 2)
+    it = mx.io.NDArrayIter(data, batch_size=4, last_batch_handle="discard")
+    seen = 0
+    while it.iter_next():
+        batch = it.getdata()
+        assert batch is not None
+        seen += 1
+    assert seen == 2  # 10 // 4 full batches only

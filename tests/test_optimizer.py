@@ -210,3 +210,25 @@ def test_stale_grad_detection():
     w2_before = d2.weight.data().asnumpy().copy()
     trainer.step(2, ignore_stale_grad=True)
     assert np.allclose(d2.weight.data().asnumpy(), w2_before)  # skipped
+
+
+def test_adam_clips_after_wd():
+    """Adam-family kernels clip rescale*grad + wd*weight (the sum), unlike
+    SGD-family which clips before wd (ref optimizer_op-inl.h AdamUpdateKernel)."""
+    w = mx.nd.array(np.full(4, 10.0, np.float32))
+    g = mx.nd.array(np.full(4, 1.0, np.float32))
+    mean = mx.nd.zeros(4)
+    var = mx.nd.zeros(4)
+    wd, clip, lr, b1, b2, eps = 0.1, 0.5, 0.01, 0.9, 0.999, 1e-8
+    w2, mean2, var2 = mx.nd.invoke("adam_update", w, g, mean, var, lr=lr, beta1=b1,
+                             beta2=b2, epsilon=eps, wd=wd, rescale_grad=1.0,
+                             clip_gradient=clip)
+    # grad + wd*w = 1 + 1.0 = 2.0 -> clipped to 0.5 (clip-before-wd would
+    # give clip(1)=0.5 then +1.0 = 1.5)
+    g_eff = 0.5
+    m_ref = (1 - b1) * g_eff
+    v_ref = (1 - b2) * g_eff ** 2
+    w_ref = 10.0 - lr * m_ref / (np.sqrt(v_ref) + eps)
+    np.testing.assert_allclose(mean2.asnumpy(), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(var2.asnumpy(), v_ref, rtol=1e-6)
+    np.testing.assert_allclose(w2.asnumpy(), w_ref, rtol=1e-6)
