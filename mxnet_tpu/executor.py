@@ -1,0 +1,236 @@
+"""Executor: a bound Symbol — arrays + compiled forward/backward.
+
+Parity target: `src/executor/graph_executor.cc` (`GraphExecutor::Init`
+:397, `Forward` :81, `Backward` :95) + the Python wrapper
+`python/mxnet/executor.py`. The reference's bind pipeline (infer attrs →
+plan memory → attach op execs → pre-create engine ops → bulk segments)
+collapses here into XLA compilation of the graph's single pure function,
+cached per (input signature, train-mode).
+
+Backward is the jitted VJP of that function with rematerialisation: the
+forward recomputes inside the backward executable (the
+`MXNET_BACKWARD_DO_MIRROR` trade, the right default on TPU where HBM
+bandwidth, not FLOPs, is the bottleneck). The dropout/rng key drawn at
+`forward` is reused by `backward`, so recomputed masks match exactly.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """Execution handle for one bound symbol (parity: executor.py)."""
+
+    def __init__(self, symbol, ctx, arg_arrays, aux_arrays, grad_req="write",
+                 grad_arrays=None):
+        from .ndarray import NDArray
+
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        self._arg_dict = OrderedDict(
+            (n, _as_nd(arg_arrays[n])) for n in self.arg_names)
+        self._aux_dict = OrderedDict(
+            (n, _as_nd(aux_arrays[n])) for n in self.aux_names)
+        self._grad_req = self._normalize_req(grad_req)
+        self._grad_dict = OrderedDict()
+        if grad_arrays is not None and not isinstance(grad_arrays, dict):
+            grad_arrays = dict(zip(self.arg_names, grad_arrays))
+        for name in self.arg_names:
+            req = self._grad_req[name]
+            if req == "null":
+                continue
+            if grad_arrays is not None and grad_arrays.get(name) is not None:
+                self._grad_dict[name] = _as_nd(grad_arrays[name])
+            else:
+                src = self._arg_dict[name]
+                self._grad_dict[name] = NDArray(
+                    _np.zeros(src.shape, dtype=_np.dtype(str(src.dtype))
+                              if not str(src.dtype).startswith("bfloat")
+                              else _np.float32), ctx=ctx)
+                if str(src.dtype).startswith("bfloat"):
+                    self._grad_dict[name] = self._grad_dict[name].astype(
+                        src.dtype)
+        self._run = symbol._build_eval()
+        self._jit = {}
+        self.outputs = []
+        self._last = None  # (args_raw, auxs_raw, key) from latest forward
+
+    def _normalize_req(self, grad_req):
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in self.arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(self.arg_names, grad_req))
+        out = {n: "null" for n in self.arg_names}
+        out.update(grad_req)
+        return out
+
+    # ------------------------------------------------------------ compile --
+    def _exe(self, kind, sig, training):
+        import jax
+
+        key = (kind, sig, training)
+        fn = self._jit.get(key)
+        if fn is not None:
+            return fn
+        run = self._run
+        if kind == "fwd":
+            def fwd(args, auxs, rng):
+                outs, new_aux = run(args, auxs, rng, training)
+                return tuple(outs), new_aux
+
+            fn = jax.jit(fwd)
+        else:
+            diff_names = tuple(sorted(
+                n for n, r in self._grad_req.items() if r != "null"))
+
+            def bwd(diff_args, rest_args, auxs, rng, cots):
+                def f(d):
+                    merged = dict(rest_args)
+                    merged.update(d)
+                    outs, _ = run(merged, auxs, rng, True)
+                    return tuple(outs)
+
+                _, pull = jax.vjp(f, dict(diff_args))
+                return pull(tuple(cots))[0]
+
+            bwd.diff_names = diff_names
+            fn = jax.jit(bwd)
+            fn.diff_names = diff_names
+        self._jit[key] = fn
+        return fn
+
+    def _sig(self):
+        return (tuple((n, tuple(a.shape), str(a.dtype))
+                      for n, a in self._arg_dict.items()),
+                tuple((n, tuple(a.shape), str(a.dtype))
+                      for n, a in self._aux_dict.items()))
+
+    # ------------------------------------------------------------ forward --
+    def forward(self, is_train=False, **kwargs):
+        from . import random as _random
+        from .ndarray import NDArray
+
+        for name, value in kwargs.items():
+            if name not in self._arg_dict:
+                raise MXNetError(f"unknown argument {name!r}")
+            dst = self._arg_dict[name]
+            value = _as_nd(value)
+            if tuple(value.shape) != tuple(dst.shape):
+                raise MXNetError(
+                    f"shape mismatch for {name!r}: bound {tuple(dst.shape)}"
+                    f" vs fed {tuple(value.shape)}")
+            dst._rebind_like(value)
+        args = {n: a._data for n, a in self._arg_dict.items()}
+        auxs = {n: a._data for n, a in self._aux_dict.items()}
+        rng = _random.next_key()
+        fwd = self._exe("fwd", self._sig(), bool(is_train))
+        outs, new_aux = fwd(args, auxs, rng)
+        if is_train:
+            for name, raw in new_aux.items():
+                self._aux_dict[name]._rebind(raw)
+        self.outputs = [NDArray(o) for o in outs]
+        self._last = (args, auxs, rng)
+        return self.outputs
+
+    # ----------------------------------------------------------- backward --
+    def backward(self, out_grads=None):
+        """Accumulate input gradients into grad_arrays honoring grad_req.
+        With no out_grads, heads are seeded with ones (loss semantics)."""
+        import jax.numpy as jnp
+
+        if self._last is None:
+            raise MXNetError("backward called before forward")
+        args, auxs, rng = self._last
+        if out_grads is None:
+            cots = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cots = [_as_nd(g)._data for g in out_grads]
+        bwd = self._exe("bwd", self._sig(), True)
+        diff_names = bwd.diff_names
+        diff_args = {n: args[n] for n in diff_names}
+        rest_args = {n: v for n, v in args.items() if n not in diff_names}
+        grads = bwd(diff_args, rest_args, auxs, rng, tuple(cots))
+        for name in diff_names:
+            req = self._grad_req[name]
+            g = grads[name]
+            dst = self._grad_dict[name]
+            if req == "add":
+                dst._rebind(dst._data + g.astype(dst._data.dtype))
+            else:  # write
+                dst._rebind(g.astype(dst._data.dtype))
+
+    # ------------------------------------------------------------- access --
+    @property
+    def arg_dict(self):
+        return self._arg_dict
+
+    @property
+    def grad_dict(self):
+        return self._grad_dict
+
+    @property
+    def aux_dict(self):
+        return self._aux_dict
+
+    @property
+    def arg_arrays(self):
+        return [self._arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self._grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self._aux_dict[n] for n in self.aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """parity: executor.py copy_params_from."""
+        for name, value in arg_params.items():
+            if name in self._arg_dict:
+                dst = self._arg_dict[name]
+                dst._rebind(_as_nd(value).astype(dst.dtype)._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"arg {name!r} not bound")
+        for name, value in (aux_params or {}).items():
+            if name in self._aux_dict:
+                dst = self._aux_dict[name]
+                dst._rebind(_as_nd(value).astype(dst.dtype)._data)
+            elif not allow_extra_params:
+                raise MXNetError(f"aux {name!r} not bound")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes (parity: executor.py reshape);
+        recompilation is just a new cache entry."""
+        shapes = {n: tuple(a.shape) for n, a in self._arg_dict.items()}
+        shapes.update({k: tuple(v) for k, v in kwargs.items()})
+        new = self._symbol.simple_bind(
+            self._ctx, grad_req=self._grad_req,
+            **{k: v for k, v in shapes.items()})
+        for name, arr in self._arg_dict.items():
+            if tuple(arr.shape) == tuple(new._arg_dict[name].shape):
+                new._arg_dict[name]._rebind(arr._data)
+        for name, arr in self._aux_dict.items():
+            if tuple(arr.shape) == tuple(new._aux_dict[name].shape):
+                new._aux_dict[name]._rebind(arr._data)
+        return new
+
+
+def _as_nd(value):
+    from .ndarray import NDArray, array
+
+    if isinstance(value, NDArray):
+        return value
+    return array(value)

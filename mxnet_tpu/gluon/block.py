@@ -278,6 +278,10 @@ class HybridBlock(Block):
             return {name: p.data() for name, p in self._reg_params.items()}
 
     def __call__(self, *args):
+        from ..symbol import Symbol
+
+        if any(isinstance(a, Symbol) for a in args):
+            return self.forward(*args)  # symbolic tracing path
         if self._active and current_trace() is None:
             if self._cached_op is not None:  # hot path: no tree walk
                 return self._cached_op(*args)
@@ -299,8 +303,16 @@ class HybridBlock(Block):
                                    flags=self._flags.items())
 
     def forward(self, x, *args):
-        """Default forward: dispatch to hybrid_forward with this block's own
-        params (parity: block.py:1471 ndarray branch)."""
+        """Default forward: ndarray branch dispatches hybrid_forward with
+        this block's params; a Symbol input traces the graph symbolically
+        (parity: block.py:1471 two-branch dispatch)."""
+        from ..symbol import Symbol
+
+        if isinstance(x, Symbol):
+            from .. import symbol as F
+
+            params = {name: p.var() for name, p in self._reg_params.items()}
+            return self.hybrid_forward(F, x, *args, **params)
         from .. import ndarray as F
 
         params = self._materialize_params(x, *args)
@@ -309,12 +321,38 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
-        """parity: block.py:1416 — serialize for deployment. Emits
-        `path-symbol.json` (structural graph) + `path-%04d.params`."""
-        raise NotImplementedError(
-            "export() requires the symbol layer; use save_parameters for "
-            "weight checkpoints")
+    def _trace_symbol(self, input_names=("data",)):
+        """Trace this block into a Symbol graph by running forward with
+        Symbol inputs (the reference traces hybrid_forward with Symbol
+        proxies, block.py:1067)."""
+        from .. import symbol as sym_mod
+
+        inputs = [sym_mod.var(n) for n in input_names]
+        out = self.forward(*inputs)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return out
+
+    def export(self, path, epoch=0, remove_amp_cast=True,
+               input_names=("data",)):
+        """parity: block.py:1416 — emit `path-symbol.json` +
+        `path-%04d.params` loadable by SymbolBlock.imports (and shaped like
+        the reference's deployment artifacts). Multi-input blocks pass
+        their input names via `input_names`."""
+        from ..ndarray import utils as nd_utils
+
+        sym = self._trace_symbol(input_names)
+        sym.save(f"{path}-symbol.json")
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        save_dict = {}
+        for name, param in self.collect_params().items():
+            if name in arg_names:
+                save_dict[f"arg:{name}"] = param.data()
+            elif name in aux_names:
+                save_dict[f"aux:{name}"] = param.data()
+        nd_utils.save(f"{path}-{epoch:04d}.params", save_dict)
+        return sym
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         self.hybridize()
@@ -323,15 +361,34 @@ class HybridBlock(Block):
 
 class SymbolBlock(HybridBlock):
     """Wrap a Symbol graph as a Gluon block (parity: gluon/block.py:1533).
-    Implemented with the symbol layer (mxnet_tpu.symbol)."""
+
+    Every symbol argument/aux that is not an input becomes a Parameter
+    (aux states as grad_req='null'), so the imported graph trains and
+    saves like any other Gluon block."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
-        self._outputs = outputs
-        self._inputs = inputs
+        from .. import symbol as sym_mod
+
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sb_inputs = [i if isinstance(i, sym_mod.Symbol)
+                           else sym_mod.var(str(i)) for i in inputs]
+        self._sb_outputs = outputs
+        input_names = {s.name for s in self._sb_inputs}
+        for name in outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            if name not in input_names:
+                self.params.get(name, grad_req="null",
+                                allow_deferred_init=True,
+                                differentiable=False)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """parity: block.py SymbolBlock.imports — load an export()ed (or
+        reference-produced) symbol.json + params pair."""
         from .. import symbol as sym_mod
 
         sym = sym_mod.load(symbol_file)
@@ -340,14 +397,38 @@ class SymbolBlock(HybridBlock):
         inputs = [sym_mod.var(n) for n in input_names]
         block = SymbolBlock(sym, inputs)
         if param_file:
-            block.collect_params().load(param_file, ctx=ctx)
+            block.collect_params().initialize(ctx=ctx)
+            block.collect_params().load(param_file, ctx=ctx,
+                                        allow_missing=False,
+                                        ignore_extra=True)
         return block
 
-    def forward(self, *args):
-        from .. import symbol as sym_mod
+    def infer_shape(self, *args):
+        """Resolve deferred param shapes from the input shapes via the
+        symbol's shape inference (reference: deferred-init symbolic pass)."""
+        names = [s.name for s in self._sb_inputs]
+        hints = {n: tuple(a.shape) for n, a in zip(names, args)}
+        shapes, _ = self._sb_outputs._infer(hints, {})
+        for name, p in self.collect_params().items():
+            got = shapes.get(("var", name))
+            if got is not None and (p.shape is None or
+                                    any(s == 0 for s in p.shape)):
+                p.shape = got
 
-        names = [getattr(i, "name", str(i)) for i in self._inputs]
+    def forward(self, *args):
+        from .parameter import DeferredInitializationError
+
+        names = [s.name for s in self._sb_inputs]
         feed = dict(zip(names, args))
-        param_feed = {name: p.data() for name, p in
-                      self.collect_params().items()}
-        return self._outputs.eval_with(feed, param_feed)
+        params = self.collect_params()
+        try:
+            param_feed = {name: p.data() for name, p in params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in params.values():
+                p._finish_deferred_init()
+            param_feed = {name: p.data() for name, p in params.items()}
+        feed.update(param_feed)
+        aux_handles = {name: p.data() for name, p in params.items()
+                       if p._grad_req == "null"}
+        return self._sb_outputs.eval_nd(feed, aux_handles)

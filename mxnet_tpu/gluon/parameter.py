@@ -182,7 +182,9 @@ class Parameter:
             g._rebind((g._data * 0))
 
     def set_data(self, data):
-        """Overwrite the value in place (keeps grad buffer)."""
+        """Overwrite the value in place (keeps grad buffer AND placement —
+        loading host values into a TPU-resident or mesh-sharded parameter
+        preserves its device/sharding)."""
         if self._data is None:
             if self._deferred_init is not None:
                 self.shape = tuple(data.shape)
@@ -190,9 +192,7 @@ class Parameter:
             else:
                 self._check_initialized()
         data = data if isinstance(data, NDArray) else NDArray(data)
-        self._data._rebind(
-            data.astype(self.dtype)._data if str(data.dtype) != str(self.dtype)
-            else data._data)
+        self._data._rebind_like(data)
 
     def reset_ctx(self, ctx):
         """Move data to another context IN PLACE — the NDArray handle keeps
@@ -217,9 +217,12 @@ class Parameter:
 
     # -------------------------------------------------------------- misc ---
     def var(self):
+        """Aux-ness tracks `differentiable=False` (BatchNorm stats), NOT a
+        user-frozen grad_req='null' — a frozen weight stays an argument."""
         from .. import symbol as sym_mod
 
-        return sym_mod.var(self.name, shape=self._shape, dtype=self.dtype)
+        return sym_mod.var(self.name, shape=self._shape, dtype=self.dtype,
+                           is_aux=not self._differentiable)
 
     def __repr__(self):
         return f"Parameter {self.name} (shape={self._shape}, dtype={getattr(self.dtype, '__name__', self.dtype)})"

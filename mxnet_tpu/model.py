@@ -1,0 +1,50 @@
+"""Checkpoint helpers for the symbolic path.
+
+Parity target: `python/mxnet/model.py:403-476` — `save_checkpoint` emits
+`prefix-symbol.json` + `prefix-%04d.params`, `load_checkpoint` reads them
+back. The `.params` payload goes through `mx.nd.save/load`, keyed with the
+reference's `arg:`/`aux:` prefixes so Gluon `SymbolBlock.imports` and
+Module.load share one on-disk contract.
+"""
+from __future__ import annotations
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .module.base_module import BatchEndParam  # noqa: F401  (parity re-export)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """parity: model.py:403."""
+    from .ndarray import utils as nd_utils
+
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd_utils.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(fname):
+    """Split a params file into (arg_params, aux_params) dicts."""
+    from .ndarray import utils as nd_utils
+
+    loaded = nd_utils.load(fname)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """parity: model.py:448 — returns (symbol, arg_params, aux_params)."""
+    from . import symbol as sym_mod
+
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(f"{prefix}-{epoch:04d}.params")
+    return symbol, arg_params, aux_params

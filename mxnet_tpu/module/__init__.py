@@ -1,0 +1,6 @@
+"""mx.mod — Module API (parity: python/mxnet/module/)."""
+from .base_module import BaseModule, BatchEndParam
+from .bucketing_module import BucketingModule
+from .module import Module
+
+__all__ = ["BaseModule", "BatchEndParam", "BucketingModule", "Module"]

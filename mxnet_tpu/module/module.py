@@ -1,0 +1,299 @@
+"""Module: intermediate-level symbolic training interface.
+
+Parity target: `python/mxnet/module/module.py` — `bind` (:364 →
+DataParallelExecutorGroup), `init_params` (:264), `init_optimizer` (:474,
+kvstore decision table in model.py:84), forward/backward/update, and the
+save_checkpoint/load path (model.py:403-476).
+
+TPU-native: one Executor holds the whole graph as a single XLA executable
+(no per-device executor group — data parallelism on TPU is mesh sharding,
+`parallel/ShardedTrainer`, not executor replication). The kvstore is still
+honored for optimizer-on-store semantics and API parity.
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..context import cpu
+from ..initializer import InitDesc
+from ..io.io import DataDesc
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """parity: module/module.py:50."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        if context is None:
+            context = cpu()
+        self._context = context[0] if isinstance(context, (list, tuple)) \
+            else context
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._data_shapes = None
+        self._label_shapes = None
+        self._inputs_need_grad = False
+
+    # -------------------------------------------------------------- bind --
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = [_as_desc(d, self._data_names, i)
+                             for i, d in enumerate(data_shapes)]
+        self._label_shapes = [_as_desc(d, self._label_names, i)
+                              for i, d in enumerate(label_shapes or [])]
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        shape_kwargs = {d.name: tuple(d.shape) for d in self._data_shapes}
+        shape_kwargs.update(
+            {d.name: tuple(d.shape) for d in self._label_shapes})
+        req = {}
+        for name in self._param_names:
+            if name in self._fixed_param_names or not for_training:
+                req[name] = "null"
+            elif isinstance(grad_req, dict):
+                req[name] = grad_req.get(name, "write")
+            else:
+                req[name] = grad_req
+        if inputs_need_grad:
+            for name in self._data_names:
+                req[name] = "write"
+        self._exec = self._symbol.simple_bind(self._context, grad_req=req,
+                                              **shape_kwargs)
+        if shared_module is not None and shared_module._exec is not None:
+            for name, arr in shared_module._exec.arg_dict.items():
+                if name in self._exec.arg_dict and \
+                        name in shared_module._param_names:
+                    # share storage: identical handles across buckets
+                    self._exec.arg_dict[name] = arr
+            for name, arr in shared_module._exec.aux_dict.items():
+                self._exec.aux_dict[name] = arr
+            for name, arr in shared_module._exec.grad_dict.items():
+                if name in self._exec.grad_dict:
+                    self._exec.grad_dict[name] = arr
+        self.binded = True
+
+    # ------------------------------------------------------------ params --
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            src = (arg_params or {}).get(name)
+            if src is not None:
+                _set_like(arr, src)
+            elif self.params_initialized and not force_init:
+                pass
+            elif initializer is not None:
+                init_arr = initializer(InitDesc(name), arr.shape,
+                                       dtype=str(arr.dtype))
+                _set_like(arr, init_arr)
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name!r} has no initializer "
+                                 "and no provided value")
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            src = (aux_params or {}).get(name)
+            if src is not None:
+                _set_like(arr, src)
+        if arg_params and allow_extra is False:
+            extra = set(arg_params) - set(self._param_names)
+            if extra:
+                raise MXNetError(f"extra parameters: {sorted(extra)}")
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # --------------------------------------------------------- optimizer --
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        if kvstore:
+            from .. import kvstore as kv_mod
+
+            if isinstance(kvstore, str):
+                kvstore = kv_mod.create(kvstore)
+            self._kvstore = kvstore
+            self._update_on_kvstore = kvstore.is_capable("optimizer")
+            if self._update_on_kvstore:
+                kvstore.set_optimizer(optimizer)
+            for idx, name in enumerate(self._param_names):
+                kvstore.init(name, self._exec.arg_dict[name])
+        self.optimizer_initialized = True
+
+    # ----------------------------------------------------------- execute --
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        labels = data_batch.label or []
+        for name, arr in zip(self._label_names, labels):
+            if name in self._exec.arg_dict:
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """parity: model.py:154 _update_params_on_kvstore."""
+        assert self.optimizer_initialized
+        for idx, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.push(name, grad)
+                self._kvstore.pull(name, out=weight)
+            else:
+                if self._kvstore is not None:
+                    self._kvstore.push(name, grad)
+                    self._kvstore.pull(name, out=grad)
+                self._updater(idx, grad, weight)
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self._inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, monitor):
+        monitor.install(self._exec)
+
+    # -------------------------------------------------------- checkpoint --
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """parity: module.py save_checkpoint → model.save_checkpoint."""
+        from .. import model as model_mod
+
+        arg, aux = self.get_params()
+        model_mod.save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    def save_optimizer_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """parity: module.py Module.load."""
+        from .. import model as model_mod
+
+        sym, args, auxs = model_mod.load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (args, auxs)
+        mod._preload_opt_states = (f"{prefix}-{epoch:04d}.states"
+                                   if load_optimizer_states else None)
+        return mod
+
+    def _maybe_preloaded(self):
+        return getattr(self, "_preloaded", None)
+
+    # -------------------------------------------------------- properties --
+    @property
+    def data_names(self):
+        return list(self._data_names)
+
+    @property
+    def label_names(self):
+        return list(self._label_names)
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self.output_names, self._exec.outputs)] \
+            if self._exec and self._exec.outputs else None
+
+    def init_params_from_preload(self, initializer=None):
+        pre = self._maybe_preloaded()
+        if pre is not None:
+            self.init_params(initializer=initializer, arg_params=pre[0],
+                             aux_params=pre[1], force_init=True)
+            if getattr(self, "_preload_opt_states", None):
+                self.load_optimizer_states(self._preload_opt_states)
+
+    def fit(self, train_data, **kwargs):
+        """fit honoring Module.load's preloaded params (parity:
+        base_module.fit arg_params plumbing)."""
+        pre = self._maybe_preloaded()
+        if pre is not None and "arg_params" not in kwargs:
+            kwargs["arg_params"] = pre[0]
+            kwargs["aux_params"] = pre[1]
+            kwargs.setdefault("allow_missing", False)
+        return super().fit(train_data, **kwargs)
+
+
+def _as_desc(d, names, i):
+    if isinstance(d, DataDesc):
+        return d
+    if isinstance(d, tuple) and len(d) == 2 and isinstance(d[0], str):
+        return DataDesc(d[0], tuple(d[1]))
+    name = names[i] if i < len(names) else f"input{i}"
+    return DataDesc(name, tuple(d))
+
+
+def _set_like(dst, src):
+    """Write src into dst matching dtype and placement (initializers
+    produce host values; executor arrays stay on their context device)."""
+    from ..ndarray import NDArray, array
+
+    dst._rebind_like(src if isinstance(src, NDArray) else array(src))

@@ -248,6 +248,22 @@ class NDArray:
         self._tape_node = None
         engine.maybe_sync([new_data])
 
+    def _rebind_like(self, value):
+        """Rebind from `value`, matching this array's dtype AND placement
+        (device_put with the existing sharding — preserves mesh-sharded
+        layouts, unlike a bare single-device device_put)."""
+        import jax
+
+        raw = value._data if isinstance(value, NDArray) else value
+        if str(raw.dtype) != str(self._data.dtype):
+            raw = raw.astype(self._data.dtype)
+        try:
+            if raw.sharding != self._data.sharding:
+                raw = jax.device_put(raw, self._data.sharding)
+        except (AttributeError, ValueError):
+            pass  # tracers / abstract values: leave placement to jit
+        self._rebind(raw)
+
     def __setitem__(self, key, value):
         import jax.numpy as jnp
 

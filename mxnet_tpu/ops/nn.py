@@ -301,14 +301,68 @@ def _softmax_activation(data, mode="instance"):
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
+                         use_ignore, normalization, out_grad, smooth_alpha,
+                         axis):
+    return jax.nn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, out_grad, smooth_alpha,
+                        axis):
+    out = jax.nn.softmax(data, axis=axis)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, multi_output, use_ignore,
+                        normalization, out_grad, smooth_alpha, axis, res,
+                        cot):
+    """The reference's hand-written CE gradient (`softmax_output-inl.h`):
+    d(data) = (softmax - onehot(label)) * grad_scale, with ignore-label
+    masking and batch/valid normalization; head gradients are ignored
+    unless out_grad=True (loss-head semantics)."""
+    out, label = res
+    num_classes = out.shape[axis]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), num_classes, axis=axis,
+                            dtype=out.dtype)
+    if smooth_alpha:
+        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / max(
+            num_classes - 1, 1) * (1.0 - onehot)
+    g = out - onehot
+    valid = None
+    if use_ignore:
+        valid = (label != ignore_label).astype(out.dtype)
+        g = g * jnp.expand_dims(valid, axis=axis)
+    if normalization == "batch":
+        g = g / label.shape[0]
+    elif normalization == "valid":
+        count = (jnp.sum(valid) if valid is not None
+                 else jnp.asarray(label.size, out.dtype))
+        g = g / jnp.maximum(count, 1.0)
+    g = g * grad_scale
+    if out_grad:
+        g = g * cot
+    return g.astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
 @register("SoftmaxOutput", aliases=("Softmax",))
 def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                     multi_output=False, use_ignore=False, preserve_shape=False,
                     normalization="null", out_grad=False, smooth_alpha=0.0):
-    """Forward = softmax. The custom CE backward of the reference
-    (`softmax_output.cc`) is realized by `SoftmaxCrossEntropyLoss` at the
-    Gluon layer; Module-path users get it via the loss-fused train step."""
-    return jax.nn.softmax(data, axis=-1 if not multi_output else 1)
+    """Forward = softmax; backward = the reference's custom cross-entropy
+    gradient (p - onehot(label)) * grad_scale (`softmax_output.cc`), so the
+    symbolic Module path trains exactly like the reference."""
+    axis = 1 if multi_output else -1
+    return _softmax_output_core(data, label, grad_scale, ignore_label,
+                                multi_output, use_ignore, normalization,
+                                out_grad, smooth_alpha, axis)
 
 
 @register("Activation")

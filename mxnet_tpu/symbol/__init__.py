@@ -1,0 +1,42 @@
+"""mx.sym — symbolic API surface.
+
+Parity target: `python/mxnet/symbol/` — every registered op is exposed as
+a composition function (the reference generates these from the op registry
+at install time; here they are built at import from `ops/registry.py`).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from .symbol import (Symbol, var, Variable, Group, load, load_json,
+                     zeros, ones, arange)
+from .symbol import _apply_op
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange", "invoke"]
+
+
+def invoke(op_name, *inputs, **kwargs):
+    """Symbolic analogue of `mx.nd.invoke` — the F-protocol entry point
+    used by HybridBlock tracing (F.invoke(...))."""
+    return _apply_op(op_name, [i for i in inputs if i is not None], kwargs)
+
+
+def _make_wrapper(op_name):
+    def wrapper(*args, **kwargs):
+        return _apply_op(op_name, list(args), kwargs)
+
+    wrapper.__name__ = op_name
+    wrapper.__qualname__ = op_name
+    wrapper.__doc__ = (_registry.get(op_name).fn.__doc__ or
+                       f"symbolic wrapper for op {op_name!r}")
+    return wrapper
+
+
+_mod = _sys.modules[__name__]
+for _name in _registry.list_ops():
+    _op = _registry.get(_name)
+    for _exposed in (_name,) + _op.aliases:
+        if not hasattr(_mod, _exposed):
+            setattr(_mod, _exposed, _make_wrapper(_name))

@@ -1,0 +1,884 @@
+"""Symbol: the lazy op-graph half of the two programming models.
+
+Parity target: `python/mxnet/symbol/symbol.py` (compose :~500, infer_shape,
+`simple_bind` :1666, json save :1334, `load`) over nnvm Symbol/Graph
+(`3rdparty/tvm/nnvm`). The reference builds an nnvm DAG of op nodes whose
+attributes (FInferShape/FGradient/FCompute) drive GraphExecutor.
+
+TPU-native redesign: a Symbol is a pure-Python DAG over the same op
+registry the imperative path uses (`ops/registry.py`). "bind" does not
+build executors node-by-node — the whole graph lowers to ONE pure JAX
+function (topological walk applying each op's jax fn) which XLA compiles
+into a single fused executable per (shape, train-mode) signature. Memory
+planning, op fusion and bulking (`src/nnvm/plan_memory.cc:330`,
+`GraphExecutor::InitOpSegs`) are all subsumed by XLA compilation.
+
+Training-dependent behaviour (BatchNorm stats, Dropout) is NOT baked into
+the graph: the eval function takes a `training` flag and an rng key, and
+ops whose signature declares `training` / `key` get them injected at that
+point — the analogue of the reference's `is_train` executor flag and
+kRandom resource.
+
+Auxiliary states (BatchNorm moving stats) follow the reference contract:
+they are graph inputs that are functionally updated during a training
+forward; the new values are returned as extra outputs and written back by
+the Executor (`attach aux-state writeback`, `graph_executor.cc`).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError, canonical_dtype, name_manager
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+
+# --------------------------------------------------------------------------
+# auto-created parameter inputs per layer op: (arg_name, is_aux, skip_if)
+# skip_if is a predicate over the static attrs.
+_LAYER_PARAMS = {
+    "FullyConnected": [("weight", False, None),
+                       ("bias", False, lambda a: a.get("no_bias", False))],
+    "Convolution": [("weight", False, None),
+                    ("bias", False, lambda a: a.get("no_bias", False))],
+    "Deconvolution": [("weight", False, None),
+                      ("bias", False, lambda a: a.get("no_bias", True))],
+    "BatchNorm": [("gamma", False, None), ("beta", False, None),
+                  ("moving_mean", True, None), ("moving_var", True, None)],
+    "LayerNorm": [("gamma", False, None), ("beta", False, None)],
+    "GroupNorm": [("gamma", False, None), ("beta", False, None)],
+    "InstanceNorm": [("gamma", False, None), ("beta", False, None)],
+    "Embedding": [("weight", False, None)],
+    "RNN": [("params", False, None)],
+    "LeakyReLU": [("gamma", False,
+                   lambda a: a.get("act_type", "leaky") != "prelu")],
+}
+
+# signature params that are array inputs even though they default to None
+_OPTIONAL_ARRAY_PARAMS = frozenset(
+    {"bias", "gamma", "beta", "moving_mean", "moving_var", "weight",
+     "state", "state_cell", "label", "data_lengths", "label_lengths",
+     "sequence_length", "lhs", "rhs", "mean", "var", "grad", "mom",
+     "condition", "index", "indices", "a", "b", "x", "y", "data"})
+
+# runtime-injected params — never graph inputs, never static attrs
+_RUNTIME_PARAMS = frozenset({"key", "training"})
+
+
+def _sig_params(op):
+    try:
+        return list(inspect.signature(op.fn).parameters.values())
+    except (TypeError, ValueError):
+        return []
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_id")
+
+    def __init__(self, op, name, attrs=None, inputs=(), num_outputs=1):
+        self.op = op                  # registry op name, or None = variable
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)    # [(node, out_idx), ...]
+        self.num_outputs = num_outputs
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+    @property
+    def is_aux(self):
+        return self.is_var and self.attrs.get("__is_aux__", False)
+
+
+def _topo(entries):
+    """Post-order unique node list for the subgraph feeding `entries`."""
+    seen = set()
+    order = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child, _ in node.inputs:
+            visit(child)
+        order.append(node)
+
+    for node, _ in entries:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """An output list over the graph (parity: symbol.py Symbol).
+
+    `_entries` is a list of (node, out_index); most symbols have one.
+    """
+
+    def __init__(self, entries):
+        self._entries = list(entries)
+
+    # ------------------------------------------------------------ basics --
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._entries)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for e in self._entries:
+                if _output_name(e) == index or e[0].name == index:
+                    return Symbol([e])
+            raise ValueError(f"no output named {index!r}; outputs: "
+                             f"{self.list_outputs()}")
+        if isinstance(index, slice):
+            return Symbol(self._entries[index])
+        return Symbol([self._entries[index]])
+
+    def __repr__(self):
+        return f"<Symbol {self.name or 'group'}>"
+
+    def __copy__(self):
+        return Symbol(self._entries)
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # ------------------------------------------------------- graph lists --
+    def list_arguments(self):
+        return [n.name for n in _topo(self._entries)
+                if n.is_var and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in _topo(self._entries) if n.is_aux]
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._entries) if n.is_var]
+
+    def list_outputs(self):
+        return [_output_name(e) for e in self._entries]
+
+    def get_internals(self):
+        """Every node output as a group (parity: symbol.py get_internals)."""
+        entries = []
+        for node in _topo(self._entries):
+            for i in range(node.num_outputs):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        nodes = {id(n): n for n, _ in self._entries}
+        child_entries = []
+        for n in nodes.values():
+            child_entries.extend(n.inputs)
+        return Symbol(child_entries) if child_entries else None
+
+    # -------------------------------------------------------------- attrs --
+    def attr(self, key):
+        if len(self._entries) == 1:
+            value = self._entries[0][0].attrs.get(key)
+            return None if value is None else str(value)
+        return None
+
+    def list_attr(self):
+        if len(self._entries) == 1:
+            return {k: str(v) for k, v in self._entries[0][0].attrs.items()}
+        return {}
+
+    def attr_dict(self):
+        out = {}
+        for node in _topo(self._entries):
+            if node.attrs:
+                out[node.name] = {k: str(v) for k, v in node.attrs.items()}
+        return out
+
+    def _set_attr(self, **kwargs):
+        for e in self._entries:
+            e[0].attrs.update(kwargs)
+
+    # -------------------------------------------------------- shape/type --
+    def infer_shape(self, **kwargs):
+        """Forward shape inference (parity: symbol.py infer_shape).
+
+        Known input shapes propagate through the graph; layer-op parameter
+        shapes (weights/biases/stats) are derived from their data input via
+        per-op rules — the practical core of the reference's bidirectional
+        FInferShape fixed point.
+        Returns (arg_shapes, out_shapes, aux_shapes) in
+        list_arguments()/list_outputs()/list_auxiliary_states() order.
+        """
+        try:
+            shapes, _ = self._infer(kwargs, {})
+        except MXNetError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - inference failure surface
+            raise MXNetError(f"infer_shape failed: {exc}") from exc
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes["var", n] for n in self.list_arguments()]
+        aux_shapes = [shapes["var", n] for n in self.list_auxiliary_states()]
+        out_shapes = [shapes[e] for e in
+                      ((id(n), i) for n, i in self._entries)]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, **kwargs):
+        try:
+            return self.infer_shape(**kwargs)
+        except MXNetError:
+            return None, None, None
+
+    def infer_type(self, **kwargs):
+        dtypes = {k: canonical_dtype(v) for k, v in kwargs.items()}
+        try:
+            _, types = self._infer({}, dtypes)
+            arg_t = [types["var", n] for n in self.list_arguments()]
+            aux_t = [types["var", n] for n in self.list_auxiliary_states()]
+            out_t = [types[(id(n), i)] for n, i in self._entries]
+            return arg_t, out_t, aux_t
+        except Exception:  # noqa: BLE001 — fall back to shape-free inference
+            return self._infer_type_only(dtypes)
+
+    def _infer_type_only(self, dtype_hints):
+        """Shape-free dtype propagation (the reference's FInferType works
+        without shapes; here: numpy promotion + explicit dtype attrs)."""
+        import numpy as np
+
+        types = {}
+        for node in _topo(self._entries):
+            if node.is_var:
+                types[id(node), 0] = np.dtype(canonical_dtype(
+                    dtype_hints.get(node.name,
+                                    node.attrs.get("__dtype__", "float32"))))
+                continue
+            if "dtype" in node.attrs and node.attrs["dtype"] is not None:
+                dt = np.dtype(canonical_dtype(node.attrs["dtype"]))
+            else:
+                in_ts = [types[id(c), oi] for c, oi in node.inputs]
+                dt = (np.result_type(*in_ts) if in_ts
+                      else np.dtype("float32"))
+            for i in range(node.num_outputs):
+                types[id(node), i] = dt
+        arg_t = [types[id(n), 0] for n in _topo(self._entries)
+                 if n.is_var and not n.is_aux]
+        aux_t = [types[id(n), 0] for n in _topo(self._entries) if n.is_aux]
+        out_t = [types[id(n), i] for n, i in self._entries]
+        return arg_t, out_t, aux_t
+
+    def _infer(self, shape_hints, dtype_hints):
+        """Shared shape+dtype inference walk. Returns (shapes, dtypes) maps
+        keyed by ("var", name) for inputs and (node_id, out_idx) for
+        intermediate outputs."""
+        import jax
+
+        shapes = {}
+        dtypes = {}
+        vals = {}  # (node_id, out_idx) -> ShapeDtypeStruct
+
+        def var_struct(node):
+            shape = shape_hints.get(node.name, node.attrs.get("__shape__"))
+            dtype = dtype_hints.get(node.name,
+                                    node.attrs.get("__dtype__", "float32"))
+            if shape is None:
+                return None
+            return jax.ShapeDtypeStruct(tuple(shape), canonical_dtype(dtype))
+
+        for node in _topo(self._entries):
+            if node.is_var:
+                st = var_struct(node)
+                if st is not None:
+                    vals[id(node), 0] = st
+                    shapes["var", node.name] = tuple(st.shape)
+                    shapes[id(node), 0] = tuple(st.shape)
+                    dtypes["var", node.name] = st.dtype
+                    dtypes[id(node), 0] = st.dtype
+                continue
+            in_structs = []
+            data_struct = None
+            for child, oi in node.inputs:
+                st = vals.get((id(child), oi))
+                if st is not None and data_struct is None:
+                    data_struct = st
+                in_structs.append((child, oi, st))
+            # resolve unknown parameter-var inputs from the data input
+            rules = _param_shape_rules(node, data_struct)
+            resolved = []
+            for child, oi, st in in_structs:
+                if st is None:
+                    if child.is_var and child.name in rules:
+                        st = jax.ShapeDtypeStruct(
+                            rules[child.name],
+                            canonical_dtype(
+                                dtype_hints.get(
+                                    child.name,
+                                    child.attrs.get("__dtype__",
+                                                    "float32"))))
+                        vals[id(child), 0] = st
+                        shapes["var", child.name] = tuple(st.shape)
+                        shapes[id(child), 0] = tuple(st.shape)
+                        dtypes["var", child.name] = st.dtype
+                        dtypes[id(child), 0] = st.dtype
+                    else:
+                        raise MXNetError(
+                            f"cannot infer shape of input {child.name!r} "
+                            f"to op {node.name!r} ({node.op})")
+                resolved.append(st)
+            outs = _eval_shape_node(node, resolved)
+            for i, st in enumerate(outs):
+                vals[id(node), i] = st
+                shapes[id(node), i] = tuple(st.shape)
+                dtypes[id(node), i] = st.dtype
+        return shapes, dtypes
+
+    # --------------------------------------------------------------- eval --
+    def _build_eval(self):
+        """The whole graph as one pure function:
+        fn(arg_vals: dict, aux_vals: dict, rng_key, training)
+          -> (out_raws: list, new_aux: dict)
+        """
+        order = _topo(self._entries)
+        entries = [(id(n), i) for n, i in self._entries]
+
+        def run(arg_vals, aux_vals, rng_key, training):
+            import jax
+
+            vals = {}
+            new_aux = {}
+            for node in order:
+                if node.is_var:
+                    if node.is_aux:
+                        vals[id(node), 0] = aux_vals[node.name]
+                    else:
+                        vals[id(node), 0] = arg_vals[node.name]
+                    continue
+                op = _registry.get(node.op)
+                in_raws = [vals[id(c), oi] for c, oi in node.inputs]
+                kwargs = dict(node.attrs)
+                sig_names = [p.name for p in _sig_params(op)]
+                is_train = training and not kwargs.get("use_global_stats",
+                                                       False)
+                if "training" in sig_names:
+                    kwargs["training"] = is_train
+                if "key" in sig_names and "key" not in kwargs:
+                    # random/dropout ops draw from the threaded key stream
+                    # (reference: Resource kRandom attached per node)
+                    rng_key, sub = jax.random.split(rng_key)
+                    kwargs["key"] = sub
+                out = op.fn(*in_raws, **kwargs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for i, o in enumerate(outs):
+                    vals[id(node), i] = o
+                if node.op == "BatchNorm" and is_train:
+                    _bn_aux_update(node, outs, aux_vals, new_aux)
+            return [vals[e] for e in entries], new_aux
+
+        return run
+
+    def eval_nd(self, feed, aux_handles=None):
+        """Evaluate with NDArrays THROUGH the imperative op path, so the
+        autograd tape records every node and parameter NDArrays receive
+        gradients (the reference's SymbolBlock runs through the same
+        CachedOp/imperative machinery as any Gluon block).
+
+        feed maps input names (args AND aux) to NDArrays; training-mode aux
+        updates (BatchNorm moving stats) are written back into the handles
+        in `aux_handles` (or `feed`) via `cached_op.update_state`, which is
+        trace-safe under hybridize.
+        """
+        from .. import autograd
+        from .. import ndarray as nd_mod
+        from ..cached_op import update_state
+
+        aux_handles = aux_handles or {}
+        vals = {}
+        training = autograd.is_training()
+        for node in _topo(self._entries):
+            if node.is_var:
+                try:
+                    vals[id(node), 0] = feed[node.name]
+                except KeyError:
+                    raise MXNetError(
+                        f"eval is missing input {node.name!r}") from None
+                continue
+            op = _registry.get(node.op)
+            in_nds = [vals[id(c), oi] for c, oi in node.inputs]
+            kwargs = dict(node.attrs)
+            sig_names = [p.name for p in _sig_params(op)]
+            is_train = training and not kwargs.get("use_global_stats", False)
+            if "training" in sig_names and node.op != "Dropout":
+                kwargs["training"] = is_train
+            kwargs.pop("key", None)  # rng handled by the nd wrappers
+            out = getattr(nd_mod, node.op)(*in_nds, **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                vals[id(node), i] = o
+            if node.op == "BatchNorm" and is_train:
+                momentum = kwargs.get("momentum", 0.9)
+                for stat_idx, inp_idx in ((1, 3), (2, 4)):
+                    child, _ = node.inputs[inp_idx]
+                    handle = aux_handles.get(child.name, feed.get(child.name))
+                    if handle is None or not child.is_aux:
+                        continue
+                    with autograd.pause():
+                        batch = outs[stat_idx].astype(handle.dtype)
+                        update_state(handle, handle * momentum
+                                     + batch * (1 - momentum))
+        wrapped = [vals[id(n), i] for n, i in self._entries]
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+    def eval_with(self, feed, param_feed=None, training=False):
+        """Evaluate with NDArray feeds; returns NDArray or list of them.
+        (Used by SymbolBlock / Symbol.eval.)"""
+        from .. import random as _random
+        from ..ndarray import NDArray
+
+        all_feed = dict(feed)
+        if param_feed:
+            all_feed.update(param_feed)
+        raw = {k: (v._data if isinstance(v, NDArray) else _np.asarray(v))
+               for k, v in all_feed.items()}
+        aux_names = set(self.list_auxiliary_states())
+        args = {k: v for k, v in raw.items() if k not in aux_names}
+        auxs = {k: v for k, v in raw.items() if k in aux_names}
+        missing = [n for n in self.list_inputs() if n not in raw]
+        if missing:
+            raise MXNetError(f"eval is missing inputs: {missing}")
+        run = self._build_eval()
+        outs, _ = run(args, auxs, _random.next_key(), training)
+        wrapped = [NDArray(o) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+    def eval(self, ctx=None, **kwargs):
+        out = self.eval_with(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    # --------------------------------------------------------------- bind --
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        """Allocate all arguments from input shapes and bind
+        (parity: symbol.py:1666)."""
+        from ..context import current_context
+        from ..executor import Executor
+        from ..ndarray import NDArray
+
+        import jax.numpy as jnp
+
+        ctx = ctx or current_context()
+        shape_hints = {k: v for k, v in kwargs.items()
+                       if isinstance(v, (tuple, list))}
+        shapes, dtypes = self._infer(
+            shape_hints,
+            {k: canonical_dtype(v) for k, v in (type_dict or {}).items()})
+        arg_arrays = OrderedDict()
+        for name in self.list_arguments():
+            key = ("var", name)
+            if key not in shapes:
+                raise MXNetError(f"simple_bind: shape of {name!r} unknown")
+            arg_arrays[name] = NDArray(
+                jnp.zeros(shapes[key], dtypes[key]), ctx=ctx)
+        aux_arrays = OrderedDict()
+        for name in self.list_auxiliary_states():
+            aux_arrays[name] = NDArray(
+                jnp.zeros(shapes["var", name], dtypes["var", name]), ctx=ctx)
+        return Executor(self, ctx, arg_arrays, aux_arrays, grad_req)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **_ignored):
+        """Bind with caller-provided arrays (parity: symbol.py bind)."""
+        from ..context import current_context
+        from ..executor import Executor
+
+        ctx = ctx or current_context()
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = OrderedDict(zip(arg_names, args))
+        else:
+            args = OrderedDict((n, args[n]) for n in arg_names)
+        aux_names = self.list_auxiliary_states()
+        if aux_states is None:
+            aux_states = OrderedDict()
+        elif isinstance(aux_states, (list, tuple)):
+            aux_states = OrderedDict(zip(aux_names, aux_states))
+        else:
+            aux_states = OrderedDict((n, aux_states[n]) for n in aux_names)
+        return Executor(self, ctx, args, aux_states, grad_req,
+                        grad_arrays=args_grad)
+
+    # --------------------------------------------------------------- json --
+    def tojson(self):
+        order = _topo(self._entries)
+        node_index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            entry = {"op": n.op if n.op else "null", "name": n.name,
+                     "inputs": [[node_index[id(c)], oi, 0]
+                                for c, oi in n.inputs]}
+            if n.attrs:
+                entry["attrs"] = {k: _attr_str(v) for k, v in n.attrs.items()}
+            nodes.append(entry)
+        heads = [[node_index[id(n)], i, 0] for n, i in self._entries]
+        arg_nodes = [i for i, n in enumerate(order) if n.is_var]
+        return json.dumps(
+            {"nodes": nodes, "arg_nodes": arg_nodes, "heads": heads,
+             "attrs": {"mxnet_version": ["int", 10800],
+                       "framework": ["str", "mxnet_tpu"]}},
+            indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ---------------------------------------------------------- operators --
+    def __add__(self, other):
+        return _binary(self, other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _binary(self, other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _binary(self, other, "elemwise_sub", "_rminus_scalar",
+                       reverse=True)
+
+    def __mul__(self, other):
+        return _binary(self, other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _binary(self, other, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _binary(self, other, "elemwise_div", "_rdiv_scalar",
+                       reverse=True)
+
+    def __neg__(self):
+        return _apply_op("_mul_scalar", [self], {"scalar": -1.0})
+
+    def __pow__(self, other):
+        if isinstance(other, Symbol):
+            return _apply_op("broadcast_power", [self, other], {})
+        return _apply_op("_power_scalar", [self], {"scalar": other})
+
+    def __getattr__(self, item):
+        """Symbol.relu(), .reshape(...), .sum(...): op-as-method sugar,
+        mirroring the generated NDArray methods."""
+        if item.startswith("_"):
+            raise AttributeError(item)
+        try:
+            _registry.get(item)
+        except KeyError:
+            raise AttributeError(item) from None
+
+        def method(*args, **kwargs):
+            return _apply_op(item, [self, *args], kwargs)
+
+        method.__name__ = item
+        return method
+
+
+def _output_name(entry):
+    node, idx = entry
+    if node.is_var:
+        return node.name
+    if node.num_outputs == 1:
+        return f"{node.name}_output"
+    return f"{node.name}_output{idx}"
+
+
+def _attr_str(v):
+    return v if isinstance(v, str) else repr(v)
+
+
+def _parse_attr(s):
+    if not isinstance(s, str):
+        return s
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _bn_aux_update(node, outs, aux_vals, new_aux):
+    """Functional moving-stat update for a training-mode BatchNorm node
+    (reference: aux writeback in the executor)."""
+    momentum = node.attrs.get("momentum", 0.9)
+    _, batch_mean, batch_var = outs[0], outs[1], outs[2]
+    for stat, inp_idx in (("mean", 3), ("var", 4)):
+        child, _ = node.inputs[inp_idx]
+        if not child.is_aux:
+            continue
+        old = new_aux.get(child.name, aux_vals[child.name])
+        batch = batch_mean if stat == "mean" else batch_var
+        new_aux[child.name] = (old * momentum
+                               + batch.astype(old.dtype) * (1 - momentum))
+
+
+def _eval_shape_node(node, in_structs):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    op = _registry.get(node.op)
+    kwargs = dict(node.attrs)
+    sig_names = [p.name for p in _sig_params(op)]
+    if "training" in sig_names:
+        kwargs["training"] = False
+    if "key" in sig_names and "key" not in kwargs:
+        key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        fn = functools.partial(op.fn, **kwargs)
+        out = jax.eval_shape(lambda k, *a: fn(*a, key=k),
+                             key_struct, *in_structs)
+    else:
+        out = jax.eval_shape(functools.partial(op.fn, **kwargs),
+                             *in_structs)
+    return out if isinstance(out, (tuple, list)) else (out,)
+
+
+def _param_shape_rules(node, data_struct):
+    """Parameter shapes derivable from the (first) data input — the
+    shape-inference rules of the reference's layer ops."""
+    if data_struct is None:
+        return {}
+    dshape = tuple(data_struct.shape)
+    attrs = node.attrs
+    rules = {}
+
+    def put(idx, shape):
+        child, _ = node.inputs[idx]
+        if child.is_var:
+            rules[child.name] = tuple(int(s) for s in shape)
+
+    op = node.op
+    if op == "FullyConnected":
+        num_hidden = attrs["num_hidden"]
+        flatten = attrs.get("flatten", True)
+        in_units = (int(_np.prod(dshape[1:])) if flatten else dshape[-1])
+        put(1, (num_hidden, in_units))
+        if len(node.inputs) > 2:
+            put(2, (num_hidden,))
+    elif op == "Convolution":
+        kernel = attrs.get("kernel", ())
+        num_filter = attrs["num_filter"]
+        num_group = attrs.get("num_group", 1)
+        put(1, (num_filter, dshape[1] // num_group) + tuple(kernel))
+        if len(node.inputs) > 2:
+            put(2, (num_filter,))
+    elif op == "Deconvolution":
+        kernel = attrs.get("kernel", ())
+        num_filter = attrs["num_filter"]
+        num_group = attrs.get("num_group", 1)
+        put(1, (dshape[1], num_filter // num_group) + tuple(kernel))
+        if len(node.inputs) > 2:
+            put(2, (num_filter,))
+    elif op in ("BatchNorm", "LeakyReLU"):
+        axis = attrs.get("axis", 1)
+        channels = dshape[axis if op == "BatchNorm" else 1]
+        for i in range(1, len(node.inputs)):
+            put(i, (channels,))
+    elif op in ("LayerNorm",):
+        axis = attrs.get("axis", -1)
+        for i in range(1, len(node.inputs)):
+            put(i, (dshape[axis],))
+    elif op in ("GroupNorm", "InstanceNorm"):
+        for i in range(1, len(node.inputs)):
+            put(i, (dshape[1],))
+    elif op == "Embedding":
+        put(1, (attrs["input_dim"], attrs["output_dim"]))
+    elif op == "RNN":
+        put(1, (_rnn_param_size(dshape, attrs),))
+    return rules
+
+
+def _rnn_param_size(dshape, attrs):
+    """Flat fused-parameter length (parity: rnn-inl.h GetRnnParamSize)."""
+    mode = attrs.get("mode", "lstm")
+    state_size = attrs["state_size"]
+    num_layers = attrs.get("num_layers", 1)
+    bidirectional = attrs.get("bidirectional", False)
+    ngates = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}[mode]
+    dirs = 2 if bidirectional else 1
+    input_size = dshape[2]
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        per_dir = ngates * state_size * (isz + state_size)  # W_x + W_h
+        per_dir += 2 * ngates * state_size                  # b_x + b_h
+        total += per_dir * dirs
+    return total
+
+
+# --------------------------------------------------------------------------
+# op application / composition
+def _as_symbol(x):
+    if isinstance(x, Symbol):
+        return x
+    return None
+
+
+def _binary(lhs, rhs, elemwise_op, scalar_op, reverse=False):
+    if isinstance(rhs, Symbol):
+        return _apply_op(elemwise_op, [lhs, rhs], {})
+    return _apply_op(scalar_op, [lhs], {"scalar": float(rhs)})
+
+
+def _apply_op(op_name, args, kwargs):
+    """Build an op node from Symbol args + static kwargs (the compose
+    primitive behind every `mx.sym.<op>` wrapper)."""
+    op = _registry.get(op_name)
+    name = kwargs.pop("name", None)
+    kwargs.pop("attr", None)
+    sig = _sig_params(op)
+    sig_names = [p.name for p in sig]
+
+    # map positional symbols onto signature array slots, in order
+    pos_syms = [a for a in args if isinstance(a, Symbol)]
+    sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+    static = {k: v for k, v in kwargs.items()
+              if not isinstance(v, Symbol) and k not in _RUNTIME_PARAMS}
+
+    if name is None:
+        hint = op_name.lower().lstrip("_")
+        name = name_manager.get(hint)
+
+    layer_params = {p[0]: p for p in _LAYER_PARAMS.get(op.name, ())}
+    inputs = []  # (sig_param_name, Symbol-or-None)
+    pos_iter = iter(pos_syms)
+    for p in sig:
+        if p.name in _RUNTIME_PARAMS or p.name in static:
+            continue
+        if p.name in sym_kwargs:
+            inputs.append((p.name, sym_kwargs.pop(p.name)))
+            continue
+        nxt = next(pos_iter, None)
+        if nxt is not None:
+            inputs.append((p.name, nxt))
+            continue
+        # exhausted explicit inputs: auto-create layer parameter vars
+        if p.name in layer_params:
+            pname, is_aux, skip = layer_params[p.name]
+            if skip is not None and skip(static):
+                continue
+            inputs.append((p.name, var(f"{name}_{pname}", is_aux=is_aux)))
+        elif p.default is inspect.Parameter.empty:
+            raise MXNetError(
+                f"op {op_name!r} missing required input {p.name!r}")
+        else:
+            break  # remaining params are statics with defaults
+    if sym_kwargs:
+        raise MXNetError(f"op {op_name!r}: unexpected symbol inputs "
+                         f"{sorted(sym_kwargs)}")
+
+    node = _Node(op.name, name, static,
+                 [(s._entries[0][0], s._entries[0][1])
+                  for _, s in inputs if s is not None],
+                 num_outputs=op.num_outputs or 1)
+    return Symbol([(node, i) for i in range(node.num_outputs)]) \
+        if node.num_outputs > 1 else Symbol([(node, 0)])
+
+
+# --------------------------------------------------------------------------
+# public constructors
+def var(name, attr=None, shape=None, dtype=None, init=None, is_aux=False,
+        **kwargs):
+    """A named graph input (parity: symbol.py var/Variable)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = _np.dtype(canonical_dtype(dtype)).name
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else repr(init)
+    if is_aux:
+        attrs["__is_aux__"] = True
+    attrs.update(kwargs)
+    return Symbol([(_Node(None, name, attrs), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):  # noqa: N802 - reference API name
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def zeros(shape, dtype="float32", name=None, **kwargs):
+    return _apply_op("_zeros", [], {"shape": shape, "dtype": dtype,
+                                    "name": name, **kwargs})
+
+
+def ones(shape, dtype="float32", name=None, **kwargs):
+    return _apply_op("_ones", [], {"shape": shape, "dtype": dtype,
+                                   "name": name, **kwargs})
+
+
+def arange(start, stop=None, step=1.0, dtype="float32", name=None, **kw):
+    return _apply_op("_arange", [], {"start": start, "stop": stop,
+                                     "step": step, "dtype": dtype,
+                                     "name": name, **kw})
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from graph JSON (parity: symbol.py load_json).
+    Also accepts reference-produced symbol.json for ops we implement."""
+    data = json.loads(json_str)
+    raw_nodes = data["nodes"]
+    built = []
+    for rn in raw_nodes:
+        attrs = {k: _parse_attr(v)
+                 for k, v in (rn.get("attrs") or rn.get("param") or
+                              rn.get("attr") or {}).items()}
+        op_name = rn["op"]
+        if op_name == "null":
+            node = _Node(None, rn["name"], attrs)
+        else:
+            op = _registry.get(op_name)
+            node = _Node(op.name, rn["name"], attrs,
+                         num_outputs=op.num_outputs or 1)
+        built.append(node)
+    for rn, node in zip(raw_nodes, built):
+        node.inputs = [(built[i], oi) for i, oi, *_ in rn["inputs"]]
+    _mark_aux(built)
+    heads = data.get("heads")
+    if heads:
+        entries = [(built[i], oi) for i, oi, *_ in heads]
+    else:
+        entries = [(built[-1], 0)]
+    return Symbol(entries)
+
+
+def _mark_aux(nodes):
+    """Mark aux-state variables by their consumer slots (the reference
+    derives this from FMutateInputs; here BatchNorm slots 3/4)."""
+    for node in nodes:
+        if node.op == "BatchNorm":
+            for idx in (3, 4):
+                if idx < len(node.inputs):
+                    child, _ = node.inputs[idx]
+                    if child.is_var:
+                        child.attrs["__is_aux__"] = True
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,238 @@
+"""Symbol API tests (parity model: tests/python/unittest/test_symbol.py +
+test_executor.py + test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def test_compose_and_listing():
+    mlp = _mlp()
+    assert mlp.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert mlp.list_outputs() == ["softmax_output"]
+    assert mlp.list_auxiliary_states() == []
+    assert mlp.name == "softmax"
+
+
+def test_auto_names_and_no_bias():
+    x = mx.sym.var("x")
+    fc = mx.sym.FullyConnected(x, num_hidden=3, no_bias=True)
+    args = fc.list_arguments()
+    assert args[0] == "x" and len(args) == 2  # no bias var created
+    assert args[1].endswith("_weight")
+
+
+def test_infer_shape():
+    mlp = _mlp()
+    arg_shapes, out_shapes, aux_shapes = mlp.infer_shape(
+        data=(8, 100), softmax_label=(8,))
+    assert arg_shapes == [(8, 100), (16, 100), (16,), (4, 16), (4,), (8,)]
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                              name="conv")
+    bn = mx.sym.BatchNorm(conv, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 8, 8))
+    args = bn.list_arguments()
+    shapes = dict(zip(args, arg_shapes))
+    assert shapes["conv_weight"] == (8, 3, 3, 3)
+    assert shapes["bn_gamma"] == (8,)
+    assert out_shapes[0] == (2, 8, 8, 8)
+    assert aux_shapes == [(8,), (8,)]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_infer_type():
+    x = mx.sym.var("x")
+    y = x.sum()
+    arg_t, out_t, _ = y.infer_type(x="float32")
+    assert np.dtype(out_t[0]) == np.float32
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b) * 2.0 - a / b
+    av = mx.nd.array(np.array([2.0, 4.0], np.float32))
+    bv = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    out = c.eval_with({"a": av, "b": bv})
+    np.testing.assert_allclose(out.asnumpy(), [4.0, 10.0], rtol=1e-6)
+
+
+def test_group_and_internals():
+    mlp = _mlp()
+    internals = mlp.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    grouped = mx.sym.Group([fc1, mlp])
+    assert len(grouped.list_outputs()) == 2
+
+
+def test_json_round_trip():
+    mlp = _mlp()
+    js = mlp.tojson()
+    loaded = mx.sym.load_json(js)
+    assert loaded.list_arguments() == mlp.list_arguments()
+    assert loaded.list_outputs() == mlp.list_outputs()
+    # and still executable with identical results
+    shapes = {"data": (4, 10), "softmax_label": (4,)}
+    ex1 = mlp.simple_bind(mx.cpu(), **shapes)
+    rng = np.random.RandomState(0)
+    feeds = {}
+    for name, arr in ex1.arg_dict.items():
+        feeds[name] = mx.nd.array(
+            rng.uniform(-1, 1, arr.shape).astype(np.float32))
+    ex2 = loaded.simple_bind(mx.cpu(), **shapes)
+    o1 = ex1.forward(is_train=False, **feeds)[0].asnumpy()
+    o2 = ex2.forward(is_train=False, **feeds)[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_bn_json_marks_aux():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    loaded = mx.sym.load_json(bn.tojson())
+    assert loaded.list_auxiliary_states() == ["bn_moving_mean",
+                                              "bn_moving_var"]
+
+
+def test_executor_forward_backward_matches_autograd():
+    """Symbolic grads == imperative autograd grads for the same graph."""
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(7)
+    xv = rng.uniform(-1, 1, (5, 6)).astype(np.float32)
+    wv = rng.uniform(-1, 1, (3, 6)).astype(np.float32)
+    bv = rng.uniform(-1, 1, (3,)).astype(np.float32)
+
+    x = mx.sym.var("x")
+    out = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    out = mx.sym.Activation(out, act_type="tanh")
+    ex = out.bind(mx.cpu(), {"x": mx.nd.array(xv), "fc_weight": mx.nd.array(wv),
+                             "fc_bias": mx.nd.array(bv)},
+                  grad_req={"fc_weight": "write", "x": "write"})
+    ex.forward(is_train=True)
+    ex.backward()
+
+    xi = mx.nd.array(xv)
+    wi = mx.nd.array(wv)
+    xi.attach_grad()
+    wi.attach_grad()
+    with autograd.record():
+        y = mx.nd.invoke("FullyConnected", xi, wi, mx.nd.array(bv),
+                         num_hidden=3).tanh()
+    y.backward()
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(),
+                               wi.grad.asnumpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               xi.grad.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_output_gradient():
+    """SoftmaxOutput backward = (p - onehot) * grad_scale (reference
+    softmax_output-inl.h custom gradient, not the softmax jacobian)."""
+    rng = np.random.RandomState(3)
+    logits = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    label = np.array([0, 2, 1, 4], np.float32)
+    sym = mx.sym.SoftmaxOutput(mx.sym.var("data"), mx.sym.var("label"),
+                               grad_scale=2.0, name="sm")
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(logits),
+                             "label": mx.nd.array(label)},
+                  grad_req={"data": "write"})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               (out - onehot) * 2.0, rtol=1e-5, atol=1e-6)
+
+
+def test_executor_grad_req_add_and_null():
+    x = mx.sym.var("x")
+    y = (x * 2.0).sum()
+    ex = y.bind(mx.cpu(), {"x": mx.nd.ones((3,))}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [4.0] * 3)
+    ex2 = y.bind(mx.cpu(), {"x": mx.nd.ones((3,))}, grad_req="null")
+    ex2.forward(is_train=True)
+    ex2.backward()  # no grads requested: must not fail
+    assert ex2.grad_arrays == [None]
+
+
+def test_executor_reshape():
+    mlp = _mlp()
+    ex = mlp.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    ex2 = ex.reshape(data=(8, 10), softmax_label=(8,))
+    out = ex2.forward(is_train=False,
+                      data=np.zeros((8, 10), np.float32),
+                      softmax_label=np.zeros((8,), np.float32))
+    assert out[0].shape == (8, 4)
+    # weights carried over
+    np.testing.assert_allclose(ex.arg_dict["fc1_weight"].asnumpy(),
+                               ex2.arg_dict["fc1_weight"].asnumpy())
+
+
+def test_bn_aux_update_in_training():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    ex = bn.simple_bind(mx.cpu(), data=(16, 4))
+    ex.arg_dict["bn_gamma"]._rebind(mx.nd.ones((4,))._data)
+    rng = np.random.RandomState(0)
+    x = (rng.rand(16, 4) * 2 + 3).astype(np.float32)
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)  # stats moved toward batch mean
+    expected = before * 0.5 + x.mean(axis=0) * 0.5
+    np.testing.assert_allclose(after, expected, rtol=1e-4)
+    # inference forward must NOT move stats
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               after, rtol=1e-6)
+
+
+def test_var_shape_dtype_hints():
+    x = mx.sym.var("x", shape=(2, 3), dtype="float32")
+    y = x * 3.0
+    arg_shapes, out_shapes, _ = y.infer_shape()
+    assert arg_shapes == [(2, 3)] and out_shapes == [(2, 3)]
+
+
+def test_symbol_save_load_file(tmp_path):
+    mlp = _mlp()
+    fname = str(tmp_path / "mlp-symbol.json")
+    mlp.save(fname)
+    loaded = mx.sym.load(fname)
+    assert loaded.list_arguments() == mlp.list_arguments()
+
+
+def test_symbol_op_method_sugar():
+    x = mx.sym.var("x")
+    y = x.reshape(shape=(2, 2)).sum()
+    out = y.eval_with({"x": mx.nd.array(np.arange(4, dtype=np.float32))})
+    assert float(out.asnumpy()) == 6.0
+
+
+def test_missing_input_error():
+    x = mx.sym.var("x")
+    y = x + mx.sym.var("y")
+    with pytest.raises(mx.MXNetError):
+        y.eval_with({"x": mx.nd.ones((2,))})
