@@ -32,11 +32,12 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
     """parity: src/operator/nn/fully_connected.cc. weight is (num_hidden, in)."""
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
+    # NOTE: no preferred_element_type=f32 here — the TPU MXU already
+    # accumulates bf16 matmuls in f32, and an explicit f32 output + astype
+    # breaks the vjp transpose (f32 cotangent vs bf16 operand).
     out = jax.lax.dot_general(
         data, weight,
-        dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+        dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())))
     if bias is not None and not no_bias:
         out = out + bias
     return out
@@ -76,9 +77,7 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+        feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out
@@ -107,9 +106,8 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad
     out = jax.lax.conv_general_dilated(
         data, jnp.flip(weight, axis=tuple(range(2, 2 + n))),
         window_strides=(1,) * n, padding=pads, lhs_dilation=stride,
-        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out
