@@ -1,16 +1,20 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 batched inference throughput on one chip.
+"""Headline benchmarks: ResNet-50 inference AND training throughput, one chip.
 
-Reference baseline (BASELINE.md / docs perf.md:196): ResNet-50 bs=128 fp32
-inference = 1233.15 img/s on 1x V100 (measured via
-example/image-classification/benchmark_score.py). This reproduces that
-benchmark's methodology — hybridized (compiled) scoring, batch 128, timed
-over repeated batches after warmup — on the TPU chip, in bfloat16 (the MXU's
-native input type; the fp16-on-V100 analogue is 2355.04 img/s).
+Reference baselines (BASELINE.md / docs perf.md): ResNet-50 bs=128 fp32 on
+1x V100 — inference 1233.15 img/s (perf.md:196, fp16 analogue 2355.04),
+training 363.69 img/s (perf.md:254, methodology of
+example/image-classification/train_imagenet.py --benchmark). Reproduced
+here in bfloat16 (the MXU's native input type).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline", ...}:
+  1. resnet50_v1_infer_bs128_bfloat16  (hybridized compiled scoring)
+  2. resnet50_v1_train_bs128_bfloat16  (ONE fused fwd+loss+bwd+SGD-momentum
+     executable via parallel.ShardedTrainer, incl. BN stat writeback;
+     extra fields: achieved_tflops + mfu vs BENCH_PEAK_TFLOPS, default 459
+     = v5p bf16 peak)
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
-BENCH_ITERS, BENCH_MODEL.
+BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS.
 """
 import json
 import os
@@ -57,7 +61,61 @@ def main():
         "value": round(throughput, 2),
         "unit": "img/s",
         "vs_baseline": round(throughput / baseline, 3),
-    }))
+    }), flush=True)
+
+    if not os.environ.get("BENCH_SKIP_TRAIN"):
+        bench_train(ctx, batch, dtype, iters, model)
+
+
+def bench_train(ctx, batch, dtype, iters, model):
+    """Training throughput: fused fwd+loss+bwd+SGD step (one executable)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    baseline = 363.69  # ResNet-50 bs=128 fp32 training on V100 (perf.md:254)
+    # forward GFLOP/img @224x224 per model; training ~= 3x forward
+    fwd_gflops = {"resnet50_v1": 4.09, "resnet50_v2": 4.09,
+                  "resnet18_v1": 1.82, "resnet101_v1": 7.8,
+                  "resnet152_v1": 11.5, "vgg16": 15.5, "alexnet": 0.71}
+    flops_per_img = 3 * fwd_gflops.get(model, 0.0) * 1e9
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", 459.0))
+
+    mx.random.seed(0)
+    net = vision.get_model(model, classes=1000)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if dtype != "float32":
+        net.cast(dtype)
+    x = mx.nd.random.uniform(shape=(batch, 3, 224, 224), ctx=ctx)
+    if dtype != "float32":
+        x = x.astype(dtype)
+    y = mx.nd.array(np.random.randint(0, 1000, batch).astype(np.float32),
+                    ctx=ctx)
+    net(x)  # materialize deferred shapes
+    trainer = ShardedTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
+        mesh=DeviceMesh({"dp": 1}))
+    trainer.step(x, y).wait_to_read()  # compile
+    trainer.step(x, y).wait_to_read()  # warm
+    start = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    elapsed = time.perf_counter() - start
+    throughput = batch * iters / elapsed
+    line = {
+        "metric": f"{model}_train_bs{batch}_{dtype}",
+        "value": round(throughput, 2),
+        "unit": "img/s",
+        "vs_baseline": round(throughput / baseline, 3),
+    }
+    if flops_per_img:  # only for models with a known FLOP count
+        achieved = throughput * flops_per_img / 1e12
+        line["achieved_tflops"] = round(achieved, 1)
+        line["mfu"] = round(achieved / peak_tflops, 3)
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

@@ -7,11 +7,13 @@ plan memory → attach op execs → pre-create engine ops → bulk segments)
 collapses here into XLA compilation of the graph's single pure function,
 cached per (input signature, train-mode).
 
-Backward is the jitted VJP of that function with rematerialisation: the
-forward recomputes inside the backward executable (the
-`MXNET_BACKWARD_DO_MIRROR` trade, the right default on TPU where HBM
-bandwidth, not FLOPs, is the bottleneck). The dropout/rng key drawn at
-`forward` is reused by `backward`, so recomputed masks match exactly.
+A training-mode `forward` computes outputs AND the VJP residuals in one
+executable (`jax.vjp` inside jit; the pullback crosses the jit boundary
+as a pytree). `backward` then just applies the jitted pullback — the
+forward is NOT recomputed, matching `GraphExecutor::Forward`/`Backward`
+(`src/executor/graph_executor.cc:81,95`) where backward consumes stored
+forward activations. The dropout/rng key drawn at `forward` is shared
+with the residuals, so masks match exactly.
 """
 from __future__ import annotations
 
@@ -63,6 +65,7 @@ class Executor:
         self._jit = {}
         self.outputs = []
         self._last = None  # (args_raw, auxs_raw, key) from latest forward
+        self._pull = None  # stored VJP pullback from latest train forward
 
     def _normalize_req(self, grad_req):
         if isinstance(grad_req, str):
@@ -82,29 +85,37 @@ class Executor:
         if fn is not None:
             return fn
         run = self._run
-        if kind == "fwd":
+        diff_names = tuple(sorted(
+            n for n, r in self._grad_req.items() if r != "null"))
+        if kind == "fwd" and training and diff_names:
+            # Forward + residual capture in one executable: the returned
+            # pullback is a pytree of residual arrays, applied by the
+            # jitted `pull` executable at backward time (no recompute).
+            def fwd_train(diff_args, rest_args, auxs, rng):
+                def f(d):
+                    merged = dict(rest_args)
+                    merged.update(d)
+                    outs, new_aux = run(merged, auxs, rng, True)
+                    return tuple(outs), new_aux
+
+                outs, pull, new_aux = jax.vjp(f, dict(diff_args),
+                                              has_aux=True)
+                return outs, new_aux, pull
+
+            fn = jax.jit(fwd_train)
+            fn.diff_names = diff_names
+        elif kind == "fwd":
             def fwd(args, auxs, rng):
                 outs, new_aux = run(args, auxs, rng, training)
                 return tuple(outs), new_aux
 
             fn = jax.jit(fwd)
-        else:
-            diff_names = tuple(sorted(
-                n for n, r in self._grad_req.items() if r != "null"))
-
-            def bwd(diff_args, rest_args, auxs, rng, cots):
-                def f(d):
-                    merged = dict(rest_args)
-                    merged.update(d)
-                    outs, _ = run(merged, auxs, rng, True)
-                    return tuple(outs)
-
-                _, pull = jax.vjp(f, dict(diff_args))
+            fn.diff_names = ()
+        else:  # kind == "pull": apply a stored pullback to cotangents
+            def apply_pull(pull, cots):
                 return pull(tuple(cots))[0]
 
-            bwd.diff_names = diff_names
-            fn = jax.jit(bwd)
-            fn.diff_names = diff_names
+            fn = jax.jit(apply_pull)
         self._jit[key] = fn
         return fn
 
@@ -133,7 +144,16 @@ class Executor:
         auxs = {n: a._data for n, a in self._aux_dict.items()}
         rng = _random.next_key()
         fwd = self._exe("fwd", self._sig(), bool(is_train))
-        outs, new_aux = fwd(args, auxs, rng)
+        self._pull = None  # free previous residuals before the new forward
+        if fwd.diff_names:
+            diff_args = {n: args[n] for n in fwd.diff_names}
+            rest_args = {n: v for n, v in args.items()
+                         if n not in fwd.diff_names}
+            outs, new_aux, pull = fwd(diff_args, rest_args, auxs, rng)
+            self._pull = pull
+        else:
+            outs, new_aux = fwd(args, auxs, rng)
+            self._pull = None
         if is_train:
             for name, raw in new_aux.items():
                 self._aux_dict[name]._rebind(raw)
@@ -149,18 +169,22 @@ class Executor:
 
         if self._last is None:
             raise MXNetError("backward called before forward")
-        args, auxs, rng = self._last
+        if not any(r != "null" for r in self._grad_req.values()):
+            return  # nothing to differentiate
+        if self._pull is None:
+            # reference parity: Backward requires a training-mode Forward
+            # (graph_executor.cc:95 CHECK on grad arrays)
+            raise MXNetError("backward requires forward(is_train=True)")
         if out_grads is None:
             cots = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
         else:
             if not isinstance(out_grads, (list, tuple)):
                 out_grads = [out_grads]
             cots = [_as_nd(g)._data for g in out_grads]
-        bwd = self._exe("bwd", self._sig(), True)
-        diff_names = bwd.diff_names
-        diff_args = {n: args[n] for n in diff_names}
-        rest_args = {n: v for n, v in args.items() if n not in diff_names}
-        grads = bwd(diff_args, rest_args, auxs, rng, tuple(cots))
+        pull_exe = self._exe("pull", self._sig(), True)
+        diff_names = tuple(sorted(
+            n for n, r in self._grad_req.items() if r != "null"))
+        grads = pull_exe(self._pull, tuple(cots))
         for name in diff_names:
             req = self._grad_req[name]
             g = grads[name]

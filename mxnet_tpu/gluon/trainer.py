@@ -136,6 +136,8 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        # gather live params, then ONE fused multi-tensor update executable
+        indices, weights, grads, states = [], [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -152,9 +154,14 @@ class Trainer:
                     "intentionally only using a subset, call step with "
                     "ignore_stale_grad=True to suppress this warning and "
                     "skip updating of Parameters with stale gradient")
-            self._optimizer.update_multi_precision(
-                i, data, param.grad(), self._states[i])
+            indices.append(i)
+            weights.append(data)
+            grads.append(param.grad())
+            states.append(self._states[i])
             data._fresh_grad = False
+        if indices:
+            self._optimizer.fused_update_multi(indices, weights, grads,
+                                               states)
 
     def save_states(self, fname):
         """parity: trainer.py:468."""

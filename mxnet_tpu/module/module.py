@@ -174,21 +174,34 @@ class Module(BaseModule):
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
-        """parity: model.py:154 _update_params_on_kvstore."""
+        """parity: model.py:154 _update_params_on_kvstore.
+
+        Off-kvstore updates are batched into ONE multi-tensor executable
+        (Optimizer.fused_update_multi) instead of a per-parameter loop —
+        a train step costs a single update dispatch.
+        """
         assert self.optimizer_initialized
+        if self._kvstore is not None and self._update_on_kvstore:
+            for name in self._param_names:
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._kvstore.push(name, grad)
+                self._kvstore.pull(name, out=self._exec.arg_dict[name])
+            return
+        indices, grads, weights = [], [], []
         for idx, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
-            weight = self._exec.arg_dict[name]
-            if self._kvstore is not None and self._update_on_kvstore:
+            if self._kvstore is not None:
                 self._kvstore.push(name, grad)
-                self._kvstore.pull(name, out=weight)
-            else:
-                if self._kvstore is not None:
-                    self._kvstore.push(name, grad)
-                    self._kvstore.pull(name, out=grad)
-                self._updater(idx, grad, weight)
+                self._kvstore.pull(name, out=grad)
+            indices.append(idx)
+            grads.append(grad)
+            weights.append(self._exec.arg_dict[name])
+        if indices:
+            self._updater.update_multi(indices, grads, weights)
 
     def get_outputs(self, merge_multi_context=True):
         return list(self._exec.outputs)

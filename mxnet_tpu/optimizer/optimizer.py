@@ -56,6 +56,7 @@ class Optimizer:
         self.idx2name = param_idx2name.copy()
         self.sym_info = ()
         self.param_dict = param_dict if param_dict else {}
+        self._fused_cache = {}
         self.set_lr_mult({})
         self.set_wd_mult({})
 
@@ -96,6 +97,29 @@ class Optimizer:
             weight._rebind(weight32.astype(weight.dtype)._data)
         else:
             self.update(index, weight, grad, state)
+
+    def fused_update_multi(self, indices, weights, grads, states):
+        """Update many parameters at once (multi-tensor apply).
+
+        parity: the reference's aggregated updates (`multi_sgd_mom_update`,
+        `src/operator/optimizer_op.cc:278`, used when `aggregate_num > 0`).
+        Base implementation is the per-parameter loop; SGD/NAG/Adam override
+        it with ONE XLA executable covering every parameter, so a train step
+        costs a single dispatch instead of hundreds.
+        """
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, s)
+
+    def _fused_common(self, indices, weights):
+        """Shared preamble for fused overrides. Returns (lrs, wds, clip), or
+        None when the multi-precision state layout forces the per-param
+        loop."""
+        if self.multi_precision and any(
+                str(w.dtype) in ("float16", "bfloat16") for w in weights):
+            return None
+        self._update_count(list(indices))
+        return (self._get_lrs(indices), self._get_wds(indices),
+                self.clip_gradient if self.clip_gradient else -1.0)
 
     # ------------------------------------------------------------- mults ---
     def set_learning_rate(self, lr):
@@ -176,16 +200,80 @@ class Optimizer:
     def __getstate__(self):
         ret = self.__dict__.copy()
         # do not serialize live Parameters (parity: optimizer.py:510-514)
+        # nor compiled executables
         del ret["param_dict"]
+        ret.pop("_fused_cache", None)
         return ret
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self.__dict__.setdefault("param_dict", {})
+        self.__dict__.setdefault("_fused_cache", {})
 
 
 register = Optimizer.register
 create = Optimizer.create_optimizer
+
+
+def _fused_apply(opt, kernel_fn, weights, grads, state_tuples, lrs, wds,
+                 static_kwargs, cache_tag):
+    """Run `kernel_fn(w, g, *states, lr=, wd=, **static)` for every parameter
+    inside ONE jitted executable, then write results back in place.
+
+    lr/wd enter as a traced vector, so lr-schedule changes do not retrace;
+    everything else (momentum, rescale, clip) is static.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    key = (cache_tag, tuple(sorted(static_kwargs.items())),
+           tuple((tuple(w.shape), str(w.dtype), len(st))
+                 for w, st in zip(weights, state_tuples)))
+    fn = opt._fused_cache.get(key)
+    if fn is None:
+        def step(ws, gs, sts, hyper):
+            outs = []
+            for i, (w, g, st) in enumerate(zip(ws, gs, sts)):
+                # hypers in weight dtype (scalar lr is baked into the
+                # kernel's arithmetic type in the reference too)
+                o = kernel_fn(w, g, *st, lr=hyper[0, i].astype(w.dtype),
+                              wd=hyper[1, i].astype(w.dtype), **static_kwargs)
+                outs.append(o if isinstance(o, tuple) else (o,))
+            return tuple(outs)
+
+        fn = jax.jit(step)
+        opt._fused_cache[key] = fn
+    hyper = jnp.asarray([lrs, wds], dtype=jnp.float32)
+    outs = fn(tuple(w._data for w in weights),
+              tuple(g._data for g in grads),
+              tuple(tuple(s._data for s in st) for st in state_tuples),
+              hyper)
+    for w, st, o in zip(weights, state_tuples, outs):
+        w._rebind(o[0])
+        for s, raw in zip(st, o[1:]):
+            s._rebind(raw)
+
+
+def _fused_sgd_like(opt, mom_kernel_name, indices, weights, grads, states):
+    """Fused multi-tensor update for the SGD family (SGD/NAG): momentum
+    kernel when momentum != 0, plain sgd_update otherwise. Returns False
+    when the caller must fall back to the per-param loop."""
+    pre = opt._fused_common(indices, weights)
+    if pre is None:
+        return False
+    lrs, wds, clip = pre
+    from ..ops import optimizer_op as _ops
+
+    static = {"rescale_grad": opt.rescale_grad, "clip_gradient": clip}
+    if opt.momentum != 0.0:
+        kernel = getattr(_ops, mom_kernel_name)
+        _fused_apply(opt, kernel.fn, weights, grads,
+                     [(s,) for s in states], lrs, wds,
+                     {**static, "momentum": opt.momentum}, kernel.name)
+    else:
+        _fused_apply(opt, _ops.sgd_update.fn, weights, grads,
+                     [() for _ in states], lrs, wds, static, "sgd")
+    return True
 
 
 def _invoke_update(op_name, weight, arrays, kwargs):
@@ -223,6 +311,11 @@ class SGD(Optimizer):
             state._rebind(mom_new._data)
         else:
             _invoke_update("sgd_update", weight, [grad], kwargs)
+
+    def fused_update_multi(self, indices, weights, grads, states):
+        if not _fused_sgd_like(self, "sgd_mom_update", indices, weights,
+                               grads, states):
+            super().fused_update_multi(indices, weights, grads, states)
 
 
 @register
@@ -442,6 +535,11 @@ class NAG(Optimizer):
         else:
             _invoke_update("sgd_update", weight, [grad], kwargs)
 
+    def fused_update_multi(self, indices, weights, grads, states):
+        if not _fused_sgd_like(self, "nag_mom_update", indices, weights,
+                               grads, states):
+            super().fused_update_multi(indices, weights, grads, states)
+
 
 @register
 class Adam(Optimizer):
@@ -475,6 +573,24 @@ class Adam(Optimizer):
                                "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0})
         mean._rebind(outs[0]._data)
         var._rebind(outs[1]._data)
+
+    def fused_update_multi(self, indices, weights, grads, states):
+        pre = self._fused_common(indices, weights)
+        if pre is None:
+            return super().fused_update_multi(indices, weights, grads, states)
+        lrs, wds, clip = pre
+        from ..ops import optimizer_op as _ops
+
+        # bias correction folded into lr on the host, per reference
+        lrs = [lr * math.sqrt(1.0 - self.beta2 ** self._index_update_count[i])
+               / (1.0 - self.beta1 ** self._index_update_count[i])
+               for lr, i in zip(lrs, indices)]
+        _fused_apply(self, _ops.adam_update.fn, weights, grads,
+                     [tuple(s) for s in states], lrs, wds,
+                     {"beta1": self.beta1, "beta2": self.beta2,
+                      "epsilon": self.epsilon,
+                      "rescale_grad": self.rescale_grad,
+                      "clip_gradient": clip}, "adam")
 
 
 @register
@@ -686,6 +802,18 @@ class Updater:
             self.states_synced[index] = True
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+
+    def update_multi(self, indices, grads, weights):
+        """Aggregated update over all parameters in one executable when the
+        optimizer supports it (parity: aggregate_num batching,
+        optimizer.py:2076)."""
+        for index, weight in zip(indices, weights):
+            if index not in self.states:
+                self.states[index] = \
+                    self.optimizer.create_state_multi_precision(index, weight)
+                self.states_synced[index] = True
+        self.optimizer.fused_update_multi(
+            indices, weights, grads, [self.states[i] for i in indices])
 
     def get_states(self, dump_optimizer=False):
         import pickle
