@@ -1,0 +1,75 @@
+"""AMP core: the trace-level cast hook.
+
+Parity target: `src/nnvm/low_precision_pass.cc` — the reference walks the
+nnvm graph inserting `amp_cast`/`amp_multicast` nodes around whitelisted /
+blacklisted ops. TPU-native, the same decision runs at trace time: both
+dispatch paths (imperative `ndarray._invoke` and the symbolic evaluator
+`symbol._build_eval`) call :func:`cast_inputs` on their raw arrays before
+invoking the op function, so the casts are traced into the executable and
+fused by XLA (a cast feeding an MXU matmul is free).
+
+Kept separate from the `amp` package so the hot dispatch path imports only
+this tiny module. `amp.init()` populates the op sets and flips ACTIVE;
+GEN is bumped on every (de)activation so executable caches keyed on it
+never serve a stale-precision compilation.
+"""
+from __future__ import annotations
+
+ACTIVE = False
+GEN = 0                 # bumped on every state change; part of jit cache keys
+TARGET_DTYPE = "bfloat16"
+TARGET_OPS = frozenset()
+FP32_OPS = frozenset()
+WIDEST_OPS = frozenset()
+
+_LOW = ("float16", "bfloat16")
+
+
+def configure(target_dtype, target_ops, fp32_ops, widest_ops):
+    global ACTIVE, GEN, TARGET_DTYPE, TARGET_OPS, FP32_OPS, WIDEST_OPS
+    TARGET_DTYPE = target_dtype
+    TARGET_OPS = frozenset(target_ops)
+    FP32_OPS = frozenset(fp32_ops)
+    WIDEST_OPS = frozenset(widest_ops)
+    ACTIVE = True
+    GEN += 1
+
+
+def deactivate():
+    global ACTIVE, GEN
+    ACTIVE = False
+    GEN += 1
+
+
+def cache_stale(obj):
+    """True when obj's compiled-executable cache predates the current AMP
+    generation; stamps obj with the current generation either way. Every
+    holder of a jit cache calls this before lookup so no stale-precision
+    executable is ever served."""
+    stale = getattr(obj, "_amp_gen", GEN) != GEN
+    obj._amp_gen = GEN
+    return stale
+
+
+def cast_inputs(op_name, raws):
+    """Apply the AMP cast decision for one op's inputs (list of raw jax
+    arrays); returns a new list. Called only when ACTIVE."""
+    import jax.numpy as jnp
+
+    def isfloat(r):
+        return jnp.issubdtype(r.dtype, jnp.floating)
+
+    if op_name in TARGET_OPS:
+        tgt = jnp.dtype(TARGET_DTYPE)
+        return [r.astype(tgt)
+                if isfloat(r) and r.dtype in (jnp.float32, jnp.float64)
+                else r for r in raws]
+    if op_name in FP32_OPS:
+        return [r.astype(jnp.float32)
+                if isfloat(r) and str(r.dtype) in _LOW else r for r in raws]
+    if op_name in WIDEST_OPS:
+        fdts = {r.dtype for r in raws if isfloat(r)}
+        if len(fdts) > 1:
+            widest = jnp.result_type(*fdts)
+            return [r.astype(widest) if isfloat(r) else r for r in raws]
+    return raws

@@ -1,0 +1,153 @@
+"""AMP — automatic mixed precision.
+
+Parity target: `python/mxnet/contrib/amp/amp.py` (`init` :67,
+`init_trainer`, `scale_loss`, `unscale`, `convert_model`,
+`convert_hybrid_block`, list editing helpers) over the graph pass
+`src/nnvm/low_precision_pass.cc`.
+
+TPU-native: instead of rewriting an nnvm graph with `amp_cast` nodes, the
+cast decisions run at *trace* time (`_amp_core.cast_inputs`, hooked into
+both dispatch paths), so every compiled executable built while AMP is
+active carries the casts, fused by XLA. Default target dtype is bfloat16 —
+the MXU-native input type, with fp32's exponent range, which is why
+`init()` defaults loss scaling off (it activates for float16).
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+from .. import _amp_core
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "list_lp16_ops", "list_fp32_ops",
+           "LossScaler"]
+
+_loss_scaler = None
+_target_dtype = None
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Activate AMP process-wide (parity: amp.py:67).
+
+    target_dtype : 'bfloat16' (TPU-native default) or 'float16'.
+    target_precision_ops : extra op names forced to the target dtype.
+    fp32_ops : extra op names forced to fp32.
+    conditional_fp32_ops : [(op_name, param, values)] — reference API; on
+        TPU the condition params are not inspected at trace level, so these
+        ops are conservatively forced fp32 (a superset of the reference's
+        blacklisting; numerically safe).
+    """
+    global _loss_scaler, _target_dtype
+    if target_dtype not in ("bfloat16", "float16"):
+        raise ValueError("target_dtype must be bfloat16 or float16")
+    target = set(lists.TARGET_OPS) | set(target_precision_ops or [])
+    fp32 = set(lists.FP32_OPS) | set(fp32_ops or [])
+    for entry in (conditional_fp32_ops or []):
+        fp32.add(entry[0] if isinstance(entry, (tuple, list)) else entry)
+    _amp_core.configure(target_dtype, target - fp32, fp32,
+                        set(lists.WIDEST_OPS))
+    _target_dtype = target_dtype
+    _loss_scaler = LossScaler() if target_dtype == "float16" else None
+
+
+def turn_off():
+    """Deactivate AMP (new executables compile without casts)."""
+    _amp_core.deactivate()
+
+
+def init_trainer(optimizer_or_trainer):
+    """Attach the dynamic loss scaler to a Trainer (parity: amp.py:181).
+    No-op for bfloat16 (no underflow risk)."""
+    if _loss_scaler is None:
+        return optimizer_or_trainer
+    optimizer_or_trainer._amp_loss_scaler = _loss_scaler
+    optimizer_or_trainer._amp_original_scale = \
+        getattr(optimizer_or_trainer, "_scale", 1.0)
+    return optimizer_or_trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizer_or_trainer):
+    """Scale the loss and arrange for gradient unscaling at `step`
+    (parity: amp.py:219)."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    optimizer_or_trainer._scale = (
+        optimizer_or_trainer._amp_original_scale / scaler.loss_scale)
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(optimizer_or_trainer):
+    """Check overflow + update the dynamic scale after backward
+    (parity: amp.py:246). Returns True when the step must be skipped."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return False
+    params = [p for p in optimizer_or_trainer._params
+              if p.grad_req != "null"]
+    overflow = scaler.has_overflow(params)
+    scaler.update_scale(overflow)
+    return overflow
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """Convert a symbolic model for AMP inference (parity: amp.py:439).
+
+    Activates the trace-level pass (executables bound from the returned
+    symbol compile with casts) and returns (sym, arg_params, aux_params).
+    Parameters stay fp32 masters unless `cast_optional_params`."""
+    init(target_dtype, target_dtype_ops, conditional_fp32_ops, fp32_ops)
+    if excluded_sym_names:
+        warnings.warn("excluded_sym_names is applied per-op-name on TPU; "
+                      "node-level exclusion is not traced")
+    if cast_optional_params:
+        def cast(d):
+            return {k: (v.astype(target_dtype)
+                        if str(np_dtype_name(v)) == "float32" else v)
+                    for k, v in d.items()}
+
+        def np_dtype_name(v):
+            import numpy as _np
+
+            return _np.dtype(v.dtype).name
+
+        arg_params = cast(arg_params)
+        aux_params = cast(aux_params)
+    return sym, arg_params, aux_params
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16",
+                         target_dtype_ops=None, fp32_ops=None,
+                         conditional_fp32_ops=None, excluded_sym_names=None,
+                         ctx=None, cast_optional_params=False):
+    """Convert a HybridBlock for AMP execution (parity: amp.py:560).
+
+    Activates AMP and re-hybridizes the block so its next call traces a
+    fresh executable carrying the casts."""
+    init(target_dtype, target_dtype_ops, conditional_fp32_ops, fp32_ops)
+    block.hybridize(active=True)
+    if hasattr(block, "_cached_op") and block._cached_op is not None:
+        block._cached_op = None  # force retrace under the new AMP state
+    return block
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    """parity: amp.py list_lp16_ops."""
+    return list(lists.TARGET_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    """parity: amp.py list_fp32_ops."""
+    return list(lists.FP32_OPS)

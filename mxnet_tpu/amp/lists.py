@@ -1,0 +1,32 @@
+"""AMP op lists (parity: `python/mxnet/contrib/amp/lists/symbol_fp16.py`).
+
+Three buckets over the registry's op names:
+  TARGET_OPS — MXU-bound ops always cast to the target dtype (the
+      reference's FP16_FUNCS: conv/dense/rnn/matmul).
+  FP32_OPS — numerically sensitive ops forced to fp32 accumulation
+      (the reference's FP32_FUNCS: softmax family, norms, reductions,
+      exp/log family).
+  WIDEST_OPS — multi-input elementwise ops cast to the widest input
+      dtype (the reference's WIDEST_TYPE_CASTS).
+"""
+
+TARGET_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "RNN",
+    "dot", "batch_dot",
+]
+
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxActivation", "SoftmaxOutput",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+    "L2Normalization", "norm", "mean", "sum", "nansum", "prod", "nanprod",
+    "exp", "expm1", "log", "log10", "log2", "log1p",
+    "CTCLoss", "LinearRegressionOutput", "MAERegressionOutput",
+    "LogisticRegressionOutput", "smooth_l1", "MakeLoss",
+]
+
+WIDEST_OPS = [
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_power",
+    "broadcast_hypot", "add_n", "maximum", "minimum", "where",
+]

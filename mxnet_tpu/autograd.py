@@ -214,9 +214,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         cots = cot.pop(id(node), None)
         if cots is None:
             continue
-        full = tuple(
-            c if c is not None else jnp.zeros(node.out_shapes[i], node.out_dtypes[i])
-            for i, c in enumerate(cots))
+        full = []
+        for i, c in enumerate(cots):
+            if c is None:
+                c = jnp.zeros(node.out_shapes[i], node.out_dtypes[i])
+            elif c.dtype != node.out_dtypes[i]:
+                # mixed-precision graphs (AMP): downstream vjps may hand
+                # back a wider cotangent than this node's output dtype
+                c = c.astype(node.out_dtypes[i])
+            full.append(c)
+        full = tuple(full)
         in_cots = node.vjp_fn(full if node.num_outputs > 1 else full[0])
         if not retain_graph:
             node.vjp_fn = None  # free residuals eagerly

@@ -139,13 +139,19 @@ class CachedOp:
 
     # -------------------------------------------------------------- call ---
     def __call__(self, *args):
+        from . import profiler as _profiler
         from .ndarray import NDArray
 
+        prof_t0 = _profiler._now_us() if _profiler._REC_SYMBOLIC else None
         arrays, spec = _flatten(list(args), [], [])
         in_raws = [a._data for a in arrays]
         params = self._param_handles
         param_raws = [p._data for p in params]
         training = autograd.is_training()
+        from . import _amp_core
+
+        if _amp_core.cache_stale(self):
+            self._cache.clear()
         key = (tuple(spec_key(s) for s in spec),
                tuple((tuple(r.shape), str(r.dtype)) for r in in_raws),
                tuple((tuple(r.shape), str(r.dtype)) for r in param_raws),
@@ -190,6 +196,10 @@ class CachedOp:
                 w._tape_node = node
                 w._tape_index = i
         result, _, _ = _unflatten_build(out_spec, wrapped)
+        if prof_t0 is not None:
+            _profiler.record_event("CachedOp", prof_t0,
+                                   _profiler._now_us() - prof_t0,
+                                   cat="symbolic")
         return result
 
     # ------------------------------------------------------------- build ---

@@ -1,0 +1,23 @@
+"""Contrib namespace (parity: `python/mxnet/contrib/__init__.py`).
+
+Hosts the experimental subsystems the reference ships under
+`mxnet.contrib`: `amp` (mixed precision — the real implementation lives
+at `mxnet_tpu.amp` and is aliased here at its reference import path) and
+`quantization`. Contrib *operators* (`mx.nd.contrib.*` /
+`mx.sym.contrib.*`) are regular registry ops with the `_contrib_` prefix.
+"""
+from __future__ import annotations
+
+from .. import amp  # reference import path: mx.contrib.amp
+
+__all__ = ["amp", "quantization"]
+
+
+def __getattr__(name):
+    if name == "quantization":
+        import importlib
+
+        mod = importlib.import_module(".quantization", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,193 @@
+"""Post-training int8 quantization (parity:
+`python/mxnet/contrib/quantization.py` over
+`src/operator/quantization/quantize_graph_pass.cc` + `calibrate.cc`).
+
+Pipeline (same three phases as the reference):
+  1. **Calibrate** — run `calib_data` through the fp32 graph collecting
+     per-quantized-op input ranges ('naive' min/max, or 'entropy' via a
+     percentile clip — the reference's KL-divergence search is approximated
+     by a 99.99th-percentile clip, which it converges to for the common
+     activation distributions).
+  2. **Pass** — rebuild the symbol DAG replacing Convolution /
+     FullyConnected nodes with `_contrib_quantized_conv` /
+     `_contrib_quantized_fully_connected` nodes wired to int8 weight +
+     per-channel scale variables and carrying the calibrated activation
+     range as attrs.
+  3. **Params** — quantize the weights per-output-channel symmetric int8;
+     biases stay fp32 (added after dequantize, like the reference).
+
+On the MXU int8 matmul runs at 2x the bf16 rate, so this is a genuine
+speed path, not emulation.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["quantize_model", "quantize_net", "quantize_graph"]
+
+_QUANTIZABLE = {"Convolution": "_contrib_quantized_conv",
+                "FullyConnected": "_contrib_quantized_fully_connected"}
+
+
+def _collect_ranges(sym, arg_params, aux_params, calib_data, data_names,
+                    num_calib_examples, calib_mode, ctx):
+    """Phase 1: per-node input activation ranges {node_name: (min, max)}."""
+    from ..symbol.symbol import _topo
+
+    # the inputs we must observe: the data feeding each quantizable node
+    internals = sym.get_internals()
+    out_names = internals.list_outputs()
+    watch = {}  # output_name -> [node names consuming it as data]
+    for node in _topo(sym._entries):
+        if node.op in _QUANTIZABLE:
+            src, oi = node.inputs[0]
+            if src.is_var:
+                oname = src.name
+            elif src.num_outputs == 1:
+                oname = f"{src.name}_output"
+            else:
+                oname = f"{src.name}_output{oi}"
+            watch.setdefault(oname, []).append(node.name)
+    ranges = {}
+    seen = 0
+    for batch in calib_data:
+        feed = dict(zip(data_names, batch.data))
+        feed.update(arg_params)
+        feed.update(aux_params)
+        outs = internals.eval_with(feed)
+        for oname, arr in zip(out_names, outs):
+            if oname not in watch:
+                continue
+            a = arr.asnumpy().astype(_np.float64)
+            if calib_mode == "entropy":
+                lo = float(_np.percentile(a, 0.01))
+                hi = float(_np.percentile(a, 99.99))
+            else:  # naive
+                lo, hi = float(a.min()), float(a.max())
+            for consumer in watch[oname]:
+                if consumer in ranges:
+                    plo, phi = ranges[consumer]
+                    ranges[consumer] = (min(plo, lo), max(phi, hi))
+                else:
+                    ranges[consumer] = (lo, hi)
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    calib_data.reset()
+    return ranges
+
+
+def quantize_graph(sym, excluded_sym_names=(), ranges=None):
+    """Phase 2: DAG surgery. Returns (qsym, [weight var names quantized])."""
+    from ..symbol.symbol import Symbol, _Node, _topo
+
+    ranges = ranges or {}
+    excluded = set(excluded_sym_names or ())
+    mapping = {}  # id(old node) -> new node
+    quantized_weights = []
+    for node in _topo(sym._entries):
+        new_inputs = [(mapping[id(c)], oi) for c, oi in node.inputs]
+        if node.op in _QUANTIZABLE and node.name not in excluded \
+                and node.name in ranges and len(node.inputs) >= 2 \
+                and node.inputs[1][0].is_var:
+            lo, hi = ranges[node.name]
+            qop = _QUANTIZABLE[node.op]
+            attrs = dict(node.attrs)
+            attrs["min_calib_range"] = lo
+            attrs["max_calib_range"] = hi
+            # inputs: data, weight->int8 var, scale var, [bias];
+            # new vars keyed off the ORIGINAL weight var name so params
+            # line up whatever the node was called (gluon export names
+            # nodes and params differently)
+            wname = node.inputs[1][0].name
+            data_in = new_inputs[0]
+            qw = _Node(None, wname + "_quantize", {}, [])
+            sc = _Node(None, wname + "_scale", {}, [])
+            ins = [data_in, (qw, 0), (sc, 0)]
+            if len(new_inputs) > 2:  # bias present
+                ins.append(new_inputs[2])
+            new = _Node(qop, node.name, attrs, ins,
+                        num_outputs=node.num_outputs)
+            quantized_weights.append(wname)
+        else:
+            new = _Node(node.op, node.name, dict(node.attrs), new_inputs,
+                        num_outputs=node.num_outputs)
+        mapping[id(node)] = new
+    entries = [(mapping[id(n)], i) for n, i in sym._entries]
+    return Symbol(entries), quantized_weights
+
+
+def _quantize_params(arg_params, quantized_weight_names):
+    """Phase 3: per-output-channel symmetric int8 weights + fp32 scales."""
+    from ..ndarray import array
+
+    qargs = {}
+    for name, arr in arg_params.items():
+        if name in quantized_weight_names:
+            w = arr.asnumpy()
+            flat = w.reshape(w.shape[0], -1)
+            absmax = _np.abs(flat).max(axis=1)
+            scale = _np.where(absmax > 0, absmax / 127.0, 1.0) \
+                .astype(_np.float32)
+            q = _np.clip(_np.round(flat / scale[:, None]), -127, 127) \
+                .astype(_np.int8).reshape(w.shape)
+            qargs[name + "_quantize"] = array(q, dtype="int8")
+            qargs[name + "_scale"] = array(scale)
+        else:
+            qargs[name] = arr
+    return qargs
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """parity: contrib/quantization.py quantize_model.
+
+    Returns (qsym, qarg_params, aux_params) ready for Module/bind.
+    """
+    if quantized_dtype not in ("int8", "auto"):
+        raise ValueError("only int8 symmetric quantization is supported")
+    if calib_data is None or calib_mode == "none":
+        raise ValueError("calib_data is required (the TPU pass bakes "
+                         "activation ranges into the executable)")
+    ranges = _collect_ranges(sym, arg_params, aux_params, calib_data,
+                             list(data_names), num_calib_examples,
+                             calib_mode, ctx)
+    qsym, qnames = quantize_graph(sym, excluded_sym_names or (), ranges)
+    qargs = _quantize_params(arg_params, set(qnames))
+    return qsym, qargs, dict(aux_params)
+
+
+def quantize_net(network, calib_data, data_shape=None, calib_mode="naive",
+                 num_calib_examples=None, excluded_layers=None, ctx=None,
+                 logger=None):
+    """Quantize a (Hybrid)Block: export -> quantize_model -> SymbolBlock
+    (parity: contrib/quantization.py quantize_net)."""
+    import mxnet_tpu as mx
+    from ..gluon import SymbolBlock
+
+    if not isinstance(calib_data, mx.io.DataIter):
+        calib_data = mx.io.NDArrayIter(calib_data, batch_size=min(
+            32, calib_data.shape[0]), label_name=None)
+    first = calib_data.provide_data[0]
+    x = mx.nd.zeros(first.shape)
+    network(x)  # materialize params
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = d + "/net"
+        network.export(prefix, 0)
+        sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+        qsym, qargs, auxs = quantize_model(
+            sym, args, auxs, data_names=(first.name,),
+            calib_data=calib_data, calib_mode=calib_mode,
+            num_calib_examples=num_calib_examples,
+            excluded_sym_names=excluded_layers)
+        # round-trip through the tested export format
+        mx.model.save_checkpoint(prefix + "-q", 0, qsym, qargs, auxs)
+        block = SymbolBlock.imports(prefix + "-q-symbol.json",
+                                    [first.name],
+                                    prefix + "-q-0000.params", ctx=ctx)
+    return block

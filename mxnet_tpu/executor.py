@@ -80,6 +80,10 @@ class Executor:
     def _exe(self, kind, sig, training):
         import jax
 
+        from . import _amp_core
+
+        if _amp_core.cache_stale(self):
+            self._jit.clear()
         key = (kind, sig, training)
         fn = self._jit.get(key)
         if fn is not None:

@@ -360,4 +360,9 @@ class ParameterDict:
                         f"Parameter {name!r} loaded from {filename!r} is not "
                         "present in ParameterDict")
                 continue
+            p = self._params[name]
+            if p._data is None:
+                # uninitialized (deferred) parameter adopts the saved dtype
+                # (int8 quantized weights, bf16 checkpoints, ...)
+                p.dtype = value.dtype
             self._params[name].set_data(value)

@@ -116,14 +116,48 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        """Reset only the windowed (local) statistics, folding them into the
+        epoch-global counters (parity: metric.py reset_local — used by
+        Speedometer's auto_reset)."""
+        self.global_sum_metric += self.sum_metric
+        self.global_num_inst += self.num_inst
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def _compute(self, total, num):
+        """Value from accumulated (total, num) — overridden by metrics whose
+        get() applies a transform (RMSE sqrt, Perplexity exp), so that
+        get() and get_global() stay consistent."""
+        return total / num
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, self._compute(self.sum_metric, self.num_inst))
+
+    def get_global(self):
+        """Epoch-global value including the current window (parity:
+        metric.py get_global)."""
+        num = getattr(self, "global_num_inst", 0) + self.num_inst
+        total = getattr(self, "global_sum_metric", 0.0) + self.sum_metric
+        if num == 0:
+            return (self.name, float("nan"))
+        return (self.name, self._compute(total, num))
 
     def get_name_value(self):
         name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        name, value = self.get_global()
         if not isinstance(name, list):
             name = [name]
         if not isinstance(value, list):
@@ -153,10 +187,14 @@ class CompositeEvalMetric(EvalMetric):
         for metric in getattr(self, "metrics", []):
             metric.reset()
 
-    def get(self):
+    def reset_local(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset_local()
+
+    def _gather(self, getter):
         names, values = [], []
         for metric in self.metrics:
-            name, value = metric.get()
+            name, value = getter(metric)
             if isinstance(name, str):
                 name = [name]
             if not isinstance(value, list):
@@ -164,6 +202,12 @@ class CompositeEvalMetric(EvalMetric):
             names.extend(name)
             values.extend(value)
         return names, values
+
+    def get(self):
+        return self._gather(lambda m: m.get())
+
+    def get_global(self):
+        return self._gather(lambda m: m.get_global())
 
 
 @register
@@ -279,6 +323,23 @@ class _BinaryClassificationHelper:
         return (self.true_positives + self.false_positives
                 + self.true_negatives + self.false_negatives)
 
+    def absorb(self, other):
+        """Fold another accumulator's counts into this one, resetting it
+        (used by reset_local to bank the window into the epoch-global)."""
+        self.true_positives += other.true_positives
+        self.false_positives += other.false_positives
+        self.true_negatives += other.true_negatives
+        self.false_negatives += other.false_negatives
+        other.reset_stats()
+
+    def combined(self, other):
+        c = _BinaryClassificationHelper()
+        c.true_positives = self.true_positives + other.true_positives
+        c.false_positives = self.false_positives + other.false_positives
+        c.true_negatives = self.true_negatives + other.true_negatives
+        c.false_negatives = self.false_negatives + other.false_negatives
+        return c
+
 
 @register
 class F1(EvalMetric):
@@ -288,6 +349,7 @@ class F1(EvalMetric):
                  average="macro"):
         self.average = average
         self.metrics = _BinaryClassificationHelper()
+        self.global_metrics = _BinaryClassificationHelper()
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
@@ -307,10 +369,24 @@ class F1(EvalMetric):
             return (self.name, self.metrics.fscore)
         return super().get()
 
+    def get_global(self):
+        if self.average == "micro":
+            comb = self.global_metrics.combined(self.metrics)
+            if comb.total_examples == 0:
+                return (self.name, float("nan"))
+            return (self.name, comb.fscore)
+        return super().get_global()
+
     def reset(self):
         super().reset()
         if hasattr(self, "metrics"):
             self.metrics.reset_stats()
+            self.global_metrics.reset_stats()
+
+    def reset_local(self):
+        super().reset_local()
+        if hasattr(self, "metrics"):
+            self.global_metrics.absorb(self.metrics)
 
 
 @register
@@ -321,6 +397,7 @@ class MCC(EvalMetric):
                  average="macro"):
         self.average = average
         self.metrics = _BinaryClassificationHelper()
+        self.global_metrics = _BinaryClassificationHelper()
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
@@ -340,10 +417,24 @@ class MCC(EvalMetric):
             return (self.name, self.metrics.matthewscc)
         return super().get()
 
+    def get_global(self):
+        if self.average == "micro":
+            comb = self.global_metrics.combined(self.metrics)
+            if comb.total_examples == 0:
+                return (self.name, float("nan"))
+            return (self.name, comb.matthewscc)
+        return super().get_global()
+
     def reset(self):
         super().reset()
         if hasattr(self, "metrics"):
             self.metrics.reset_stats()
+            self.global_metrics.reset_stats()
+
+    def reset_local(self):
+        super().reset_local()
+        if hasattr(self, "metrics"):
+            self.global_metrics.absorb(self.metrics)
 
 
 @register
@@ -378,10 +469,8 @@ class Perplexity(EvalMetric):
         self.sum_metric += loss
         self.num_inst += num
 
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+    def _compute(self, total, num):
+        return math.exp(total / num)
 
 
 @register
@@ -425,10 +514,8 @@ class RMSE(MSE):
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+    def _compute(self, total, num):
+        return math.sqrt(total / num)
 
 
 @register

@@ -1,0 +1,101 @@
+"""Monitor: tensor statistics for debugging (parity: `python/mxnet/monitor.py:32`).
+
+The reference taps every engine op's outputs via a C callback installed on
+the executor (`set_monitor_callback`). On TPU the bound graph is ONE XLA
+executable, so per-op intermediates are fused away; the monitor therefore
+reports what is observable at the executable boundary — arguments,
+auxiliary states, gradients, and outputs — which covers the reference's
+dominant use (weight/grad/output health checks). Pattern filtering, custom
+`stat_func`, `tic`/`toc`/`toc_print` all match the reference protocol.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """parity: monitor.py:32."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """|x| mean surrogate: norm(x)/sqrt(size) (reference default)."""
+                return x.norm() / math.sqrt(x.size)
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+    def install(self, exe):
+        """Attach to an Executor (parity: monitor.py install)."""
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch; call before forward."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def _collect(self, exe):
+        sym = exe._symbol
+        seen = set()
+
+        def emit(name, arr):
+            if arr is None or id(arr) in seen:
+                return
+            seen.add(id(arr))
+            if self.re_prog.match(name):
+                self.queue.append((self.step, name, self.stat_func(arr)))
+
+        for name, arr in zip(sym.list_arguments(), exe.arg_arrays):
+            emit(name, arr)
+            grad = exe.grad_dict.get(name)
+            if grad is not None:
+                emit(name + "_grad", grad)
+        for name, arr in zip(sym.list_auxiliary_states(), exe.aux_arrays):
+            emit(name, arr)
+        for name, arr in zip(sym.list_outputs(), exe.outputs or []):
+            emit(name, arr)
+
+    def toc(self):
+        """Finish collecting; returns [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            self._collect(exe)
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            from .ndarray import NDArray
+
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            s = ""
+            for v in v_list:
+                if v.size == 1:
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """parity: monitor.py:141."""
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

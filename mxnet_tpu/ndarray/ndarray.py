@@ -24,7 +24,8 @@ import numbers
 
 import numpy as _np
 
-from .. import autograd, engine
+from .. import _amp_core, autograd, engine
+from .. import profiler as _profiler
 from ..base import MXNetError, canonical_dtype
 from ..context import Context, current_context
 from ..ops import registry as _reg
@@ -587,8 +588,11 @@ def _wrap_outputs(op, raw_out):
 def _invoke(op_name, nd_inputs, kwargs, out=None):
     """The imperative dispatch path (parity: Imperative::Invoke,
     `src/imperative/imperative.cc:89`)."""
+    prof_t0 = _profiler._now_us() if _profiler._REC_IMPERATIVE else None
     op = _reg.get(op_name)
     raws = [x._data for x in nd_inputs]
+    if _amp_core.ACTIVE:
+        raws = _amp_core.cast_inputs(op_name, raws)
     if autograd.is_recording() and op.differentiable and autograd.any_on_tape(nd_inputs):
         import jax
         import functools
@@ -616,6 +620,9 @@ def _invoke(op_name, nd_inputs, kwargs, out=None):
             raw_out = op.bound(kwargs)(*raws)
         result = _wrap_outputs(op, raw_out)
     engine.maybe_sync([r._data for r in (result if isinstance(result, tuple) else (result,))])
+    if prof_t0 is not None:
+        _profiler.record_event(op_name, prof_t0,
+                               _profiler._now_us() - prof_t0)
     if out is not None:
         first = result[0] if isinstance(result, tuple) else result
         out._rebind(first._data)

@@ -1,0 +1,130 @@
+"""Quantization ops (parity: `src/operator/quantization/`).
+
+Symmetric int8 quantization with int32 accumulation — the MXU runs int8
+matmuls at twice the bf16 rate, so `_contrib_quantized_*` ops lower to
+`lax.dot_general`/`conv_general_dilated` with int8 operands and
+``preferred_element_type=int32`` (the TPU analogue of the reference's
+cuDNN/MKLDNN int8 paths, `quantized_conv.cu`, `quantized_fully_connected.cc`).
+
+Scale convention (matches the reference's symmetric int8 'auto' path,
+`quantize_v2-inl.h`): scale = max(|min_range|, |max_range|) / 127; zero
+point is always 0.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _scale(min_range, max_range):
+    s = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / 127.0
+    # all-zero range (dead activation): scale 1 maps everything to q=0
+    return jnp.where(s > 0, s, 1.0)
+
+
+def _quantize(data, scale):
+    q = jnp.clip(jnp.round(data / scale), -127, 127)
+    return q.astype(jnp.int8)
+
+
+@register("_contrib_quantize", num_outputs=3)
+def _contrib_quantize(data, min_range, max_range, out_type="int8"):
+    """parity: quantize.cc — float -> int8 with provided ranges."""
+    s = _scale(min_range, max_range)
+    return _quantize(data, s), min_range.astype(jnp.float32), \
+        max_range.astype(jnp.float32)
+
+
+@register("_contrib_quantize_v2", num_outputs=3)
+def _contrib_quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                         out_type="int8"):
+    """parity: quantize_v2.cc — calibrated ranges as attrs, or dynamic
+    (min/max of the batch) when not provided."""
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.min(data).astype(jnp.float32)
+        max_r = jnp.max(data).astype(jnp.float32)
+    else:
+        min_r = jnp.float32(min_calib_range)
+        max_r = jnp.float32(max_calib_range)
+    s = _scale(min_r, max_r)
+    return _quantize(data, s), min_r, max_r
+
+
+@register("_contrib_dequantize")
+def _contrib_dequantize(data, min_range, max_range, out_type="float32"):
+    """parity: dequantize.cc."""
+    s = _scale(min_range, max_range)
+    return data.astype(jnp.float32) * s
+
+
+@register("_contrib_requantize", num_outputs=3)
+def _contrib_requantize(data, min_range, max_range, min_calib_range=None,
+                        max_calib_range=None):
+    """parity: requantize.cc — int32 accumulator -> int8 with new range."""
+    in_scale = jnp.maximum(jnp.abs(min_range),
+                           jnp.abs(max_range)) / (2.0 ** 31 - 1)
+    f = data.astype(jnp.float32) * in_scale
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.min(f).astype(jnp.float32)
+        max_r = jnp.max(f).astype(jnp.float32)
+    else:
+        min_r = jnp.float32(min_calib_range)
+        max_r = jnp.float32(max_calib_range)
+    s = _scale(min_r, max_r)
+    return _quantize(f, s), min_r, max_r
+
+
+@register("_contrib_quantized_fully_connected")
+def _quantized_fully_connected(data, weight, scale, bias=None, num_hidden=1,
+                               no_bias=False, flatten=True,
+                               min_calib_range=0.0, max_calib_range=0.0):
+    """int8 FullyConnected: activation quantized with the calibrated range,
+    int8 x int8 -> int32 on the MXU, per-output-channel dequantize.
+
+    weight: int8 (num_hidden, K); scale: float32 (num_hidden,) per-channel
+    weight scales. parity: quantized_fully_connected.cc.
+    """
+    if flatten and data.ndim > 2:
+        data = data.reshape((data.shape[0], -1))
+    s_x = _scale(jnp.float32(min_calib_range), jnp.float32(max_calib_range))
+    qx = _quantize(data, s_x)
+    acc = jax.lax.dot_general(
+        qx, weight, (((qx.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (s_x * scale)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+@register("_contrib_quantized_conv")
+def _quantized_conv(data, weight, scale, bias=None, kernel=(), stride=(),
+                    dilate=(), pad=(), num_filter=1, num_group=1,
+                    no_bias=False, layout=None, min_calib_range=0.0,
+                    max_calib_range=0.0):
+    """int8 Convolution (NCHW): parity: quantized_conv.cc.
+
+    weight: int8 (num_filter, C/g, *kernel); scale: float32 (num_filter,)."""
+    n = len(kernel)
+    stride = tuple(stride) if stride else (1,) * n
+    dilate = tuple(dilate) if dilate else (1,) * n
+    pad = tuple(pad) if pad else (0,) * n
+    s_x = _scale(jnp.float32(min_calib_range), jnp.float32(max_calib_range))
+    qx = _quantize(data, s_x)
+    spatial = "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    acc = jax.lax.conv_general_dilated(
+        qx, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * \
+        (s_x * scale).reshape((1, -1) + (1,) * n)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out

@@ -33,6 +33,7 @@ from collections import OrderedDict
 
 import numpy as _np
 
+from .. import _amp_core
 from ..base import MXNetError, canonical_dtype, name_manager
 from ..ops import registry as _registry
 
@@ -368,6 +369,8 @@ class Symbol:
                     continue
                 op = _registry.get(node.op)
                 in_raws = [vals[id(c), oi] for c, oi in node.inputs]
+                if _amp_core.ACTIVE:
+                    in_raws = _amp_core.cast_inputs(node.op, in_raws)
                 kwargs = dict(node.attrs)
                 sig_names = [p.name for p in _sig_params(op)]
                 is_train = training and not kwargs.get("use_global_stats",

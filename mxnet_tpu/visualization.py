@@ -1,0 +1,124 @@
+"""Network visualization (parity: `python/mxnet/visualization.py`).
+
+`print_summary` renders the Keras-style per-layer table (layer name/type,
+output shape, param count, previous layers, plus totals); `plot_network`
+emits a graphviz Digraph when the `graphviz` package is installed (it is
+not part of the baked environment, so it is import-gated exactly like the
+reference, which raises ImportError with guidance).
+"""
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a per-layer summary table (parity: visualization.py:34).
+
+    Parameters
+    ----------
+    symbol : Symbol
+    shape : dict of str -> tuple, optional
+        Input shapes (by variable name) used to infer per-layer output
+        shapes and parameter counts.
+    """
+    from .symbol.symbol import _topo
+
+    shape_dict = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for field, p in zip(fields, pos):
+            line += str(field)
+            line = line[:p - 1] + " " * max(1, p - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(headers, positions)
+    print("=" * line_length)
+
+    order = _topo(symbol._entries)
+    input_names = set(symbol.list_arguments()) | \
+        set(symbol.list_auxiliary_states())
+    total_params = 0
+    for node in order:
+        if node.is_var:
+            continue
+        name = node.name
+        out_name = name + "_output" if node.num_outputs == 1 \
+            else name + "_output0"
+        out_shape = shape_dict.get(out_name, "")
+        # params: variable inputs that belong to this layer (prefix match)
+        cur_params = 0
+        pre_layers = []
+        for child, _ in node.inputs:
+            if child.is_var:
+                if child.name.startswith(name) and shape_dict.get(child.name):
+                    n = 1
+                    for d in shape_dict[child.name]:
+                        n *= d
+                    cur_params += n
+                elif child.name in input_names and \
+                        not child.name.startswith(name):
+                    pre_layers.append(child.name)
+            else:
+                pre_layers.append(child.name)
+        total_params += cur_params
+        fields = [f"{name}({node.op})",
+                  str(tuple(out_shape)) if out_shape != "" else "",
+                  cur_params, ",".join(pre_layers[:3])]
+        print_row(fields, positions)
+        print("_" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the network (parity:
+    visualization.py:214). Requires the optional `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    from .symbol.symbol import _topo
+
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+    order = _topo(symbol._entries)
+    # palette per op family (reference's color scheme)
+    palette = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+               "BatchNorm": "#bebada", "Activation": "#ffffb3",
+               "Pooling": "#80b1d3", "Concat": "#fdb462",
+               "softmax": "#fccde5"}
+    names = set()
+    for node in order:
+        if node.is_var and hide_weights and \
+                node.name not in symbol.list_arguments()[:1]:
+            # weights/aux hidden; data-like vars kept
+            if node.attrs.get("__is_aux__") or any(
+                    node.name.endswith(s)
+                    for s in ("weight", "bias", "gamma", "beta",
+                              "moving_mean", "moving_var")):
+                continue
+        color = palette.get(node.op or "", "#8dd3c7")
+        label = node.name if node.is_var else f"{node.op}\n{node.name}"
+        dot.node(node.name, label=label, fillcolor=color, **node_attr)
+        names.add(node.name)
+    for node in order:
+        if node.name not in names:
+            continue
+        for child, _ in node.inputs:
+            if child.name in names:
+                dot.edge(child.name, node.name)
+    return dot

@@ -1,0 +1,111 @@
+"""int8 quantization tests (parity model:
+tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def _conv_fc_sym():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                              name="conv1")
+    act = mx.sym.Activation(conv, act_type="relu")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    return mx.sym.FullyConnected(pool, num_hidden=10, name="fc1")
+
+
+def _init_args(sym, data_shape):
+    arg_shapes, _, _ = sym.infer_shape(data=data_shape)
+    rng = np.random.RandomState(0)
+    return {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.1)
+            for n, s in zip(sym.list_arguments(), arg_shapes) if n != "data"}
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array(np.linspace(-3, 3, 101, dtype=np.float32))
+    qv, mn, mxr = mx.nd.invoke("_contrib_quantize_v2", x)
+    assert np.dtype(qv.dtype).name == "int8"
+    back = mx.nd.invoke("_contrib_dequantize", qv, mn, mxr)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=3 / 127.0)
+
+
+def test_quantize_v2_calibrated_range():
+    x = mx.nd.array(np.array([-1.0, 0.0, 5.0], np.float32))
+    qv, mn, mxr = mx.nd.invoke("_contrib_quantize_v2", x,
+                               min_calib_range=-2.0, max_calib_range=2.0)
+    assert float(mn.asscalar()) == -2.0
+    assert int(qv.asnumpy()[2]) == 127  # clipped at the calibrated max
+
+
+def test_quantized_fc_matches_fp32():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 16).astype(np.float32)
+    w = (rng.randn(8, 16) * 0.1).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    ref = x @ w.T + b
+    absmax = np.abs(w).max(axis=1)
+    scale = absmax / 127.0
+    qw = np.clip(np.round(w / scale[:, None]), -127, 127).astype(np.int8)
+    out = mx.nd.invoke(
+        "_contrib_quantized_fully_connected", mx.nd.array(x),
+        mx.nd.array(qw, dtype="int8"), mx.nd.array(scale), mx.nd.array(b),
+        num_hidden=8, min_calib_range=float(x.min()),
+        max_calib_range=float(x.max()))
+    rel = np.abs(out.asnumpy() - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def test_quantize_model_symbol_path():
+    sym = _conv_fc_sym()
+    args = _init_args(sym, (4, 3, 8, 8))
+    X = np.random.RandomState(2).randn(64, 3, 8, 8).astype(np.float32)
+    it = mx.io.NDArrayIter(X, batch_size=16, label_name=None)
+    qsym, qargs, auxs = q.quantize_model(
+        sym, args, {}, data_names=("data",), calib_data=it,
+        num_calib_examples=64)
+    assert "conv1_weight_quantize" in qargs
+    assert "fc1_weight_scale" in qargs
+    assert np.dtype(qargs["conv1_weight_quantize"].dtype).name == "int8"
+    x = mx.nd.array(X[:4])
+    ref = sym.eval_with({"data": x, **args}).asnumpy()
+    out = qsym.eval_with({"data": x, **qargs}).asnumpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_model_excluded_layer():
+    sym = _conv_fc_sym()
+    args = _init_args(sym, (4, 3, 8, 8))
+    X = np.random.RandomState(3).randn(32, 3, 8, 8).astype(np.float32)
+    it = mx.io.NDArrayIter(X, batch_size=16, label_name=None)
+    qsym, qargs, _ = q.quantize_model(
+        sym, args, {}, data_names=("data",), calib_data=it,
+        excluded_sym_names=["fc1"])
+    assert "conv1_weight_quantize" in qargs
+    assert "fc1_weight" in qargs and "fc1_weight_quantize" not in qargs
+
+
+def test_quantize_net_gluon_path():
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(64, 3, 8, 8).astype(np.float32)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"), nn.Flatten(),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    qblock = q.quantize_net(net, X[:32])
+    x = mx.nd.array(X[:4])
+    ref = net(x).asnumpy()
+    out = qblock(x).asnumpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_model_requires_calib():
+    sym = _conv_fc_sym()
+    with pytest.raises(ValueError):
+        q.quantize_model(sym, {}, {}, calib_data=None)

@@ -1,0 +1,321 @@
+"""Tests for profiler / callback / monitor / visualization / runtime /
+util / amp (parity model: tests/python/unittest/test_profiler.py,
+test_amp.py, and the callback/monitor doctests in the reference)."""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, util
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as gloss
+
+
+# ------------------------------------------------------------- profiler ----
+
+def test_profiler_trace_and_aggregate(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.reset()
+    mx.profiler.set_config(filename=fname, aggregate_stats=True)
+    mx.profiler.set_state("run")
+    a = mx.nd.ones((32, 32))
+    ((a * 2) + 1).sum().wait_to_read()
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    trace = json.load(open(fname))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "sum" in names and "_mul_scalar" in names
+    assert all({"ts", "dur", "ph"} <= set(e) for e in trace["traceEvents"])
+    table = mx.profiler.dumps(sort_by="count")
+    assert "sum" in table and "Count" in table
+
+
+def test_profiler_pause_resume():
+    mx.profiler.reset()
+    mx.profiler.set_state("run")
+    mx.profiler.pause()
+    mx.nd.ones((4,)).sum().wait_to_read()
+    mx.profiler.resume()
+    assert mx.profiler.state() == "run"
+    mx.profiler.set_state("stop")
+    # nothing recorded while paused
+    assert "sum" not in mx.profiler.dumps()
+
+
+def test_profiler_instrumentation_objects(tmp_path):
+    mx.profiler.reset()
+    mx.profiler.set_state("run")
+    domain = mx.profiler.Domain("test")
+    with domain.new_task("work"):
+        pass
+    counter = domain.new_counter("ctr", 10)
+    counter += 5
+    domain.new_marker("mark").mark()
+    mx.profiler.set_state("stop")
+    fname = str(tmp_path / "p.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.dump()
+    evs = json.load(open(fname))["traceEvents"]
+    assert any(e["name"] == "work" for e in evs)
+    assert any(e["ph"] == "C" for e in evs)
+    assert any(e["ph"] == "i" for e in evs)
+
+
+def test_profiler_hybrid_cachedop_event():
+    mx.profiler.reset()
+    net = nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 8))
+    net(x)  # compile outside profile window
+    mx.profiler.set_state("run")
+    net(x).wait_to_read()
+    mx.profiler.set_state("stop")
+    assert "CachedOp" in mx.profiler.dumps()
+
+
+# ------------------------------------------------------------- callback ----
+
+def _batch_param(epoch, nbatch, metric=None):
+    from mxnet_tpu.module.base_module import BatchEndParam
+
+    return BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=metric,
+                         locals=None)
+
+
+def test_speedometer_logs(caplog):
+    sp = mx.callback.Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1, 1])], [mx.nd.array([[0.1, 0.9],
+                                                       [0.8, 0.2]])])
+    with caplog.at_level(logging.INFO):
+        for i in range(1, 5):
+            sp(_batch_param(0, i, metric))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint(tmp_path):
+    prefix = str(tmp_path / "model")
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    arg = {"fc_weight": mx.nd.ones((3, 4)), "fc_bias": mx.nd.zeros((3,))}
+    cb = mx.callback.do_checkpoint(prefix, period=1)
+    cb(0, fc, arg, {})
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 1)
+    np.testing.assert_allclose(args["fc_weight"].asnumpy(), np.ones((3, 4)))
+
+
+def test_log_train_metric(caplog):
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1])], [mx.nd.array([[0.1, 0.9]])])
+    cb = mx.callback.log_train_metric(1)
+    with caplog.at_level(logging.INFO):
+        cb(_batch_param(0, 1, metric))
+    assert any("accuracy" in r.message for r in caplog.records)
+
+
+# -------------------------------------------------------------- monitor ----
+
+def test_monitor_collects_stats():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    ex = fc.simple_bind(mx.cpu(), data=(2, 4))
+    ex.copy_params_from({"fc_weight": mx.nd.ones((3, 4)),
+                         "fc_bias": mx.nd.zeros((3,))})
+    mon = mx.monitor.Monitor(interval=1, pattern=".*weight.*", sort=True)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True, data=np.ones((2, 4), np.float32))
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert "fc_weight" in names
+    assert all("bias" not in k for k in names)
+    mon.toc_print()  # smoke
+
+
+def test_monitor_interval():
+    mon = mx.monitor.Monitor(interval=2)
+    mon.tic()
+    assert mon.activated
+    res = mon.toc()
+    mon.tic()  # step 1: not activated (1 % 2 != 0)
+    assert not mon.activated
+
+
+# -------------------------------------------------------- visualization ----
+
+def test_print_summary_counts_params(capsys):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    total = mx.visualization.print_summary(fc2, shape={"data": (32, 100)})
+    out = capsys.readouterr().out
+    assert total == 100 * 64 + 64 + 64 * 10 + 10
+    assert "fc1(FullyConnected)" in out
+    assert "(32, 64)" in out
+
+
+def test_plot_network_gated():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    try:
+        import graphviz  # noqa: F401
+
+        dot = mx.visualization.plot_network(fc)
+        assert "fc" in dot.source
+    except ImportError:
+        with pytest.raises(ImportError):
+            mx.visualization.plot_network(fc)
+
+
+# -------------------------------------------------------------- runtime ----
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert feats.is_enabled("CPU")
+    assert not feats.is_enabled("CUDNN")
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NO_SUCH_FEATURE")
+    assert repr(mx.runtime.Feature("X", True)).endswith("X")
+
+
+# ----------------------------------------------------------------- util ----
+
+def test_util_np_scopes():
+    assert not util.is_np_shape() and not util.is_np_array()
+    with util.np_shape(True):
+        assert util.is_np_shape()
+        with util.np_array(True):
+            assert util.is_np_array()
+        assert not util.is_np_array()
+    assert not util.is_np_shape()
+
+
+def test_util_use_np_decorator():
+    @util.use_np
+    def inner():
+        return util.is_np_shape(), util.is_np_array()
+
+    assert inner() == (True, True)
+    assert not util.is_np_shape()
+    util.set_np()
+    assert util.is_np_shape() and util.is_np_array()
+    util.reset_np()
+    assert not util.is_np_shape()
+
+
+def test_util_env():
+    util.setenv("MXNET_TPU_TEST_ENV", "42")
+    assert util.getenv("MXNET_TPU_TEST_ENV") == "42"
+    util.setenv("MXNET_TPU_TEST_ENV", None)
+    assert util.getenv("MXNET_TPU_TEST_ENV") is None
+
+
+# ------------------------------------------------------------------ amp ----
+
+def _dt(x):
+    return np.dtype(x.dtype).name
+
+
+@pytest.fixture
+def amp_off():
+    yield
+    amp.turn_off()
+
+
+def test_amp_eager_and_hybrid_cast(amp_off):
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(4, 8))
+    ref = net(x).asnumpy()
+    amp.init("bfloat16")
+    out = net(x)
+    assert _dt(out) == "bfloat16"
+    net.hybridize()
+    out_h = net(x)
+    assert _dt(out_h) == "bfloat16"
+    np.testing.assert_allclose(out.asnumpy().astype(np.float32), ref,
+                               rtol=0.05, atol=0.05)
+
+
+def test_amp_fp32_ops_stay_fp32(amp_off):
+    amp.init("bfloat16")
+    x = mx.nd.ones((2, 3)).astype("bfloat16")
+    assert str(mx.nd.softmax(x).dtype) == "float32"
+    assert str(mx.nd.sum(x).dtype) == "float32"
+
+
+def test_amp_symbol_path(amp_off):
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    sm = mx.sym.softmax(fc)
+    amp.init("bfloat16")
+    ex = sm.simple_bind(mx.cpu(), data=(2, 8))
+    out = ex.forward(is_train=False,
+                     data=np.random.rand(2, 8).astype(np.float32))
+    assert str(out[0].dtype) == "float32"  # softmax forced fp32
+
+
+def test_amp_training_converges(amp_off):
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(128, 10).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    amp.init("bfloat16")
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    Xn, yn = mx.nd.array(X), mx.nd.array(y)
+    losses = []
+    for _ in range(40):
+        with mx.autograd.record():
+            loss = lfn(net(Xn), yn).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+def test_amp_loss_scaler_dynamics():
+    scaler = amp.LossScaler(init_scale=1024, scale_factor=2, scale_window=2)
+    scaler.update_scale(overflow=True)
+    assert scaler.loss_scale == 512
+    scaler.update_scale(False)
+    scaler.update_scale(False)
+    assert scaler.loss_scale == 1024  # doubled after window
+
+
+def test_amp_convert_hybrid_block(amp_off):
+    net = nn.Dense(4)
+    net.initialize()
+    x = mx.nd.ones((2, 8))
+    net(x)
+    net2 = amp.convert_hybrid_block(net, "bfloat16")
+    out = net2(x)
+    assert _dt(out) == "bfloat16"
+
+
+def test_amp_generation_invalidates_caches(amp_off):
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 5))
+    out1 = net(x)
+    assert _dt(out1) == "float32"
+    amp.init("bfloat16")
+    out2 = net(x)
+    assert _dt(out2) == "bfloat16"
+    amp.turn_off()
+    out3 = net(x)
+    assert _dt(out3) == "float32"
