@@ -579,15 +579,21 @@ def _invoke_scalar(op_name, nd, scalar, reverse):
 
 # -------------------------------------------------------------- invoke -----
 
-def _wrap_outputs(op, raw_out):
+def _wrap_outputs(op, raw_out, wrap=None):
+    wrap = wrap or NDArray
     if isinstance(raw_out, tuple):
-        return tuple(NDArray(r) for r in raw_out)
-    return NDArray(raw_out)
+        return tuple(wrap(r) for r in raw_out)
+    return wrap(raw_out)
 
 
-def _invoke(op_name, nd_inputs, kwargs, out=None):
+def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
     """The imperative dispatch path (parity: Imperative::Invoke,
-    `src/imperative/imperative.cc:89`)."""
+    `src/imperative/imperative.cc:89`). `wrap` selects the output array
+    class (NDArray, or mx.np.ndarray for the NumPy frontend)."""
+    if wrap is None:
+        # np-frontend arrays propagate their class through any op
+        wrap = next((type(x) for x in nd_inputs
+                     if getattr(type(x), "_np_frontend", False)), NDArray)
     prof_t0 = _profiler._now_us() if _profiler._REC_IMPERATIVE else None
     op = _reg.get(op_name)
     raws = [x._data for x in nd_inputs]
@@ -603,7 +609,7 @@ def _invoke(op_name, nd_inputs, kwargs, out=None):
         node = autograd.TapeNode(op_name, vjp_fn, autograd.make_entries(nd_inputs),
                                  len(outs), [o.shape for o in outs],
                                  [o.dtype for o in outs])
-        wrapped = tuple(NDArray(o) for o in outs)
+        wrapped = tuple(wrap(o) for o in outs)
         for i, w in enumerate(wrapped):
             w._tape_node = node
             w._tape_index = i
@@ -618,7 +624,7 @@ def _invoke(op_name, nd_inputs, kwargs, out=None):
             raw_out = op.fn(*raws, **kwargs)
         else:
             raw_out = op.bound(kwargs)(*raws)
-        result = _wrap_outputs(op, raw_out)
+        result = _wrap_outputs(op, raw_out, wrap)
     engine.maybe_sync([r._data for r in (result if isinstance(result, tuple) else (result,))])
     if prof_t0 is not None:
         _profiler.record_event(op_name, prof_t0,
@@ -630,9 +636,12 @@ def _invoke(op_name, nd_inputs, kwargs, out=None):
     return result
 
 
-def _invoke_fn(fn, name, nd_inputs, kwargs):
+def _invoke_fn(fn, name, nd_inputs, kwargs, wrap=None):
     """Invoke an ad-hoc pure function as if it were an op (used by fancy
     indexing and frontend helpers)."""
+    if wrap is None:
+        wrap = next((type(x) for x in nd_inputs
+                     if getattr(type(x), "_np_frontend", False)), NDArray)
     raws = [x._data for x in nd_inputs]
     if autograd.is_recording() and autograd.any_on_tape(nd_inputs):
         import jax
@@ -642,15 +651,15 @@ def _invoke_fn(fn, name, nd_inputs, kwargs):
         node = autograd.TapeNode(name, vjp_fn, autograd.make_entries(nd_inputs),
                                  len(outs), [o.shape for o in outs],
                                  [o.dtype for o in outs])
-        wrapped = tuple(NDArray(o) for o in outs)
+        wrapped = tuple(wrap(o) for o in outs)
         for i, w in enumerate(wrapped):
             w._tape_node = node
             w._tape_index = i
         return wrapped if isinstance(raw_out, tuple) else wrapped[0]
     raw_out = fn(*raws)
     if isinstance(raw_out, tuple):
-        return tuple(NDArray(r) for r in raw_out)
-    return NDArray(raw_out)
+        return tuple(wrap(r) for r in raw_out)
+    return wrap(raw_out)
 
 
 def invoke(op_name, *nd_inputs, out=None, **kwargs):

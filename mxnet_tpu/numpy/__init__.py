@@ -1,0 +1,780 @@
+"""mx.np — the NumPy-compatible frontend.
+
+Parity target: `python/mxnet/numpy/multiarray.py` (~12.6k LoC) over
+`src/operator/numpy/` (`_npi_*` ops). `mx.np.ndarray` follows NumPy
+semantics — zero-dim arrays, boolean masking, bool comparison results,
+`@` matmul, NumPy type promotion — while staying a first-class framework
+tensor: it lives on a Context, records on the autograd tape, hybridizes,
+and its ops dispatch through the same registry (`ops/numpy_ops.py`) as
+everything else, so AMP / profiler / opperf see them uniformly.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, _invoke, _invoke_fn
+
+# re-exported numpy dtype/constant surface (parity: numpy/__init__.py)
+from numpy import (float16, float32, float64, int8, int16, int32, int64,  # noqa: F401
+                   uint8, uint16, uint32, uint64, bool_, pi, e, inf, nan,
+                   euler_gamma, newaxis)
+
+_npx_dtype = None
+
+
+class ndarray(NDArray):
+    """NumPy-semantics tensor (parity: numpy/multiarray.py ndarray)."""
+
+    __slots__ = ()
+    _np_frontend = True  # _invoke propagates this class through ops
+
+    # ------------------------------------------------------------- repr ----
+    def __repr__(self):
+        arr = self.asnumpy()
+        prefix = "array("
+        body = _onp.array2string(arr, separator=", ", prefix=prefix)
+        ctx = self.context
+        suffix = f", ctx={ctx})" if ctx.device_type != "cpu" else ")"
+        if arr.dtype not in (_onp.float32, _onp.int32, _onp.bool_):
+            suffix = f", dtype={arr.dtype}" + suffix
+        return prefix + body + suffix
+
+    def __str__(self):
+        return str(self.asnumpy())
+
+    # -------------------------------------------------------- operators ----
+    def _bin(self, other, op, scalar_op=None, reverse=False):
+        if isinstance(other, NDArray):
+            args = [other, self] if reverse else [self, other]
+            return _invoke(op, args, {}, wrap=ndarray)
+        if scalar_op is not None and isinstance(other, (int, float, bool)):
+            name = ("_npi_r" + scalar_op if reverse else
+                    "_npi_" + scalar_op) + "_scalar"
+            try:
+                return _invoke(name, [self], {"scalar": other}, wrap=ndarray)
+            except KeyError:
+                pass
+        other = array(other, ctx=self.context)
+        args = [other, self] if reverse else [self, other]
+        return _invoke(op, args, {}, wrap=ndarray)
+
+    def __add__(self, o):
+        return self._bin(o, "_npi_add", "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, "_npi_subtract", "subtract")
+
+    def __rsub__(self, o):
+        return self._bin(o, "_npi_subtract", "subtract", reverse=True)
+
+    def __mul__(self, o):
+        return self._bin(o, "_npi_multiply", "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, "_npi_true_divide", "true_divide")
+
+    def __rtruediv__(self, o):
+        return self._bin(o, "_npi_true_divide", "true_divide", reverse=True)
+
+    def __floordiv__(self, o):
+        return self._bin(o, "_npi_floor_divide", "floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._bin(o, "_npi_floor_divide", "floor_divide",
+                         reverse=True)
+
+    def __mod__(self, o):
+        return self._bin(o, "_npi_mod", "mod")
+
+    def __rmod__(self, o):
+        return self._bin(o, "_npi_mod", "mod", reverse=True)
+
+    def __pow__(self, o):
+        return self._bin(o, "_npi_power", "power")
+
+    def __rpow__(self, o):
+        return self._bin(o, "_npi_power", "power", reverse=True)
+
+    def __matmul__(self, o):
+        return self._bin(o, "_npi_matmul")
+
+    def __rmatmul__(self, o):
+        return self._bin(o, "_npi_matmul", reverse=True)
+
+    def __neg__(self):
+        return _invoke("_npi_negative", [self], {}, wrap=ndarray)
+
+    def __abs__(self):
+        return _invoke("_npi_absolute", [self], {}, wrap=ndarray)
+
+    def __invert__(self):
+        return _invoke("_npi_invert", [self], {}, wrap=ndarray)
+
+    def __eq__(self, o):
+        return self._bin(o, "_npi_equal")
+
+    def __ne__(self, o):
+        return self._bin(o, "_npi_not_equal")
+
+    def __lt__(self, o):
+        return self._bin(o, "_npi_less")
+
+    def __le__(self, o):
+        return self._bin(o, "_npi_less_equal")
+
+    def __gt__(self, o):
+        return self._bin(o, "_npi_greater")
+
+    def __ge__(self, o):
+        return self._bin(o, "_npi_greater_equal")
+
+    __hash__ = NDArray.__hash__
+
+    def __and__(self, o):
+        return self._bin(o, "_npi_bitwise_and")
+
+    def __or__(self, o):
+        return self._bin(o, "_npi_bitwise_or")
+
+    def __xor__(self, o):
+        return self._bin(o, "_npi_bitwise_xor")
+
+    # --------------------------------------------------------- methods -----
+    @property
+    def T(self):
+        return _invoke("_npi_transpose", [self], {}, wrap=ndarray)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke("_npi_transpose", [self],
+                       {"axes": axes or None}, wrap=ndarray)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _invoke("_npi_reshape", [self], {"newshape": shape},
+                       wrap=ndarray)
+
+    def flatten(self, order="C"):
+        return _invoke("_npi_ravel", [self], {}, wrap=ndarray)
+
+    ravel = flatten
+
+    def astype(self, dtype, copy=True):
+        return _invoke_fn(lambda x: x.astype(_npdt(dtype)), "astype", [self],
+                          {}, wrap=ndarray)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def as_nd_ndarray(self):
+        """Convert to the legacy mx.nd frontend (parity: multiarray.py)."""
+        out = NDArray(self._data)
+        out._tape_node = self._tape_node
+        out._tape_index = self._tape_index
+        out._grad_req = self._grad_req
+        out._grad = self._grad
+        return out
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return _invoke("_npi_sum", [self],
+                       {"axis": axis, "dtype": _npdt(dtype),
+                        "keepdims": keepdims}, wrap=ndarray)
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return _invoke("_npi_mean", [self],
+                       {"axis": axis, "dtype": _npdt(dtype),
+                        "keepdims": keepdims}, wrap=ndarray)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return _invoke("_npi_std", [self], {"axis": axis, "ddof": ddof,
+                                            "keepdims": keepdims},
+                       wrap=ndarray)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return _invoke("_npi_var", [self], {"axis": axis, "ddof": ddof,
+                                            "keepdims": keepdims},
+                       wrap=ndarray)
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke("_npi_prod", [self], {"axis": axis,
+                                             "keepdims": keepdims},
+                       wrap=ndarray)
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke("_npi_max", [self], {"axis": axis,
+                                            "keepdims": keepdims},
+                       wrap=ndarray)
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke("_npi_min", [self], {"axis": axis,
+                                            "keepdims": keepdims},
+                       wrap=ndarray)
+
+    def argmax(self, axis=None):
+        return _invoke("_npi_argmax", [self], {"axis": axis}, wrap=ndarray)
+
+    def argmin(self, axis=None):
+        return _invoke("_npi_argmin", [self], {"axis": axis}, wrap=ndarray)
+
+    def clip(self, min=None, max=None):
+        return _invoke("_npi_clip", [self], {"a_min": min, "a_max": max},
+                       wrap=ndarray)
+
+    def squeeze(self, axis=None):
+        return _invoke("_npi_squeeze", [self], {"axis": axis}, wrap=ndarray)
+
+    def cumsum(self, axis=None, dtype=None):
+        return _invoke("_npi_cumsum", [self],
+                       {"axis": axis, "dtype": _npdt(dtype)}, wrap=ndarray)
+
+    def round(self, decimals=0):
+        return _invoke("_npi_round", [self], {"decimals": decimals},
+                       wrap=ndarray)
+
+    def dot(self, b):
+        return self._bin(b, "_npi_dot")
+
+    def copy(self):
+        return ndarray(self._data)
+
+    def any(self, axis=None, keepdims=False):
+        return _invoke("_npi_any", [self], {"axis": axis,
+                                            "keepdims": keepdims},
+                       wrap=ndarray)
+
+    def all(self, axis=None, keepdims=False):
+        return _invoke("_npi_all", [self], {"axis": axis,
+                                            "keepdims": keepdims},
+                       wrap=ndarray)
+
+
+def _npdt(dtype):
+    """Canonicalize a dtype argument (None passes through)."""
+    if dtype is None:
+        return None
+    return _onp.dtype(dtype).name
+
+
+def _as_np(x, ctx=None):
+    if isinstance(x, ndarray):
+        return x
+    if isinstance(x, NDArray):
+        return ndarray(x._data)
+    return array(x, ctx=ctx)
+
+
+# ------------------------------------------------------------- creation ----
+
+def array(object, dtype=None, ctx=None):
+    """parity: multiarray.py array."""
+    if isinstance(object, NDArray):
+        object = object._data
+    return ndarray(object, ctx=ctx or current_context(),
+                   dtype=_npdt(dtype))
+
+
+def zeros(shape, dtype=None, order="C", ctx=None):
+    return array(_onp.zeros(shape if not isinstance(shape, int) else (shape,),
+                            dtype=_npdt(dtype) or "float32"), ctx=ctx)
+
+
+def ones(shape, dtype=None, order="C", ctx=None):
+    return array(_onp.ones(shape if not isinstance(shape, int) else (shape,),
+                           dtype=_npdt(dtype) or "float32"), ctx=ctx)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None):
+    return array(_onp.full(shape if not isinstance(shape, int) else (shape,),
+                           fill_value, dtype=_npdt(dtype)), ctx=ctx)
+
+
+def empty(shape, dtype=None, order="C", ctx=None):
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return array(_onp.arange(start, stop, step, dtype=_npdt(dtype)), ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    out = _onp.linspace(start, stop, num, endpoint=endpoint,
+                        retstep=retstep, dtype=_npdt(dtype), axis=axis)
+    if retstep:
+        return array(out[0], ctx=ctx), out[1]
+    return array(out, ctx=ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    return array(_onp.logspace(start, stop, num, endpoint=endpoint,
+                               base=base, dtype=_npdt(dtype), axis=axis),
+                 ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    return array(_onp.eye(N, M, k, dtype=_npdt(dtype) or "float32"), ctx=ctx)
+
+
+def identity(n, dtype=None, ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _invoke_fn(lambda x: x * 0 if dtype is None
+                      else (x * 0).astype(_npdt(dtype)),
+                      "zeros_like", [_as_np(a)], {}, wrap=ndarray)
+
+
+def ones_like(a, dtype=None):
+    return _invoke_fn(lambda x: x * 0 + 1 if dtype is None
+                      else (x * 0 + 1).astype(_npdt(dtype)),
+                      "ones_like", [_as_np(a)], {}, wrap=ndarray)
+
+
+def full_like(a, fill_value, dtype=None):
+    return _invoke_fn(lambda x: x * 0 + fill_value if dtype is None
+                      else (x * 0 + fill_value).astype(_npdt(dtype)),
+                      "full_like", [_as_np(a)], {}, wrap=ndarray)
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype=dtype)
+
+
+def copy(a):
+    return _as_np(a).copy()
+
+
+def ascontiguousarray(a, dtype=None):
+    return _as_np(a) if dtype is None else _as_np(a).astype(dtype)
+
+
+asarray = array
+
+
+# ------------------------------------------------------------ dispatch -----
+
+def _op_kw_names(op_name):
+    """Keyword parameter names of an op's emitter, after the array arg —
+    used to bind positional frontend args (np.tril(a, 1) -> k=1)."""
+    import inspect
+
+    from ..ops import registry as _reg
+
+    params = list(inspect.signature(_reg.get(op_name).fn).parameters)
+    return tuple(params[1:])
+
+
+def _op1(op_name):
+    """Single-tensor op wrapper: np.f(a, *args, **kwargs) with positional
+    args bound onto the emitter's keyword parameters in order."""
+    kw_names = None
+
+    def f(a, *args, **kwargs):
+        nonlocal kw_names
+        a = _as_np(a)
+        if args:
+            if kw_names is None:
+                kw_names = _op_kw_names(op_name)
+            if len(args) > len(kw_names):
+                raise TypeError(
+                    f"{f.__name__}() takes at most {len(kw_names)} "
+                    f"positional arguments after the array")
+            kwargs.update(dict(zip(kw_names, args)))
+        return _invoke(op_name, [a], kwargs, wrap=ndarray)
+
+    f.__name__ = op_name.replace("_npi_", "")
+    return f
+
+
+def _op2(op_name, scalar_name=None):
+    """Two-tensor op wrapper with scalar support."""
+
+    def f(x1, x2, *a, **k):
+        if isinstance(x1, NDArray):
+            return _as_np(x1)._bin(x2, op_name, scalar_name)
+        if isinstance(x2, NDArray):
+            return _as_np(x2)._bin(x1, op_name, scalar_name, reverse=True)
+        return f(array(x1), x2)
+
+    f.__name__ = op_name.replace("_npi_", "")
+    return f
+
+
+# unary surface
+for _n in ("negative", "reciprocal", "absolute", "sign", "rint", "ceil",
+           "floor", "trunc", "fix", "square", "sqrt", "cbrt", "exp",
+           "expm1", "log", "log10", "log2", "log1p", "sin", "cos", "tan",
+           "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh",
+           "arccosh", "arctanh", "degrees", "radians", "invert",
+           "logical_not", "isnan", "isinf", "isposinf", "isneginf",
+           "isfinite"):
+    globals()[_n] = _op1(f"_npi_{_n}")
+abs = absolute  # noqa: F821,A001
+
+# binary surface
+for _n in ("add", "subtract", "multiply", "true_divide", "floor_divide",
+           "mod", "fmod", "remainder", "power", "maximum", "minimum",
+           "fmax", "fmin", "hypot", "arctan2", "copysign", "ldexp",
+           "logaddexp", "bitwise_and", "bitwise_or", "bitwise_xor",
+           "left_shift", "right_shift", "logical_and", "logical_or",
+           "logical_xor", "equal", "not_equal", "less", "less_equal",
+           "greater", "greater_equal", "matmul", "dot", "inner", "outer",
+           "kron", "cross", "gcd", "lcm", "vdot"):
+    _scalar = _n if _n in ("add", "subtract", "multiply", "true_divide",
+                           "mod", "power", "floor_divide") else None
+    globals()[_n] = _op2(f"_npi_{_n}", _scalar)
+divide = true_divide  # noqa: F821
+
+
+# reductions / shape / etc. with explicit signatures
+def sum(a, axis=None, dtype=None, keepdims=False):  # noqa: A001
+    return _as_np(a).sum(axis=axis, dtype=dtype, keepdims=keepdims)
+
+
+def mean(a, axis=None, dtype=None, keepdims=False):
+    return _as_np(a).mean(axis=axis, dtype=dtype, keepdims=keepdims)
+
+
+def std(a, axis=None, ddof=0, keepdims=False):
+    return _as_np(a).std(axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+def var(a, axis=None, ddof=0, keepdims=False):
+    return _as_np(a).var(axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+def prod(a, axis=None, keepdims=False):
+    return _as_np(a).prod(axis=axis, keepdims=keepdims)
+
+
+def max(a, axis=None, keepdims=False):  # noqa: A001
+    return _as_np(a).max(axis=axis, keepdims=keepdims)
+
+
+def min(a, axis=None, keepdims=False):  # noqa: A001
+    return _as_np(a).min(axis=axis, keepdims=keepdims)
+
+
+amax, amin = max, min
+
+
+def argmax(a, axis=None):
+    return _as_np(a).argmax(axis=axis)
+
+
+def argmin(a, axis=None):
+    return _as_np(a).argmin(axis=axis)
+
+
+def clip(a, a_min=None, a_max=None):
+    return _as_np(a).clip(a_min, a_max)
+
+
+def round(a, decimals=0):  # noqa: A001
+    return _as_np(a).round(decimals)
+
+
+around = round
+for _n in ("cumsum", "cumprod", "nansum", "nanprod", "median", "ptp",
+           "any", "all", "count_nonzero", "sort", "argsort", "unique",
+           "ediff1d", "ravel", "fliplr", "flipud",
+           "atleast_1d", "atleast_2d", "atleast_3d", "trace", "diag",
+           "diagonal", "diagflat", "tril", "triu", "nan_to_num"):
+    globals()[_n] = _op1(f"_npi_{_n}")
+
+
+def reshape(a, newshape, order="C"):
+    return _as_np(a).reshape(newshape)
+
+
+def transpose(a, axes=None):
+    return _invoke("_npi_transpose", [_as_np(a)], {"axes": axes},
+                   wrap=ndarray)
+
+
+def swapaxes(a, axis1, axis2):
+    return _invoke("_npi_swapaxes", [_as_np(a)],
+                   {"dim1": axis1, "dim2": axis2}, wrap=ndarray)
+
+
+def moveaxis(a, source, destination):
+    return _invoke("_npi_moveaxis", [_as_np(a)],
+                   {"source": source, "destination": destination},
+                   wrap=ndarray)
+
+
+def expand_dims(a, axis):
+    return _invoke("_npi_expand_dims", [_as_np(a)], {"axis": axis},
+                   wrap=ndarray)
+
+
+def squeeze(a, axis=None):
+    return _as_np(a).squeeze(axis)
+
+
+def broadcast_to(a, shape):
+    return _invoke("_npi_broadcast_to", [_as_np(a)], {"shape": tuple(shape)},
+                   wrap=ndarray)
+
+
+def flip(a, axis=None):
+    return _invoke("_npi_flip", [_as_np(a)], {"axis": axis}, wrap=ndarray)
+
+
+def roll(a, shift, axis=None):
+    return _invoke("_npi_roll", [_as_np(a)], {"shift": shift, "axis": axis},
+                   wrap=ndarray)
+
+
+def rot90(a, k=1, axes=(0, 1)):
+    return _invoke("_npi_rot90", [_as_np(a)], {"k": k, "axes": tuple(axes)},
+                   wrap=ndarray)
+
+
+def tile(a, reps):
+    return _invoke("_npi_tile", [_as_np(a)], {"reps": reps}, wrap=ndarray)
+
+
+def repeat(a, repeats, axis=None):
+    return _invoke("_npi_repeat", [_as_np(a)],
+                   {"repeats": repeats, "axis": axis}, wrap=ndarray)
+
+
+def pad(a, pad_width, mode="constant", constant_values=0):
+    return _invoke("_npi_pad", [_as_np(a)],
+                   {"pad_width": _freeze_pads(pad_width), "mode": mode,
+                    "constant_values": constant_values}, wrap=ndarray)
+
+
+def _freeze_pads(pw):
+    if isinstance(pw, int):
+        return pw
+    return tuple(tuple(p) if isinstance(p, (list, tuple)) else p
+                 for p in pw)
+
+
+def concatenate(seq, axis=0, out=None):
+    return _invoke("_npi_concatenate", [_as_np(a) for a in seq],
+                   {"axis": axis}, wrap=ndarray)
+
+
+def stack(arrays, axis=0, out=None):
+    return _invoke("_npi_stack", [_as_np(a) for a in arrays],
+                   {"axis": axis}, wrap=ndarray)
+
+
+def vstack(tup):
+    return _invoke("_npi_vstack", [_as_np(a) for a in tup], {}, wrap=ndarray)
+
+
+def hstack(tup):
+    return _invoke("_npi_hstack", [_as_np(a) for a in tup], {}, wrap=ndarray)
+
+
+def dstack(tup):
+    return _invoke("_npi_dstack", [_as_np(a) for a in tup], {}, wrap=ndarray)
+
+
+def column_stack(tup):
+    return _invoke("_npi_column_stack", [_as_np(a) for a in tup], {},
+                   wrap=ndarray)
+
+
+def split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    if isinstance(ios, (list, tuple)):
+        ios = tuple(ios)
+    out = _invoke("_npi_split", [_as_np(ary)],
+                  {"indices_or_sections": ios, "axis": axis}, wrap=ndarray)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    if isinstance(ios, (list, tuple)):
+        ios = tuple(ios)
+    out = _invoke("_npi_array_split", [_as_np(ary)],
+                  {"indices_or_sections": ios, "axis": axis}, wrap=ndarray)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def hsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=1)
+
+
+def vsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=0)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return _invoke("_npi_where",
+                   [_as_np(condition), _as_np(x), _as_np(y)], {},
+                   wrap=ndarray)
+
+
+def nonzero(a):
+    """Returns a tuple of 1-D index arrays (NumPy contract)."""
+    out = _invoke("_npi_nonzero", [_as_np(a)], {}, wrap=ndarray)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def take(a, indices, axis=None, mode="clip"):
+    return _invoke("_npi_take", [_as_np(a), _as_np(indices)],
+                   {"axis": axis, "mode": mode}, wrap=ndarray)
+
+
+def take_along_axis(a, indices, axis):
+    return _invoke("_npi_take_along_axis", [_as_np(a), _as_np(indices)],
+                   {"axis": axis}, wrap=ndarray)
+
+
+def searchsorted(a, v, side="left"):
+    return _invoke("_npi_searchsorted", [_as_np(a), _as_np(v)],
+                   {"side": side}, wrap=ndarray)
+
+
+def bincount(x, weights=None, minlength=0):
+    args = [_as_np(x)]
+    if weights is not None:
+        args.append(_as_np(weights))
+        return _invoke_fn(
+            lambda a, w: __import__("jax.numpy", fromlist=["x"]).bincount(
+                a, weights=w, minlength=minlength), "bincount", args, {},
+            wrap=ndarray)
+    return _invoke("_npi_bincount", args, {"minlength": minlength},
+                   wrap=ndarray)
+
+
+def histogram(a, bins=10, range=None):
+    return _invoke("_npi_histogram", [_as_np(a)],
+                   {"bins": bins, "range": range}, wrap=ndarray)
+
+
+def interp(x, xp, fp):
+    return _invoke("_npi_interp", [_as_np(x), _as_np(xp), _as_np(fp)], {},
+                   wrap=ndarray)
+
+
+def diff(a, n=1, axis=-1):
+    return _invoke("_npi_diff", [_as_np(a)], {"n": n, "axis": axis},
+                   wrap=ndarray)
+
+
+def gradient(f, axis=None):
+    out = _invoke("_npi_gradient_op", [_as_np(f)], {"axis": axis},
+                  wrap=ndarray)
+    return list(out) if isinstance(out, tuple) else out
+
+
+def meshgrid(*xi, indexing="xy"):
+    out = _invoke("_npi_meshgrid", [_as_np(x) for x in xi],
+                  {"indexing": indexing}, wrap=ndarray)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def einsum(subscripts, *operands):
+    return _invoke("_npi_einsum", [_as_np(o) for o in operands],
+                   {"subscripts": subscripts}, wrap=ndarray)
+
+
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(ax) if isinstance(ax, (list, tuple)) else ax
+                     for ax in axes)
+    return _invoke("_npi_tensordot", [_as_np(a), _as_np(b)],
+                   {"axes": axes}, wrap=ndarray)
+
+
+def quantile(a, q, axis=None, keepdims=False):
+    return _invoke("_npi_quantile", [_as_np(a)],
+                   {"q": q, "axis": axis, "keepdims": keepdims},
+                   wrap=ndarray)
+
+
+def percentile(a, q, axis=None, keepdims=False):
+    return _invoke("_npi_percentile", [_as_np(a)],
+                   {"q": q, "axis": axis, "keepdims": keepdims},
+                   wrap=ndarray)
+
+
+def average(a, axis=None, weights=None):
+    if weights is not None:
+        return _invoke_fn(
+            lambda x, w: __import__("jax.numpy", fromlist=["x"]).average(
+                x, axis=axis, weights=w), "average",
+            [_as_np(a), _as_np(weights)], {}, wrap=ndarray)
+    return _invoke("_npi_average", [_as_np(a)], {"axis": axis}, wrap=ndarray)
+
+
+def maximum_sctype(t):
+    return _onp.float64
+
+
+def may_share_memory(a, b, max_work=None):
+    return False  # jax arrays are immutable buffers
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+def result_type(*args):
+    return _onp.result_type(*[
+        _onp.dtype(a.dtype) if isinstance(a, NDArray) else a for a in args])
+
+
+def isscalar(element):
+    return _onp.isscalar(element)
+
+
+def shape(a):
+    return _as_np(a).shape
+
+
+def ndim(a):
+    return _as_np(a).ndim
+
+
+def size(a, axis=None):
+    if axis is None:
+        return _as_np(a).size
+    return _as_np(a).shape[axis]
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return bool(_onp.allclose(_as_np(a).asnumpy(), _as_np(b).asnumpy(),
+                              rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def array_equal(a1, a2):
+    return bool(_onp.array_equal(_as_np(a1).asnumpy(),
+                                 _as_np(a2).asnumpy()))
+
+
+def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return _invoke_fn(
+        lambda x, y: __import__("jax.numpy", fromlist=["x"]).isclose(
+            x, y, rtol=rtol, atol=atol, equal_nan=equal_nan), "isclose",
+        [_as_np(a), _as_np(b)], {}, wrap=ndarray)
+
+
+def dtype(d):  # noqa: A001
+    return _onp.dtype(d)
+
+
+from . import linalg  # noqa: E402,F401
+from . import random  # noqa: E402,F401

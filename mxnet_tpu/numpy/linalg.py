@@ -1,0 +1,102 @@
+"""mx.np.linalg (parity: `python/mxnet/numpy/linalg.py` over
+`src/operator/numpy/linalg/`). All factorizations lower to XLA's native
+decompositions (cusolver analogues are built into XLA on TPU)."""
+from __future__ import annotations
+
+from ..ndarray.ndarray import _invoke
+from . import _as_np, ndarray  # noqa: F401
+
+__all__ = ["norm", "inv", "pinv", "det", "slogdet", "matrix_rank", "svd",
+           "qr", "cholesky", "eig", "eigh", "eigvals", "eigvalsh", "solve",
+           "lstsq", "matrix_power", "multi_dot", "tensorinv", "tensorsolve"]
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _invoke("_npi_norm", [_as_np(x)],
+                   {"ord": ord, "axis": axis, "keepdims": keepdims},
+                   wrap=ndarray)
+
+
+def inv(a):
+    return _invoke("_npi_inv", [_as_np(a)], {}, wrap=ndarray)
+
+
+def pinv(a, rcond=1e-15):
+    return _invoke("_npi_pinv", [_as_np(a)], {"rcond": rcond}, wrap=ndarray)
+
+
+def det(a):
+    return _invoke("_npi_det", [_as_np(a)], {}, wrap=ndarray)
+
+
+def slogdet(a):
+    return _invoke("_npi_slogdet", [_as_np(a)], {}, wrap=ndarray)
+
+
+def matrix_rank(M, tol=None):
+    return _invoke("_npi_matrix_rank", [_as_np(M)], {"tol": tol},
+                   wrap=ndarray)
+
+
+def svd(a):
+    return _invoke("_npi_svd", [_as_np(a)], {}, wrap=ndarray)
+
+
+def qr(a):
+    return _invoke("_npi_qr", [_as_np(a)], {}, wrap=ndarray)
+
+
+def cholesky(a):
+    return _invoke("_npi_cholesky", [_as_np(a)], {}, wrap=ndarray)
+
+
+def eig(a):
+    return _invoke("_npi_eig", [_as_np(a)], {}, wrap=ndarray)
+
+
+def eigh(a, UPLO="L"):
+    return _invoke("_npi_eigh", [_as_np(a)], {"UPLO": UPLO}, wrap=ndarray)
+
+
+def eigvals(a):
+    return _invoke("_npi_eigvals", [_as_np(a)], {}, wrap=ndarray)
+
+
+def eigvalsh(a, UPLO="L"):
+    return _invoke("_npi_eigvalsh", [_as_np(a)], {"UPLO": UPLO},
+                   wrap=ndarray)
+
+
+def solve(a, b):
+    return _invoke("_npi_solve", [_as_np(a), _as_np(b)], {}, wrap=ndarray)
+
+
+def lstsq(a, b, rcond=None):
+    return _invoke("_npi_lstsq", [_as_np(a), _as_np(b)], {"rcond": rcond},
+                   wrap=ndarray)
+
+
+def matrix_power(a, n):
+    return _invoke("_npi_matrix_power", [_as_np(a)], {"n": n}, wrap=ndarray)
+
+
+def multi_dot(arrays):
+    return _invoke("_npi_multi_dot", [_as_np(a) for a in arrays], {},
+                   wrap=ndarray)
+
+
+def tensorinv(a, ind=2):
+    from ..ndarray.ndarray import _invoke_fn
+    import jax.numpy as jnp
+
+    return _invoke_fn(lambda x: jnp.linalg.tensorinv(x, ind=ind),
+                      "tensorinv", [_as_np(a)], {}, wrap=ndarray)
+
+
+def tensorsolve(a, b, axes=None):
+    from ..ndarray.ndarray import _invoke_fn
+    import jax.numpy as jnp
+
+    return _invoke_fn(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                      "tensorsolve", [_as_np(a), _as_np(b)], {},
+                      wrap=ndarray)

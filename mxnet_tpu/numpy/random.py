@@ -1,0 +1,142 @@
+"""mx.np.random (parity: `python/mxnet/numpy/random.py` over
+`src/operator/numpy/random/`). Draws from the framework's stateful
+seed->key stream (`mxnet_tpu.random`), so `mx.random.seed` governs these
+samplers too, and inside a CachedOp trace the key is threaded through the
+executable like every other random op."""
+from __future__ import annotations
+
+from .. import random as _framework_random
+from ..ndarray.ndarray import _invoke
+from . import _as_np, ndarray  # noqa: F401
+
+__all__ = ["seed", "uniform", "normal", "randint", "rand", "randn",
+           "choice", "shuffle", "permutation", "gamma", "exponential",
+           "beta", "poisson", "multinomial", "bernoulli"]
+
+
+def seed(seed_value):
+    _framework_random.seed(seed_value)
+
+
+def _size(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None):
+    return _invoke("_npi_random_uniform", [],
+                   {"low": low, "high": high, "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None):
+    return _invoke("_npi_random_normal", [],
+                   {"loc": loc, "scale": scale,
+                    "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    return _invoke("_npi_random_randint", [],
+                   {"low": low, "high": high,
+                    "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def rand(*size):
+    return uniform(size=size or ())
+
+
+def randn(*size):
+    return normal(size=size or ())
+
+
+def choice(a, size=None, replace=True, p=None):
+    if isinstance(a, int):
+        from . import arange
+
+        a = arange(a)
+    args = [_as_np(a)]
+    kwargs = {"key": _framework_random.next_key(), "size": _size(size),
+              "replace": replace}
+    if p is not None:
+        import jax
+
+        from ..ndarray.ndarray import _invoke_fn
+
+        return _invoke_fn(
+            lambda arr, probs: jax.random.choice(
+                kwargs["key"], arr, shape=kwargs["size"], replace=replace,
+                p=probs), "choice", [args[0], _as_np(p)], {}, wrap=ndarray)
+    return _invoke("_npi_random_choice", args, kwargs, wrap=ndarray)
+
+
+def permutation(x):
+    if isinstance(x, int):
+        from . import arange
+
+        x = arange(x)
+    return _invoke("_npi_random_permutation", [_as_np(x)],
+                   {"key": _framework_random.next_key()}, wrap=ndarray)
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (parity: np.random.shuffle)."""
+    out = permutation(x)
+    x._rebind(out._data)
+
+
+def gamma(shape, scale=1.0, size=None, dtype="float32", ctx=None):
+    return _invoke("_npi_random_gamma", [],
+                   {"shape_param": shape, "scale": scale,
+                    "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def exponential(scale=1.0, size=None, dtype="float32", ctx=None):
+    return _invoke("_npi_random_exponential", [],
+                   {"scale": scale, "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def beta(a, b, size=None, dtype="float32", ctx=None):
+    return _invoke("_npi_random_beta", [],
+                   {"a": a, "b": b, "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def poisson(lam=1.0, size=None, dtype="int32", ctx=None):
+    return _invoke("_npi_random_poisson", [],
+                   {"lam": lam, "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def bernoulli(p=0.5, size=None, dtype="float32", ctx=None):
+    return _invoke("_npi_random_bernoulli", [],
+                   {"p": p, "key": _framework_random.next_key(),
+                    "size": _size(size), "dtype": dtype}, wrap=ndarray)
+
+
+def multinomial(n, pvals, size=None):
+    """Sample counts from a multinomial (parity: np.random.multinomial)."""
+    import jax
+
+    from ..ndarray.ndarray import _invoke_fn
+
+    key = _framework_random.next_key()
+    shape = _size(size)
+
+    def _mn(p):
+        import jax.numpy as jnp
+
+        draws = jax.random.categorical(
+            key, jnp.log(jnp.maximum(p, 1e-30)), shape=shape + (n,))
+        return jax.nn.one_hot(draws, p.shape[-1]).sum(axis=-2) \
+            .astype(jnp.int32)
+
+    return _invoke_fn(_mn, "multinomial", [_as_np(pvals)], {}, wrap=ndarray)

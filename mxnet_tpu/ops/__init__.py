@@ -12,5 +12,6 @@ from . import nn  # noqa: F401  (FC/conv/pool/norm/softmax/rnn ops)
 from . import optimizer_op  # noqa: F401  (fused optimizer updates)
 from . import random_ops  # noqa: F401  (samplers)
 from . import quantization  # noqa: F401  (int8 quantize/dequantize/conv/fc)
+from . import numpy_ops  # noqa: F401  (_npi_* NumPy-frontend ops)
 
 __all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]

@@ -1,0 +1,354 @@
+"""NumPy-frontend ops: `_npi_*` registrations.
+
+Parity target: `src/operator/numpy/` (~33.5k LoC, 147 `_npi_*`
+registrations: np_elemwise_broadcast_op.cc, np_matrix_op.cc,
+np_einsum_op.cc, np_tensordot_op.cc, linalg/*, random/*). Each op here is
+the jnp emitter for one `_npi_` name; `mx.np` functions dispatch through
+the registry so the tape, AMP pass, profiler and opperf all see them like
+any other op.
+
+Unlike the legacy op set (MXNet 1.x semantics), these follow NumPy
+semantics exactly — jnp already implements them, so the registration layer
+is thin by design; the value is the uniform dispatch surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _reg_fixed(name, fn, num_outputs=None, differentiable=True, eager=False):
+    register(name, num_outputs=num_outputs, differentiable=differentiable,
+             eager=eager)(fn)
+
+
+# ---------------------------------------------------------------- unary ----
+_UNARY = {
+    "negative": jnp.negative, "reciprocal": jnp.reciprocal,
+    "absolute": jnp.abs, "sign": jnp.sign, "rint": jnp.rint,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt, "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log,
+    "log10": jnp.log10, "log2": jnp.log2, "log1p": jnp.log1p,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos, "arctan": jnp.arctan, "sinh": jnp.sinh,
+    "cosh": jnp.cosh, "tanh": jnp.tanh, "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "invert": jnp.invert, "logical_not": jnp.logical_not,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isposinf": jnp.isposinf,
+    "isneginf": jnp.isneginf, "isfinite": jnp.isfinite,
+    "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
+}
+for _name, _fn in _UNARY.items():
+    _reg_fixed(f"_npi_{_name}", _fn,
+               differentiable=_name not in (
+                   "invert", "logical_not", "isnan", "isinf", "isposinf",
+                   "isneginf", "isfinite", "sign", "rint", "ceil", "floor",
+                   "trunc", "fix"))
+
+# --------------------------------------------------------------- binary ----
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "true_divide": jnp.true_divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "fmod": jnp.fmod, "remainder": jnp.remainder,
+    "power": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "hypot": jnp.hypot,
+    "arctan2": jnp.arctan2, "copysign": jnp.copysign,
+    "ldexp": jnp.ldexp, "logaddexp": jnp.logaddexp,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor, "left_shift": jnp.left_shift,
+    "right_shift": jnp.right_shift,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "equal": jnp.equal, "not_equal": jnp.not_equal, "less": jnp.less,
+    "less_equal": jnp.less_equal, "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "matmul": jnp.matmul, "dot": jnp.dot, "inner": jnp.inner,
+    "outer": jnp.outer, "kron": jnp.kron, "cross": jnp.cross,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+}
+_NONDIFF_BIN = {"bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
+                "right_shift", "logical_and", "logical_or", "logical_xor",
+                "equal", "not_equal", "less", "less_equal", "greater",
+                "greater_equal", "gcd", "lcm", "floor_divide"}
+for _name, _fn in _BINARY.items():
+    _reg_fixed(f"_npi_{_name}", _fn,
+               differentiable=_name not in _NONDIFF_BIN)
+
+# scalar variants (scalar baked static, like the legacy _*_scalar ops)
+for _name in ("add", "subtract", "rsubtract", "multiply", "true_divide",
+              "rtrue_divide", "mod", "rmod", "power", "rpower",
+              "floor_divide", "rfloor_divide"):
+    base = _name[1:] if _name.startswith("r") else _name
+    rev = _name.startswith("r")
+    fn = _BINARY[base]
+
+    def _scalar_op(data, scalar=0.0, _fn=fn, _rev=rev):
+        return _fn(scalar, data) if _rev else _fn(data, scalar)
+
+    _reg_fixed(f"_npi_{_name}_scalar", _scalar_op,
+               differentiable=base != "floor_divide")
+
+
+# ----------------------------------------------------------- reductions ----
+def _np_reduce(fn):
+    def op(a, axis=None, keepdims=False, dtype=None):
+        out = fn(a, axis=axis, keepdims=keepdims)
+        return out.astype(dtype) if dtype is not None else out
+
+    return op
+
+
+_reg_fixed("_npi_sum", lambda a, axis=None, dtype=None, keepdims=False:
+           jnp.sum(a, axis=axis, dtype=dtype, keepdims=keepdims))
+_reg_fixed("_npi_prod", lambda a, axis=None, dtype=None, keepdims=False:
+           jnp.prod(a, axis=axis, dtype=dtype, keepdims=keepdims))
+_reg_fixed("_npi_mean", lambda a, axis=None, dtype=None, keepdims=False:
+           jnp.mean(a, axis=axis, dtype=dtype, keepdims=keepdims))
+_reg_fixed("_npi_std", lambda a, axis=None, ddof=0, keepdims=False:
+           jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdims))
+_reg_fixed("_npi_var", lambda a, axis=None, ddof=0, keepdims=False:
+           jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdims))
+_reg_fixed("_npi_max", _np_reduce(jnp.max))
+_reg_fixed("_npi_min", _np_reduce(jnp.min))
+_reg_fixed("_npi_amax", _np_reduce(jnp.max))
+_reg_fixed("_npi_amin", _np_reduce(jnp.min))
+_reg_fixed("_npi_argmax", lambda a, axis=None, keepdims=False:
+           jnp.argmax(a, axis=axis, keepdims=keepdims), differentiable=False)
+_reg_fixed("_npi_argmin", lambda a, axis=None, keepdims=False:
+           jnp.argmin(a, axis=axis, keepdims=keepdims), differentiable=False)
+_reg_fixed("_npi_any", lambda a, axis=None, keepdims=False:
+           jnp.any(a, axis=axis, keepdims=keepdims), differentiable=False)
+_reg_fixed("_npi_all", lambda a, axis=None, keepdims=False:
+           jnp.all(a, axis=axis, keepdims=keepdims), differentiable=False)
+_reg_fixed("_npi_cumsum", lambda a, axis=None, dtype=None:
+           jnp.cumsum(a, axis=axis, dtype=dtype))
+_reg_fixed("_npi_cumprod", lambda a, axis=None, dtype=None:
+           jnp.cumprod(a, axis=axis, dtype=dtype))
+_reg_fixed("_npi_nansum", lambda a, axis=None, dtype=None, keepdims=False:
+           jnp.nansum(a, axis=axis, dtype=dtype, keepdims=keepdims))
+_reg_fixed("_npi_nanprod", lambda a, axis=None, dtype=None, keepdims=False:
+           jnp.nanprod(a, axis=axis, dtype=dtype, keepdims=keepdims))
+_reg_fixed("_npi_median", lambda a, axis=None, keepdims=False:
+           jnp.median(a, axis=axis, keepdims=keepdims))
+_reg_fixed("_npi_quantile", lambda a, q=0.5, axis=None, keepdims=False:
+           jnp.quantile(a, q, axis=axis, keepdims=keepdims))
+_reg_fixed("_npi_percentile", lambda a, q=50.0, axis=None, keepdims=False:
+           jnp.percentile(a, q, axis=axis, keepdims=keepdims))
+_reg_fixed("_npi_average",
+           lambda a, weights=None, axis=None:
+           jnp.average(a, axis=axis, weights=weights))
+_reg_fixed("_npi_ptp", lambda a, axis=None, keepdims=False:
+           jnp.ptp(a, axis=axis, keepdims=keepdims))
+_reg_fixed("_npi_count_nonzero", lambda a, axis=None, keepdims=False:
+           jnp.count_nonzero(a, axis=axis, keepdims=keepdims),
+           differentiable=False)
+
+
+# ----------------------------------------------------------- shape/move ----
+_reg_fixed("_npi_reshape", lambda a, newshape=(), order="C":
+           jnp.reshape(a, newshape))
+_reg_fixed("_npi_transpose", lambda a, axes=None:
+           jnp.transpose(a, axes=axes if axes else None))
+_reg_fixed("_npi_swapaxes", lambda a, dim1=0, dim2=1:
+           jnp.swapaxes(a, dim1, dim2))
+_reg_fixed("_npi_moveaxis", lambda a, source=0, destination=0:
+           jnp.moveaxis(a, source, destination))
+_reg_fixed("_npi_expand_dims", lambda a, axis=0: jnp.expand_dims(a, axis))
+_reg_fixed("_npi_squeeze", lambda a, axis=None: jnp.squeeze(a, axis=axis))
+_reg_fixed("_npi_broadcast_to", lambda a, shape=():
+           jnp.broadcast_to(a, shape))
+_reg_fixed("_npi_ravel", lambda a: jnp.ravel(a))
+_reg_fixed("_npi_flip", lambda a, axis=None: jnp.flip(a, axis=axis))
+_reg_fixed("_npi_fliplr", jnp.fliplr)
+_reg_fixed("_npi_flipud", jnp.flipud)
+_reg_fixed("_npi_roll", lambda a, shift=0, axis=None:
+           jnp.roll(a, shift, axis=axis))
+_reg_fixed("_npi_rot90", lambda a, k=1, axes=(0, 1):
+           jnp.rot90(a, k=k, axes=tuple(axes)))
+_reg_fixed("_npi_tile", lambda a, reps=(): jnp.tile(a, reps))
+_reg_fixed("_npi_repeat", lambda a, repeats=1, axis=None:
+           jnp.repeat(a, repeats, axis=axis))
+_reg_fixed("_npi_pad", lambda a, pad_width=(), mode="constant",
+           constant_values=0:
+           jnp.pad(a, pad_width, mode=mode,
+                   constant_values=constant_values)
+           if mode == "constant" else jnp.pad(a, pad_width, mode=mode))
+_reg_fixed("_npi_diag", lambda a, k=0: jnp.diag(a, k=k))
+_reg_fixed("_npi_diagonal", lambda a, offset=0, axis1=0, axis2=1:
+           jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2))
+_reg_fixed("_npi_diagflat", lambda a, k=0: jnp.diagflat(a, k=k))
+_reg_fixed("_npi_tril", lambda a, k=0: jnp.tril(a, k=k))
+_reg_fixed("_npi_triu", lambda a, k=0: jnp.triu(a, k=k))
+_reg_fixed("_npi_trace", lambda a, offset=0, axis1=0, axis2=1:
+           jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2))
+
+
+# ---------------------------------------------------------- combination ----
+def _variadic(fn_name, jfn):
+    def op(*arrays, axis=0):
+        return jfn(arrays, axis=axis)
+
+    _reg_fixed(fn_name, op)
+
+
+_variadic("_npi_concatenate", jnp.concatenate)
+_variadic("_npi_stack", jnp.stack)
+_reg_fixed("_npi_vstack", lambda *arrays: jnp.vstack(arrays))
+_reg_fixed("_npi_hstack", lambda *arrays: jnp.hstack(arrays))
+_reg_fixed("_npi_dstack", lambda *arrays: jnp.dstack(arrays))
+_reg_fixed("_npi_column_stack", lambda *arrays: jnp.column_stack(arrays))
+_reg_fixed("_npi_atleast_1d", jnp.atleast_1d)
+_reg_fixed("_npi_atleast_2d", jnp.atleast_2d)
+_reg_fixed("_npi_atleast_3d", jnp.atleast_3d)
+_reg_fixed("_npi_split", lambda a, indices_or_sections=1, axis=0:
+           tuple(jnp.split(a, indices_or_sections, axis=axis)),
+           num_outputs=2)  # variable; registry num_outputs unused for tuples
+_reg_fixed("_npi_array_split", lambda a, indices_or_sections=1, axis=0:
+           tuple(jnp.array_split(a, indices_or_sections, axis=axis)),
+           num_outputs=2)
+_reg_fixed("_npi_where", jnp.where)
+_reg_fixed("_npi_clip", lambda a, a_min=None, a_max=None:
+           jnp.clip(a, a_min, a_max))
+_reg_fixed("_npi_take", lambda a, indices, axis=None, mode="clip":
+           jnp.take(a, indices, axis=axis, mode=mode))
+_reg_fixed("_npi_take_along_axis", lambda a, indices, axis=0:
+           jnp.take_along_axis(a, indices, axis=axis))
+_reg_fixed("_npi_searchsorted", lambda a, v, side="left":
+           jnp.searchsorted(a, v, side=side), differentiable=False)
+_reg_fixed("_npi_sort", lambda a, axis=-1: jnp.sort(a, axis=axis))
+_reg_fixed("_npi_argsort", lambda a, axis=-1: jnp.argsort(a, axis=axis),
+           differentiable=False)
+# dynamic-output-shape ops: eager (never jitted; see Operator.eager)
+_reg_fixed("_npi_unique", lambda a, size=None:
+           jnp.unique(a, size=size), differentiable=False, eager=True)
+_reg_fixed("_npi_nonzero", lambda a: tuple(jnp.nonzero(a)),
+           num_outputs=2, differentiable=False, eager=True)
+_reg_fixed("_npi_bincount", lambda a, weights=None, minlength=0:
+           jnp.bincount(a, weights=weights, minlength=minlength),
+           differentiable=False, eager=True)
+_reg_fixed("_npi_histogram", lambda a, bins=10, range=None:
+           jnp.histogram(a, bins=bins, range=range), num_outputs=2,
+           differentiable=False)
+_reg_fixed("_npi_interp", jnp.interp)
+_reg_fixed("_npi_nan_to_num", lambda a, nan=0.0, posinf=None, neginf=None:
+           jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf))
+_reg_fixed("_npi_round", lambda a, decimals=0: jnp.round(a, decimals))
+_reg_fixed("_npi_sign_nd", jnp.sign, differentiable=False)
+_reg_fixed("_npi_meshgrid", lambda *arrays, indexing="xy":
+           tuple(jnp.meshgrid(*arrays, indexing=indexing)), num_outputs=2)
+_reg_fixed("_npi_tril_indices", lambda n=1, k=0, m=None:
+           jnp.stack(jnp.tril_indices(n, k, m)), differentiable=False)
+_reg_fixed("_npi_indices", lambda dimensions=(), dtype="int32":
+           jnp.indices(tuple(dimensions), dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_diff", lambda a, n=1, axis=-1: jnp.diff(a, n=n, axis=axis))
+_reg_fixed("_npi_ediff1d", lambda a: jnp.ediff1d(a))
+
+
+def _gradient_op(a, axis=None):
+    out = jnp.gradient(a, axis=axis)
+    return tuple(out) if isinstance(out, list) else out
+
+
+_reg_fixed("_npi_gradient_op", _gradient_op)
+
+
+# ------------------------------------------------------ einsum/tensordot ----
+def _einsum(*operands, subscripts=""):
+    return jnp.einsum(subscripts, *operands)
+
+
+_reg_fixed("_npi_einsum", _einsum)
+_reg_fixed("_npi_tensordot", lambda a, b, axes=2:
+           jnp.tensordot(a, b, axes=axes))
+_reg_fixed("_npi_vdot", jnp.vdot)
+_reg_fixed("_npi_tensordot_int_axes", lambda a, b, axes=2:
+           jnp.tensordot(a, b, axes=int(axes)))
+
+
+# ---------------------------------------------------------------- linalg ----
+_reg_fixed("_npi_norm", lambda a, ord=None, axis=None, keepdims=False:
+           jnp.linalg.norm(a, ord=ord, axis=axis, keepdims=keepdims))
+_reg_fixed("_npi_inv", jnp.linalg.inv)
+_reg_fixed("_npi_pinv", lambda a, rcond=1e-15:
+           jnp.linalg.pinv(a, rtol=rcond))
+_reg_fixed("_npi_det", jnp.linalg.det)
+_reg_fixed("_npi_slogdet", jnp.linalg.slogdet, num_outputs=2)
+_reg_fixed("_npi_matrix_rank", lambda a, tol=None:
+           jnp.linalg.matrix_rank(a, rtol=tol), differentiable=False)
+_reg_fixed("_npi_svd", lambda a: tuple(jnp.linalg.svd(a)), num_outputs=3)
+_reg_fixed("_npi_qr", lambda a: tuple(jnp.linalg.qr(a)), num_outputs=2)
+_reg_fixed("_npi_cholesky", jnp.linalg.cholesky)
+_reg_fixed("_npi_eig", lambda a: tuple(jnp.linalg.eig(a)), num_outputs=2,
+           differentiable=False)
+_reg_fixed("_npi_eigh", lambda a, UPLO="L":
+           tuple(jnp.linalg.eigh(a, UPLO=UPLO)), num_outputs=2)
+_reg_fixed("_npi_eigvals", jnp.linalg.eigvals, differentiable=False)
+_reg_fixed("_npi_eigvalsh", lambda a, UPLO="L":
+           jnp.linalg.eigvalsh(a, UPLO=UPLO))
+_reg_fixed("_npi_solve", jnp.linalg.solve)
+_reg_fixed("_npi_lstsq", lambda a, b, rcond=None:
+           tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), num_outputs=4,
+           differentiable=False)
+_reg_fixed("_npi_matrix_power", lambda a, n=1: jnp.linalg.matrix_power(a, n))
+_reg_fixed("_npi_multi_dot", lambda *arrays: jnp.linalg.multi_dot(arrays))
+
+
+# ---------------------------------------------------------------- random ----
+_reg_fixed("_npi_random_uniform",
+           lambda low=0.0, high=1.0, key=None, size=(), dtype="float32":
+           jax.random.uniform(key, shape=tuple(size),
+                              dtype=jnp.dtype(dtype), minval=low,
+                              maxval=high),
+           differentiable=False)
+_reg_fixed("_npi_random_normal",
+           lambda loc=0.0, scale=1.0, key=None, size=(), dtype="float32":
+           loc + scale * jax.random.normal(key, shape=tuple(size),
+                                           dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_random_randint",
+           lambda low=0, high=None, key=None, size=(), dtype="int32":
+           jax.random.randint(key, tuple(size), low,
+                              high if high is not None else low,
+                              dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_random_choice",
+           lambda a, key=None, size=(), replace=True, p=None:
+           jax.random.choice(key, a, shape=tuple(size), replace=replace,
+                             p=p),
+           differentiable=False)
+_reg_fixed("_npi_random_permutation",
+           lambda a, key=None: jax.random.permutation(key, a),
+           differentiable=False)
+_reg_fixed("_npi_random_gamma",
+           lambda shape_param=1.0, scale=1.0, key=None, size=(),
+           dtype="float32":
+           scale * jax.random.gamma(key, shape_param, shape=tuple(size),
+                                    dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_random_exponential",
+           lambda scale=1.0, key=None, size=(), dtype="float32":
+           scale * jax.random.exponential(key, shape=tuple(size),
+                                          dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_random_beta",
+           lambda a=1.0, b=1.0, key=None, size=(), dtype="float32":
+           jax.random.beta(key, a, b, shape=tuple(size),
+                           dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_random_poisson",
+           lambda lam=1.0, key=None, size=(), dtype="int32":
+           jax.random.poisson(key, lam, shape=tuple(size),
+                              dtype=jnp.dtype(dtype)),
+           differentiable=False)
+_reg_fixed("_npi_random_bernoulli",
+           lambda p=0.5, key=None, size=(), dtype="float32":
+           jax.random.bernoulli(key, p, shape=tuple(size))
+           .astype(jnp.dtype(dtype)),
+           differentiable=False)

@@ -59,18 +59,23 @@ class Operator:
     """
 
     def __init__(self, name: str, fn: Callable, num_outputs: Optional[int] = None,
-                 differentiable: bool = True, aliases=()):
+                 differentiable: bool = True, aliases=(), eager: bool = False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.differentiable = differentiable
         self.aliases = tuple(aliases)
+        self.eager = eager  # dynamic-output-shape ops cannot be jitted
         self._jit_cache: Dict = {}
 
     def bound(self, kwargs: dict) -> Callable:
         """A jitted executable for these static kwargs (cached)."""
         import jax
 
+        if self.eager:
+            # data-dependent output shape (nonzero/unique/...): run the
+            # emitter directly on concrete arrays, never under jit
+            return functools.partial(self.fn, **kwargs)
         key = _freeze(kwargs)
         try:
             return self._jit_cache[key]
@@ -95,12 +100,13 @@ class Operator:
 
 
 def register(name: str, num_outputs: Optional[int] = None, differentiable: bool = True,
-             aliases=()):
+             aliases=(), eager: bool = False):
     """Decorator: register a pure JAX function as a named op."""
 
     def deco(fn: Callable) -> Operator:
         op = Operator(name, fn, num_outputs=num_outputs,
-                      differentiable=differentiable, aliases=aliases)
+                      differentiable=differentiable, aliases=aliases,
+                      eager=eager)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op

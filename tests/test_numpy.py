@@ -1,0 +1,347 @@
+"""mx.np / mx.npx oracle tests vs real NumPy (parity model:
+tests/python/unittest/test_numpy_op.py + test_numpy_interoperability.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+npx = mx.npx
+
+RS = onp.random.RandomState(42)
+
+
+def _rand(*shape):
+    return RS.randn(*shape).astype(onp.float32)
+
+
+def _check(mx_out, onp_out, rtol=1e-5, atol=1e-5):
+    onp.testing.assert_allclose(mx_out.asnumpy(), onp_out, rtol=rtol,
+                                atol=atol)
+
+
+# ------------------------------------------------------------- creation ----
+
+def test_creation_functions():
+    assert np.ones((2, 3)).shape == (2, 3)
+    assert np.zeros(4).shape == (4,)
+    _check(np.full((2, 2), 7.0), onp.full((2, 2), 7.0))
+    _check(np.arange(10), onp.arange(10))
+    _check(np.linspace(0, 1, 5), onp.linspace(0, 1, 5).astype("float32"))
+    _check(np.eye(3), onp.eye(3, dtype="float32"))
+    a = np.array([[1, 2], [3, 4]], dtype="float32")
+    _check(np.zeros_like(a), onp.zeros((2, 2), "float32"))
+    _check(np.ones_like(a), onp.ones((2, 2), "float32"))
+    assert np.array(3.5).shape == ()  # zero-dim supported
+
+
+UNARY_CASES = [
+    ("absolute", onp.abs), ("sqrt", onp.sqrt), ("exp", onp.exp),
+    ("log", onp.log), ("sin", onp.sin), ("cos", onp.cos),
+    ("tanh", onp.tanh), ("floor", onp.floor), ("ceil", onp.ceil),
+    ("square", onp.square), ("sign", onp.sign), ("log1p", onp.log1p),
+    ("expm1", onp.expm1), ("arctan", onp.arctan), ("sinh", onp.sinh),
+    ("cbrt", onp.cbrt), ("radians", onp.radians), ("degrees", onp.degrees),
+]
+
+
+@pytest.mark.parametrize("name,ofn", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_oracle(name, ofn):
+    x = onp.abs(_rand(3, 4)) + 0.5  # positive domain works for all cases
+    _check(getattr(np, name)(np.array(x)), ofn(x), rtol=1e-4, atol=1e-5)
+
+
+BINARY_CASES = [
+    ("add", onp.add), ("subtract", onp.subtract),
+    ("multiply", onp.multiply), ("true_divide", onp.true_divide),
+    ("power", onp.power), ("maximum", onp.maximum),
+    ("minimum", onp.minimum), ("hypot", onp.hypot),
+    ("arctan2", onp.arctan2), ("logaddexp", onp.logaddexp),
+    ("fmod", onp.fmod), ("copysign", onp.copysign),
+]
+
+
+@pytest.mark.parametrize("name,ofn", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_oracle(name, ofn):
+    a, b = onp.abs(_rand(3, 4)) + 0.5, onp.abs(_rand(3, 4)) + 0.5
+    _check(getattr(np, name)(np.array(a), np.array(b)), ofn(a, b),
+           rtol=1e-4, atol=1e-5)
+
+
+def test_broadcasting_and_scalars():
+    a = _rand(3, 1)
+    b = _rand(1, 4)
+    _check(np.array(a) + np.array(b), a + b)
+    _check(np.array(a) * 2.5, a * 2.5)
+    _check(3.0 - np.array(a), 3.0 - a)
+    _check(2.0 / np.array(onp.abs(a) + 1), 2.0 / (onp.abs(a) + 1))
+
+
+def test_comparisons_return_bool():
+    a = np.array([1.0, 2.0, 3.0])
+    m = a > 2.0
+    assert onp.dtype(m.dtype) == onp.bool_
+    _check(m.astype("float32"), onp.array([0.0, 0.0, 1.0]))
+    assert bool((np.array([1.0]) == np.array([1.0])).item())
+
+
+def test_boolean_indexing():
+    x = _rand(4, 5)
+    a = np.array(x)
+    mask = a > 0
+    _check(a[mask], x[x > 0])
+    # fancy integer indexing
+    idx = onp.array([2, 0, 3])
+    _check(a[np.array(idx, dtype="int32")], x[idx])
+
+
+REDUCE_CASES = [
+    ("sum", onp.sum), ("mean", onp.mean), ("prod", onp.prod),
+    ("max", onp.max), ("min", onp.min), ("std", onp.std), ("var", onp.var),
+]
+
+
+@pytest.mark.parametrize("name,ofn", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_reductions_oracle(name, ofn, axis):
+    x = _rand(3, 4)
+    _check(getattr(np, name)(np.array(x), axis=axis), ofn(x, axis=axis),
+           rtol=1e-4, atol=1e-5)
+
+
+def test_argmax_sort_cumsum():
+    x = _rand(4, 5)
+    a = np.array(x)
+    _check(np.argmax(a, axis=1), onp.argmax(x, axis=1))
+    _check(np.argmin(a, axis=0), onp.argmin(x, axis=0))
+    _check(np.sort(a, axis=1), onp.sort(x, axis=1))
+    _check(np.argsort(a, axis=1), onp.argsort(x, axis=1))
+    _check(np.cumsum(a, axis=0), onp.cumsum(x, axis=0), rtol=1e-4)
+
+
+def test_shape_manipulation():
+    x = _rand(2, 3, 4)
+    a = np.array(x)
+    _check(a.reshape(6, 4), x.reshape(6, 4))
+    _check(a.T, x.T)
+    _check(np.transpose(a, (2, 0, 1)), onp.transpose(x, (2, 0, 1)))
+    _check(np.swapaxes(a, 0, 2), onp.swapaxes(x, 0, 2))
+    _check(np.expand_dims(a, 1), onp.expand_dims(x, 1))
+    _check(np.squeeze(np.ones((1, 3, 1))), onp.ones(3, "float32"))
+    _check(np.broadcast_to(np.ones((1, 3)), (4, 3)),
+           onp.ones((4, 3), "float32"))
+    _check(np.tile(a, (2, 1, 1)), onp.tile(x, (2, 1, 1)))
+    _check(np.repeat(a, 2, axis=1), onp.repeat(x, 2, axis=1))
+    _check(np.flip(a, axis=0), onp.flip(x, axis=0))
+    _check(np.roll(a, 1, axis=2), onp.roll(x, 1, axis=2))
+
+
+def test_concatenate_stack_split():
+    x, y = _rand(2, 3), _rand(2, 3)
+    _check(np.concatenate([np.array(x), np.array(y)], axis=0),
+           onp.concatenate([x, y], axis=0))
+    _check(np.stack([np.array(x), np.array(y)], axis=1),
+           onp.stack([x, y], axis=1))
+    _check(np.vstack([np.array(x), np.array(y)]), onp.vstack([x, y]))
+    _check(np.hstack([np.array(x), np.array(y)]), onp.hstack([x, y]))
+    parts = np.split(np.array(x), 3, axis=1)
+    oparts = onp.split(x, 3, axis=1)
+    assert len(parts) == 3
+    for p, op_ in zip(parts, oparts):
+        _check(p, op_)
+
+
+def test_where_take_clip():
+    x = _rand(3, 4)
+    a = np.array(x)
+    _check(np.where(a > 0, a, np.zeros_like(a)), onp.where(x > 0, x, 0))
+    _check(np.clip(a, -0.5, 0.5), onp.clip(x, -0.5, 0.5))
+    idx = onp.array([0, 2])
+    _check(np.take(a, np.array(idx, "int32"), axis=1),
+           onp.take(x, idx, axis=1))
+
+
+def test_einsum_oracle():
+    a, b = _rand(3, 4), _rand(4, 5)
+    _check(np.einsum("ij,jk->ik", np.array(a), np.array(b)),
+           onp.einsum("ij,jk->ik", a, b), rtol=1e-4)
+    c = _rand(2, 3, 4)
+    _check(np.einsum("bij->bji", np.array(c)), onp.einsum("bij->bji", c))
+    _check(np.einsum("ii->", np.array(_rand(4, 4) * 0 + onp.eye(4, dtype="float32"))),
+           onp.array(4.0, "float32"))
+
+
+def test_tensordot_matmul_dot():
+    a, b = _rand(3, 4), _rand(4, 5)
+    _check(np.tensordot(np.array(a), np.array(b), axes=1), a @ b, rtol=1e-4)
+    _check(np.matmul(np.array(a), np.array(b)), a @ b, rtol=1e-4)
+    _check(np.array(a) @ np.array(b), a @ b, rtol=1e-4)
+    _check(np.dot(np.array(a), np.array(b)), onp.dot(a, b), rtol=1e-4)
+    t1, t2 = _rand(2, 3, 4), _rand(4, 3, 2)
+    _check(np.tensordot(np.array(t1), np.array(t2), axes=((1, 2), (1, 0))),
+           onp.tensordot(t1, t2, axes=((1, 2), (1, 0))), rtol=1e-4)
+
+
+def test_linalg_oracle():
+    a = _rand(4, 4) + 4 * onp.eye(4, dtype="float32")  # well-conditioned
+    A = np.array(a)
+    _check(np.linalg.inv(A), onp.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    _check(np.linalg.det(A), onp.linalg.det(a), rtol=1e-3)
+    sign, logdet = np.linalg.slogdet(A)
+    osign, ologdet = onp.linalg.slogdet(a)
+    assert float(sign.item()) == pytest.approx(float(osign))
+    assert float(logdet.item()) == pytest.approx(float(ologdet), rel=1e-3)
+    b = _rand(4, 2)
+    _check(np.linalg.solve(A, np.array(b)), onp.linalg.solve(a, b),
+           rtol=1e-3, atol=1e-4)
+    q, r = np.linalg.qr(np.array(a))
+    onp.testing.assert_allclose((q.asnumpy() @ r.asnumpy()), a, atol=1e-4)
+    spd = a @ a.T + onp.eye(4, dtype="float32")
+    L = np.linalg.cholesky(np.array(spd))
+    onp.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-3,
+                                atol=1e-3)
+    w, v = np.linalg.eigh(np.array(spd))
+    ow = onp.linalg.eigvalsh(spd)
+    onp.testing.assert_allclose(onp.sort(w.asnumpy()), onp.sort(ow),
+                                rtol=1e-3, atol=1e-3)
+    _check(np.linalg.norm(A), onp.linalg.norm(a), rtol=1e-4)
+    u, s, vt = np.linalg.svd(np.array(a))
+    onp.testing.assert_allclose(
+        u.asnumpy() @ onp.diag(s.asnumpy()) @ vt.asnumpy(), a, atol=1e-3)
+
+
+def test_random_sanity():
+    np.random.seed(7)
+    u = np.random.uniform(2.0, 3.0, size=(1000,))
+    arr = u.asnumpy()
+    assert arr.min() >= 2.0 and arr.max() <= 3.0
+    assert abs(arr.mean() - 2.5) < 0.05
+    n = np.random.normal(0.0, 1.0, size=(2000,)).asnumpy()
+    assert abs(n.mean()) < 0.1 and abs(n.std() - 1.0) < 0.1
+    r = np.random.randint(0, 10, size=(500,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 10
+    # seeding reproduces
+    np.random.seed(3)
+    a1 = np.random.uniform(size=(5,)).asnumpy()
+    np.random.seed(3)
+    a2 = np.random.uniform(size=(5,)).asnumpy()
+    onp.testing.assert_array_equal(a1, a2)
+    assert np.random.choice(5, size=(3,)).shape == (3,)
+    p = np.random.permutation(10).asnumpy()
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_np_autograd():
+    w = np.array([1.0, 2.0, 3.0])
+    w.attach_grad()
+    with mx.autograd.record():
+        loss = np.sum(w * w + np.exp(w))
+    loss.backward()
+    onp.testing.assert_allclose(
+        w.grad.asnumpy(), 2 * onp.array([1, 2, 3]) + onp.exp([1, 2, 3]),
+        rtol=1e-5)
+    assert isinstance(w.grad, np.ndarray)
+
+
+def test_np_einsum_autograd():
+    a = np.array(_rand(3, 4))
+    b = np.array(_rand(4, 5))
+    a.attach_grad()
+    with mx.autograd.record():
+        out = np.einsum("ij,jk->ik", a, b).sum()
+    out.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                b.asnumpy().sum(axis=1)[None, :].repeat(3, 0),
+                                rtol=1e-4)
+
+
+def test_npx_nn_ops():
+    x = np.array(_rand(2, 8))
+    w = np.array(_rand(4, 8))
+    b = np.array(_rand(4))
+    out = npx.fully_connected(x, w, b, num_hidden=4)
+    _check(out, x.asnumpy() @ w.asnumpy().T + b.asnumpy(), rtol=1e-4)
+    assert isinstance(out, np.ndarray)
+    r = npx.relu(np.array([-1.0, 1.0]))
+    _check(r, onp.array([0.0, 1.0]))
+    sm = npx.softmax(np.array([[1.0, 2.0, 3.0]]))
+    e = onp.exp([1.0, 2.0, 3.0])
+    _check(sm, (e / e.sum())[None, :].astype("float32"), rtol=1e-5)
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), depth=3)
+    _check(oh, onp.eye(3, dtype="float32")[[0, 2]])
+
+
+def test_npx_set_np_roundtrip():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    d = {"a": np.ones((2, 2)), "b": np.arange(3)}
+    npx.save(f, d)
+    loaded = npx.load(f)
+    assert isinstance(loaded["a"], np.ndarray)
+    _check(loaded["a"], onp.ones((2, 2), "float32"))
+
+
+def test_np_nd_interop():
+    a = np.ones((2, 2))
+    legacy = a.as_nd_ndarray()
+    assert type(legacy).__name__ == "NDArray"
+    back = np._as_np(legacy)
+    assert isinstance(back, np.ndarray)
+
+
+def test_np_statistics():
+    x = _rand(100)
+    a = np.array(x)
+    _check(np.median(a), onp.median(x), rtol=1e-5)
+    _check(np.percentile(a, 30.0), onp.percentile(x, 30.0).astype("float32"),
+           rtol=1e-3)
+    _check(np.diff(a), onp.diff(x), rtol=1e-4)
+    h, edges = np.histogram(a, bins=10)
+    oh, oe = onp.histogram(x, bins=10)
+    onp.testing.assert_array_equal(h.asnumpy(), oh)
+
+
+def test_positional_args_bind_correctly():
+    # regression: _op1 used to silently drop positional args
+    x = onp.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    a = np.array(x)
+    _check(np.tril(a, 1), onp.tril(x, 1))
+    _check(np.tril(a, -1), onp.tril(x, -1))
+    _check(np.triu(a, 1), onp.triu(x, 1))
+    _check(np.cumsum(a, 1), onp.cumsum(x, 1))
+    _check(np.diag(np.array([1.0, 2.0]), 1), onp.diag(onp.array([1.0, 2.0], "float32"), 1))
+
+
+def test_dynamic_shape_ops_eager():
+    # regression: nonzero/unique/bincount used to fail under the op jit
+    x = onp.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+    a = np.array(x)
+    rows, cols = np.nonzero(a)
+    onp.testing.assert_array_equal(rows.asnumpy(), [0, 1])
+    onp.testing.assert_array_equal(cols.asnumpy(), [1, 0])
+    idx = np.where(a > 0)
+    assert isinstance(idx, tuple) and len(idx) == 2
+    u = np.unique(np.array([3, 1, 3, 2], dtype="int32"))
+    onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
+    bc = np.bincount(np.array([0, 1, 1, 3], dtype="int32"))
+    onp.testing.assert_array_equal(bc.asnumpy(), [1, 2, 0, 1])
+
+
+def test_np_gradient():
+    x = onp.array([1.0, 2.0, 4.0, 7.0], "float32")
+    _check(np.gradient(np.array(x)), onp.gradient(x))
+
+
+def test_result_type_no_transfer():
+    a = np.ones((2, 2))
+    assert np.result_type(a, "float64") == onp.float64
