@@ -66,3 +66,7 @@ def Dropout(data, p=0.5, mode="training", axes=(), **kwargs):  # noqa: N802
 
 
 setattr(_mod, "Dropout", Dropout)
+
+# contrib namespace (control flow + _contrib_* ops); imported last so it
+# can reuse _make_wrapper and the fully-populated registry
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,254 @@
+"""`mx.nd.contrib` — contrib op namespace + control-flow operators.
+
+Parity target: `python/mxnet/ndarray/contrib.py` (foreach :70,
+while_loop :193, cond :332) over `src/operator/control_flow.cc:35-180`
+(`_foreach`, `_while_loop`, `_cond` stateful ops executing subgraphs).
+
+TPU-native redesign: the body is a Python callable over NDArrays, traced
+ONCE into `lax.scan` / `lax.while_loop`-style executables — compiler
+control flow instead of the reference's subgraph-interpreting stateful
+ops. Because the trace happens inside `_invoke_fn`, gradients flow
+(scan's vjp) and the same callable works under `hybridize()` (the outer
+trace simply inlines). `while_loop` follows the reference's
+max_iterations contract: outputs padded to `max_iterations` rows plus the
+final loop state.
+
+Every `_contrib_*` registry op is also exposed here unprefixed
+(`mx.nd.contrib.box_nms` etc.), like the generated namespace in the
+reference.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from .ndarray import NDArray, _invoke_fn, array
+
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _wrap_all(raws):
+    return [NDArray(r) for r in raws]
+
+
+def _eager_mode(arrays):
+    """Recording outside a trace -> execute control flow op-by-op on the
+    tape (the reference's imperative path, which also differentiates
+    closure-captured parameters). Inside a trace (hybridize) or outside
+    recording -> compile with lax.scan/cond."""
+    import jax.core
+
+    from .. import autograd
+
+    traced = any(isinstance(a._data, jax.core.Tracer) for a in arrays)
+    return autograd.is_recording() and not traced
+
+
+def foreach(body, data, init_states):
+    """Run `body(data_slice, states) -> (outputs, new_states)` over axis 0
+    of `data`, scan-compiled (parity: ndarray/contrib.py:70)."""
+    import jax
+
+    data_list = [d if isinstance(d, NDArray) else array(d)
+                 for d in _as_list(data)]
+    state_list = [s if isinstance(s, NDArray) else array(s)
+                  for s in _as_list(init_states)]
+    data_single = not isinstance(data, (list, tuple))
+    states_single = not isinstance(init_states, (list, tuple))
+    n_data, n_state = len(data_list), len(state_list)
+    meta = {}
+
+    if _eager_mode(data_list + state_list):
+        from . import stack as _stack
+
+        states = init_states
+        out_cols = None
+        for i in range(data_list[0].shape[0]):
+            xs = [d[i] for d in data_list]
+            outs, states = body(xs[0] if data_single else xs, states)
+            outs_l = _as_list(outs)
+            if out_cols is None:
+                out_cols = [[] for _ in outs_l]
+                meta["out_single"] = not isinstance(outs, (list, tuple))
+            for col, o in zip(out_cols, outs_l):
+                col.append(o)
+        stacked = [_stack(*col, axis=0) for col in out_cols]
+        return (stacked[0] if meta["out_single"] else stacked), states
+
+    def fn(*raws):
+        d_raws, s_raws = raws[:n_data], raws[n_data:]
+
+        def step(carry, xs):
+            xs_nd = _wrap_all(xs)
+            st_nd = _wrap_all(carry)
+            outs, new_states = body(xs_nd[0] if data_single else xs_nd,
+                                    st_nd[0] if states_single else st_nd)
+            outs_l = _as_list(outs)
+            ns_l = _as_list(new_states)
+            meta["n_out"] = len(outs_l)
+            meta["out_single"] = not isinstance(outs, (list, tuple))
+            return (tuple(s._data for s in ns_l),
+                    tuple(o._data for o in outs_l))
+
+        final_states, ys = jax.lax.scan(
+            step, tuple(s_raws), tuple(d_raws))
+        return tuple(ys) + tuple(final_states)
+
+    flat = _invoke_fn(fn, "_foreach", data_list + state_list, {})
+    flat = list(flat) if isinstance(flat, tuple) else [flat]
+    outs = flat[:meta["n_out"]]
+    states = flat[meta["n_out"]:]
+    outs = outs[0] if meta["out_single"] else outs
+    states = states[0] if states_single else states
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """parity: ndarray/contrib.py:193 — run `func` while `cond` holds, at
+    most `max_iterations` times. Returns (outputs stacked over
+    max_iterations rows — rows beyond the actual iteration count are
+    zeros — and the final loop_vars).
+
+    Compiled as a masked scan (static trip count = max_iterations), which
+    keeps shapes static for XLA and makes the loop differentiable — the
+    TPU formulation of the reference's recorded-iteration backward."""
+    import jax
+    import jax.numpy as jnp
+
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    vars_single = not isinstance(loop_vars, (list, tuple))
+    var_list = [v if isinstance(v, NDArray) else array(v)
+                for v in _as_list(loop_vars)]
+    meta = {}
+
+    if _eager_mode(var_list):
+        from . import stack as _stack
+        from . import zeros_like as _zl
+
+        vs = var_list
+        out_cols = None
+        steps = 0
+        for _ in range(max_iterations):
+            pred = cond(vs[0]) if vars_single else cond(*vs)
+            if not bool(pred.asscalar()):
+                break
+            res = func(vs[0]) if vars_single else func(*vs)
+            outs, new_vs = res
+            outs_l = _as_list(outs)
+            if out_cols is None:
+                out_cols = [[] for _ in outs_l]
+                meta["out_single"] = not isinstance(outs, (list, tuple))
+            for col, o in zip(out_cols, outs_l):
+                col.append(o)
+            vs = [v if isinstance(v, NDArray) else array(v)
+                  for v in _as_list(new_vs)]
+            steps += 1
+        if out_cols is None:
+            raise ValueError("while_loop made zero iterations; cannot "
+                             "infer output structure")
+        # pad to max_iterations rows with zeros (reference contract)
+        for col in out_cols:
+            pad = _zl(col[0])
+            col.extend(pad for _ in range(max_iterations - steps))
+        stacked = [_stack(*col, axis=0) for col in out_cols]
+        outs = stacked[0] if meta["out_single"] else stacked
+        return outs, (vs[0] if vars_single else vs)
+
+    def fn(*raws):
+        def step(carry, _):
+            active, vs = carry
+            vs_nd = _wrap_all(vs)
+            packed = vs_nd[0] if vars_single else vs_nd
+            pred = cond(*_as_list(packed)) if not vars_single \
+                else cond(packed)
+            pred_raw = pred._data.astype(bool).reshape(())
+            run = active & pred_raw
+            outs, new_vs = func(*_as_list(packed)) if not vars_single \
+                else func(packed)
+            outs_l = _as_list(outs)
+            nv_l = [v._data for v in _as_list(new_vs)]
+            meta["n_out"] = len(outs_l)
+            meta["out_single"] = not isinstance(outs, (list, tuple))
+            kept = tuple(jnp.where(run, nv, v)
+                         for nv, v in zip(nv_l, vs))
+            ys = tuple(jnp.where(run, o._data,
+                                 jnp.zeros_like(o._data))
+                       for o in outs_l)
+            return (run, kept), ys
+
+        (_, final_vs), ys = jax.lax.scan(
+            step, (jnp.asarray(True), tuple(raws)), None,
+            length=max_iterations)
+        return tuple(ys) + tuple(final_vs)
+
+    flat = _invoke_fn(fn, "_while_loop", var_list, {})
+    flat = list(flat) if isinstance(flat, tuple) else [flat]
+    outs = flat[:meta["n_out"]]
+    final = flat[meta["n_out"]:]
+    outs = outs[0] if meta["out_single"] else outs
+    final = final[0] if vars_single else final
+    return outs, final
+
+
+def cond(pred, then_func, else_func):
+    """parity: ndarray/contrib.py:332 — traced lax.cond over the two
+    branches (both compiled; one executed)."""
+    import jax
+
+    pred_nd = pred if isinstance(pred, NDArray) else array(pred)
+    meta = {}
+
+    if _eager_mode([pred_nd]):
+        return then_func() if bool(pred_nd.asscalar()) else else_func()
+
+    def fn(p):
+        def run(branch):
+            outs = branch()
+            outs_l = _as_list(outs)
+            meta["single"] = not isinstance(outs, (list, tuple))
+            return tuple(o._data for o in outs_l)
+
+        return jax.lax.cond(p.astype(bool).reshape(()),
+                            lambda: run(then_func), lambda: run(else_func))
+
+    flat = _invoke_fn(fn, "_cond", [pred_nd], {})
+    if isinstance(flat, tuple) and meta["single"]:
+        return flat[0]
+    return list(flat) if isinstance(flat, tuple) else flat
+
+
+def isfinite(data):
+    return _invoke_fn(
+        lambda x: __import__("jax.numpy", fromlist=["x"]).isfinite(x)
+        .astype(x.dtype), "isfinite", [data], {})
+
+
+def isnan(data):
+    return _invoke_fn(
+        lambda x: __import__("jax.numpy", fromlist=["x"]).isnan(x)
+        .astype(x.dtype), "isnan", [data], {})
+
+
+def isinf(data):
+    return _invoke_fn(
+        lambda x: __import__("jax.numpy", fromlist=["x"]).isinf(x)
+        .astype(x.dtype), "isinf", [data], {})
+
+
+# expose every `_contrib_*` registry op unprefixed, like the generated
+# namespace in the reference (mx.nd.contrib.box_nms, .fft, .ROIAlign, ...)
+_mod = _sys.modules[__name__]
+from . import _make_wrapper  # noqa: E402
+
+for _name in _registry.list_ops():
+    _op = _registry.get(_name)
+    for _cand in (_name,) + _op.aliases:
+        if _cand.startswith("_contrib_"):
+            _short = _cand[len("_contrib_"):]
+            if not hasattr(_mod, _short):
+                setattr(_mod, _short, _make_wrapper(_name))

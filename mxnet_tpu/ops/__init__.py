@@ -13,5 +13,8 @@ from . import optimizer_op  # noqa: F401  (fused optimizer updates)
 from . import random_ops  # noqa: F401  (samplers)
 from . import quantization  # noqa: F401  (int8 quantize/dequantize/conv/fc)
 from . import numpy_ops  # noqa: F401  (_npi_* NumPy-frontend ops)
+from . import la_op  # noqa: F401  (linalg_* suite)
+from . import contrib_ops  # noqa: F401  (fft/detection/roi/stn/misc)
+from . import output_ops  # noqa: F401  (regression/SVM loss heads)
 
 __all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]

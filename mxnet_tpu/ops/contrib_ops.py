@@ -1,0 +1,505 @@
+"""Contrib ops: FFT, detection (MultiBox*/NMS/IOU), ROI pooling/align,
+spatial transformer, correlation, and misc contrib utilities.
+
+Parity targets: `src/operator/contrib/fft/`, `multibox_prior.cc`,
+`multibox_target.cc`, `multibox_detection.cc`, `bounding_box.cc`,
+`src/operator/roi_pooling.cc`, `contrib/roi_align.cc`,
+`spatial_transformer.cc`, `grid_generator.cc`, `bilinear_sampler.cc`,
+`contrib/correlation.cc`, `contrib/bilinear_resize.cc`,
+`contrib/boolean_mask.cc`, `contrib/index_copy.cc`,
+`contrib/multi_all_finite.cc`, `im2col.h`.
+
+TPU-native notes: everything is static-shape. NMS keeps the input shape
+and writes -1 into suppressed slots (exactly the reference's contract,
+which happens to be the TPU-friendly formulation — no dynamic output).
+ROI ops vmap over boxes with gather-based sampling; bilinear sampling is
+a 4-corner gather, fully fused by XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+# ------------------------------------------------------------------- fft ----
+
+@register("_contrib_fft")
+def _contrib_fft(data, compute_size=128):
+    """FFT along the last axis; complex output interleaved as
+    [..., 2*d] (re, im, re, im, ...) — parity: contrib/fft/fft-inl.h."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft")
+def _contrib_ifft(data, compute_size=128):
+    """Inverse of `_contrib_fft`'s interleaved layout; returns the real
+    part scaled like the reference (no 1/N — cuFFT semantics)."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (d, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    return (jnp.fft.ifft(comp, axis=-1).real * d).astype(jnp.float32)
+
+
+# ------------------------------------------------------------- detection ----
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor box generation (parity: multibox_prior.cc). Output
+    (1, H*W*(num_sizes+num_ratios-1), 4) corner-format boxes."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # H,W,2
+    # anchor shapes: (size_i, ratio_0) for all sizes, (size_0, ratio_j>0)
+    whs = []
+    for s in sizes:
+        r = ratios[0]
+        whs.append((s * jnp.sqrt(r), s / jnp.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * jnp.sqrt(r), s / jnp.sqrt(r)))
+    whs = jnp.asarray(whs, jnp.float32)  # (A, 2) = (w, h)
+    a = whs.shape[0]
+    cyx_b = jnp.broadcast_to(cyx[:, :, None, :], (h, w, a, 2))
+    half_w = whs[None, None, :, 0] / 2
+    half_h = whs[None, None, :, 1] / 2
+    xmin = cyx_b[..., 1] - half_w
+    ymin = cyx_b[..., 0] - half_h
+    xmax = cyx_b[..., 1] + half_w
+    ymax = cyx_b[..., 0] + half_h
+    out = jnp.stack([xmin, ymin, xmax, ymax], axis=-1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _center_to_corner(b):
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _corner_to_center(b):
+    x1, y1, x2, y2 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                     axis=-1)
+
+
+def _iou_corner(lhs, rhs):
+    """IOU between (..., N, 4) and (..., M, 4) corner boxes -> (..., N, M)."""
+    lx1, ly1, lx2, ly2 = [lhs[..., :, None, i] for i in range(4)]
+    rx1, ry1, rx2, ry2 = [rhs[..., None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(lx2, rx2) - jnp.maximum(lx1, rx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ly2, ry2) - jnp.maximum(ly1, ry1), 0.0)
+    inter = iw * ih
+    area_l = jnp.maximum(lx2 - lx1, 0.0) * jnp.maximum(ly2 - ly1, 0.0)
+    area_r = jnp.maximum(rx2 - rx1, 0.0) * jnp.maximum(ry2 - ry1, 0.0)
+    union = area_l + area_r - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou")
+def _contrib_box_iou(lhs, rhs, format="corner"):
+    """parity: bounding_box.cc box_iou."""
+    if format == "center":
+        lhs, rhs = _center_to_corner(lhs), _center_to_corner(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+def _nms_core(boxes, scores, ids, valid, overlap_thresh, topk):
+    """Greedy NMS over one batch element; returns keep mask (bool [N])."""
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_o = boxes[order]
+    valid_o = valid[order]
+    iou = _iou_corner(boxes_o, boxes_o)
+    same_class = ids[order][:, None] == ids[order][None, :]
+
+    def body(i, keep):
+        # suppress j>i overlapping with kept i of the same class
+        sup = (iou[i] > overlap_thresh) & same_class[i] & \
+            (jnp.arange(n) > i) & keep[i] & valid_o[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, n if topk < 0 else min(topk, n), body,
+                             valid_o)
+    # un-sort
+    inv = jnp.argsort(order)
+    return keep[inv]
+
+
+@register("box_nms", aliases=("_contrib_box_nms",), num_outputs=1)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+             in_format="corner", out_format="corner", background_id=-1):
+    """NMS keeping input shape, suppressed entries set to -1
+    (parity: bounding_box.cc BoxNMS)."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])  # (B, N, K)
+    boxes = flat[..., coord_start:coord_start + 4]
+    if in_format == "center":
+        boxes = _center_to_corner(boxes)
+    if out_format != in_format:
+        out_boxes = boxes if out_format == "corner" \
+            else _corner_to_center(boxes)
+        flat = flat.at[..., coord_start:coord_start + 4].set(out_boxes)
+    scores = flat[..., score_index]
+    if id_index >= 0 and not force_suppress:
+        ids = flat[..., id_index]
+    else:
+        ids = jnp.zeros_like(scores)
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid = valid & (flat[..., id_index] != background_id)
+    keep = jax.vmap(
+        lambda b, s, i, v: _nms_core(b, s, i, v, overlap_thresh, topk)
+    )(boxes, scores, ids, valid)
+    out = jnp.where(keep[..., None], flat, -jnp.ones_like(flat))
+    return out.reshape(shape)
+
+
+@register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget",),
+          num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor matching + target encoding (parity: multibox_target.cc).
+    anchor: (1, N, 4); label: (B, M, 5) [cls, x1, y1, x2, y2] (-1 pad);
+    cls_pred: (B, num_cls+1, N). Returns (loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N))."""
+    anchors = anchor[0]  # (N, 4)
+    n = anchors.shape[0]
+
+    def per_sample(lab, cls_pred_s):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt_boxes)  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match each VALID gt to its best anchor (`.add` not `.set`:
+        # padded gt rows all argmax to anchor 0 and a duplicate-index .set
+        # could erase a valid gt's forced match)
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        forced = jnp.zeros((n,), jnp.int32) \
+            .at[best_anchor].add(gt_valid.astype(jnp.int32)) > 0
+        pos = (best_iou >= overlap_threshold) | forced
+        matched_gt = gt_boxes[best_gt]
+        matched_cls = lab[best_gt, 0]
+        # encode: center offsets normalized by variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = matched_gt[:, 2] - matched_gt[:, 0]
+        gh = matched_gt[:, 3] - matched_gt[:, 1]
+        gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+        gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+        eps = 1e-8
+        tx = (gcx - acx) / jnp.maximum(aw, eps) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, eps) / variances[1]
+        tw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) \
+            / variances[2]
+        th = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) \
+            / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(pos[:, None],
+                          jnp.ones((n, 4), anchors.dtype), 0.0).reshape(-1)
+        cls_t = jnp.where(pos, matched_cls + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining (multibox_target.cc): rank unmatched
+            # anchors by max non-background confidence, keep the top
+            # ratio*num_pos as background samples, ignore the rest
+            neg_conf = jnp.max(cls_pred_s[1:], axis=0)  # (N,)
+            eligible = (~pos) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(pos)
+            num_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            score = jnp.where(eligible, neg_conf, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
+            keep_neg = eligible & (rank < num_neg)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return loc_t, loc_m, cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(
+        label, cls_pred)
+    return loc_target, loc_mask, cls_target
+
+
+@register("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions to detections + NMS (parity:
+    multibox_detection.cc). cls_prob: (B, C, N); loc_pred: (B, N*4);
+    anchor: (1, N, 4). Output (B, N, 6) [id, score, x1, y1, x2, y2]."""
+    b, c, n = cls_prob.shape
+    anchors = anchor[0]
+    loc = loc_pred.reshape(b, n, 4)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = loc[..., 0] * variances[0] * aw + acx
+    cy = loc[..., 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw / 2
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah / 2
+    boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # best non-background class per anchor
+    fg = jnp.concatenate([cls_prob[:, :background_id],
+                          cls_prob[:, background_id + 1:]], axis=1) \
+        if 0 <= background_id < c else cls_prob
+    best = jnp.argmax(fg, axis=1).astype(jnp.float32)  # (B, N)
+    score = jnp.max(fg, axis=1)
+    keep_score = score > threshold
+    det = jnp.concatenate([
+        jnp.where(keep_score, best, -1.0)[..., None],
+        jnp.where(keep_score, score, 0.0)[..., None], boxes], axis=-1)
+    return _box_nms.fn(det, overlap_thresh=nms_threshold,
+                       valid_thresh=threshold, topk=nms_topk,
+                       coord_start=2, score_index=1, id_index=0,
+                       force_suppress=force_suppress)
+
+
+# ------------------------------------------------------------------ rois ----
+
+def _bilinear_gather(img, ys, xs):
+    """Bilinear sample img (C, H, W) at float coords (ys, xs) of any
+    shape -> (C, *coords.shape). Out-of-range clamps (edge padding)."""
+    h, w = img.shape[-2], img.shape[-1]
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(ys - y0, 0.0, 1.0)
+    wx = jnp.clip(xs - x0, 0.0, 1.0)
+    y0i, y1i, x0i, x1i = (y0.astype(jnp.int32), y1.astype(jnp.int32),
+                          x0.astype(jnp.int32), x1.astype(jnp.int32))
+    v00 = img[:, y0i, x0i]
+    v01 = img[:, y0i, x1i]
+    v10 = img[:, y1i, x0i]
+    v11 = img[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max pooling over regions (parity: roi_pooling.cc). rois: (R, 5)
+    [batch_idx, x1, y1, x2, y2] in image coords."""
+    ph, pw = pooled_size
+    h, w = data.shape[2], data.shape[3]
+
+    def one_roi(roi):
+        img = data[roi[0].astype(jnp.int32)]  # (C, H, W)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        # sample a fixed 2x2 grid per bin, take max (static-shape stand-in
+        # for the reference's variable-size bin max)
+        sy = jnp.arange(ph)[:, None, None, None]
+        sx = jnp.arange(pw)[None, :, None, None]
+        oy = (jnp.arange(2)[None, None, :, None] + 0.5) / 2
+        ox = (jnp.arange(2)[None, None, None, :] + 0.5) / 2
+        ys = jnp.clip(y1 + (sy + oy) * bin_h, 0, h - 1)
+        xs = jnp.clip(x1 + (sx + ox) * bin_w, 0, w - 1)
+        ys = jnp.broadcast_to(ys, (ph, pw, 2, 2))
+        xs = jnp.broadcast_to(xs, (ph, pw, 2, 2))
+        vals = img[:, ys.astype(jnp.int32), xs.astype(jnp.int32)]
+        return vals.max(axis=(-2, -1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign")
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=2, position_sensitive=False, aligned=False):
+    """Bilinear average pooling over regions (parity: roi_align.cc)."""
+    ph, pw = pooled_size
+    s = max(int(sample_ratio), 1)
+
+    def one_roi(roi):
+        img = data[roi[0].astype(jnp.int32)]
+        off = 0.5 if aligned else 0.0
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rh = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
+        rw = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        sy = jnp.arange(ph)[:, None, None, None]
+        sx = jnp.arange(pw)[None, :, None, None]
+        oy = (jnp.arange(s)[None, None, :, None] + 0.5) / s
+        ox = (jnp.arange(s)[None, None, None, :] + 0.5) / s
+        ys = jnp.broadcast_to(y1 + (sy + oy) * bin_h, (ph, pw, s, s))
+        xs = jnp.broadcast_to(x1 + (sx + ox) * bin_w, (ph, pw, s, s))
+        return _bilinear_gather(img, ys, xs).mean(axis=(-2, -1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+# -------------------------------------------------- spatial transformer ----
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Affine/warp sampling grid (parity: grid_generator.cc). Output
+    (B, 2, H, W) with (x, y) in [-1, 1]."""
+    if transform_type == "affine":
+        b = data.shape[0]
+        h, w = target_shape
+        theta = data.reshape(b, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3,HW)
+        out = jnp.einsum("bij,jk->bik", theta, coords)  # (B, 2, HW)
+        return out.reshape(b, 2, h, w)
+    # warp: data is (B, 2, H, W) flow field added to the identity grid
+    b, _, h, w = data.shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy])[None]
+    norm = jnp.asarray([(w - 1) / 2.0, (h - 1) / 2.0],
+                       data.dtype).reshape(1, 2, 1, 1)
+    return base + data / norm
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    """Sample data (B,C,H,W) at grid (B,2,Ho,Wo) in [-1,1] (parity:
+    bilinear_sampler.cc). Out-of-range -> 0 (border zero-padding)."""
+    h, w = data.shape[2], data.shape[3]
+    xs = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    ys = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    inside = ((xs >= -1) & (xs <= w) & (ys >= -1) & (ys <= h))
+
+    out = jax.vmap(_bilinear_gather)(data, ys, xs)
+    return out * inside[:, None].astype(data.dtype)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=False):
+    """parity: spatial_transformer.cc — affine grid + bilinear sample."""
+    grid = _grid_generator.fn(loc, transform_type="affine",
+                              target_shape=tuple(target_shape))
+    return _bilinear_sampler.fn(data, grid)
+
+
+# ------------------------------------------------------------------ misc ----
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(data, height=1, width=1, scale_height=None,
+                       scale_width=None, mode="size"):
+    """parity: contrib/bilinear_resize.cc via jax.image.resize."""
+    h = int(data.shape[2] * scale_height) if scale_height else height
+    w = int(data.shape[3] * scale_width) if scale_width else width
+    return jax.image.resize(data, data.shape[:2] + (h, w), method="linear")
+
+
+@register("Correlation")
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """Cost-volume correlation (parity: contrib/correlation.cc,
+    FlowNet-style). Simplified to kernel_size=1 semantics: output channel
+    per displacement (d2 shifted), mean over channels."""
+    b, c, h, w = data1.shape
+    d = max_displacement
+    p1 = jnp.pad(data2, ((0, 0), (0, 0), (d + pad_size, d + pad_size),
+                         (d + pad_size, d + pad_size)))
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = jax.lax.dynamic_slice(
+                p1, (0, 0, d + pad_size + dy, d + pad_size + dx),
+                (b, c, h, w))
+            if is_multiply:
+                outs.append((data1 * shifted).mean(axis=1))
+            else:
+                outs.append(jnp.abs(data1 - shifted).mean(axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+@register("_contrib_boolean_mask", eager=True, differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    """parity: contrib/boolean_mask.cc (dynamic output -> eager)."""
+    idx = jnp.nonzero(index)[0]
+    return jnp.take(data, idx, axis=axis)
+
+
+@register("_contrib_index_copy")
+def _index_copy(old, index, new_tensor):
+    """parity: contrib/index_copy.cc — copy rows of new_tensor into old."""
+    return old.at[index.astype(jnp.int32)].set(new_tensor)
+
+
+@register("_contrib_arange_like")
+def _contrib_arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    n = data.shape[axis] if axis is not None else data.size
+    # each value emitted `repeat` times (parity: arange_like contract)
+    return start + step * (jnp.arange(n) // max(int(repeat), 1)) \
+        .astype(jnp.float32)
+
+
+@register("multi_all_finite")
+def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """1 when every element of every input is finite (parity:
+    contrib/multi_all_finite.cc — the AMP overflow check)."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(a).all()
+    return ok.astype(jnp.float32).reshape((1,))
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(data, h, s, out_dim=16, processing_batch_size=32):
+    """Count sketch projection (parity: contrib/count_sketch.cc)."""
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., idx].add(data * sign)
+
+
+@register("im2col")
+def _im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    """parity: im2col.h — patch extraction for explicit GEMM conv."""
+    n = len(kernel)
+    stride = tuple(stride) if stride else (1,) * n
+    dilate = tuple(dilate) if dilate else (1,) * n
+    pad = tuple(pad) if pad else (0,) * n
+    patches = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(kernel), window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate)
+    # (B, C*prod(kernel), *out_spatial) -> (B, C*prod(kernel), prod(out))
+    return patches.reshape(patches.shape[0], patches.shape[1], -1)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(data):
+    """parity: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad."""
+    return jax.lax.stop_gradient(data)

@@ -1,0 +1,145 @@
+"""Linear-algebra ops (parity: `src/operator/tensor/la_op.cc` — the
+`linalg_*` suite over mshadow/cuSOLVER; here lowered to XLA's native
+factorizations, which are MXU-tiled on TPU).
+
+MXNet conventions preserved: batched over leading dims, `linalg_syevd`
+returns eigenvectors as ROWS (A = U^T diag(L) U), `linalg_gelqf` yields
+A = L Q with Q having orthonormal rows, `linalg_potri` computes the
+inverse from a Cholesky factor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register("linalg_gemm")
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    """parity: la_op.cc linalg_gemm — C = alpha*op(A)op(B) + beta*C."""
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_potri")
+def _linalg_potri(A, lower=True):
+    """Inverse from a Cholesky factor: inv(B) where B = A A^T (lower).
+    parity: la_op.cc linalg_potri."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(A, eye, lower=lower)
+    return jnp.matmul(_t(inv_l), inv_l) if lower \
+        else jnp.matmul(inv_l, _t(inv_l))
+
+
+@register("linalg_trmm")
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    """Triangular matrix multiply (parity: la_op.cc linalg_trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = _t(tri)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register("linalg_trsm")
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    """Triangular solve (parity: la_op.cc linalg_trsm): solves
+    op(A) X = alpha B (or X op(A) = alpha B when rightside)."""
+    if rightside:
+        # X op(A) = aB  <=>  op(A)^T X^T = a B^T
+        sol = jax.scipy.linalg.solve_triangular(
+            A, _t(alpha * B), lower=lower, trans=0 if transpose else 1)
+        return _t(sol)
+    return jax.scipy.linalg.solve_triangular(
+        A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_gelqf", num_outputs=2)
+def _linalg_gelqf(A):
+    """LQ factorization A = L Q (parity: la_op.cc linalg_gelqf)."""
+    q, r = jnp.linalg.qr(_t(A))
+    return _t(r), _t(q)
+
+
+@register("linalg_syevd", num_outputs=2)
+def _linalg_syevd(A):
+    """Symmetric eigendecomposition, A = U^T diag(L) U with eigenvectors
+    as rows (parity: la_op.cc linalg_syevd)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("linalg_sumlogdiag")
+def _linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def _linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def _linalg_makediag(A, offset=0):
+    base = jnp.zeros(A.shape[:-1] + (A.shape[-1] + abs(offset),) * 2,
+                     A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    return base.at[..., rows, cols].set(A)
+
+
+@register("linalg_extracttrian")
+def _linalg_extracttrian(A, offset=0, lower=True):
+    """Extract the triangle as a packed vector (parity: la_op.cc)."""
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("linalg_maketrian")
+def _linalg_maketrian(A, offset=0, lower=True):
+    """Unpack a packed triangle vector into a matrix (parity: la_op.cc;
+    like the reference, offset > 0 implies the upper triangle and
+    offset < 0 the lower one)."""
+    import math
+
+    m = A.shape[-1]
+    k = abs(offset)
+    # the packed triangle has t(t+1)/2 elements where t = n - k
+    t = (math.isqrt(8 * m + 1) - 1) // 2
+    n = t + k
+    if offset > 0 or (offset == 0 and not lower):
+        rows, cols = jnp.triu_indices(n, k=k)
+    else:
+        rows, cols = jnp.tril_indices(n, k=-k)
+    base = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return base.at[..., rows, cols].set(A)
+
+
+@register("linalg_det")
+def _linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", num_outputs=2)
+def _linalg_slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("linalg_inverse")
+def _linalg_inverse(A):
+    return jnp.linalg.inv(A)

@@ -1,0 +1,126 @@
+"""Loss-head output ops with the reference's hand-written gradients.
+
+Parity: `src/operator/regression_output.cc` (LinearRegressionOutput :63,
+MAERegressionOutput :84, LogisticRegressionOutput :74) and
+`src/operator/svm_output.cc`. Forward is the prediction; backward ignores
+head cotangents and injects the loss gradient directly — loss-head
+semantics identical to SoftmaxOutput, so Module graphs train exactly like
+the reference."""
+from __future__ import annotations
+
+import functools as _functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _make_output_op(name, fwd_fn, grad_fn):
+    @_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def fwd(data, label, grad_scale):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, cot):
+        out, label = res
+        # reference normalizes by outputs-per-sample
+        # (regression_output-inl.h: scale = grad_scale / num_output)
+        num_output = out.size // out.shape[0] if out.ndim > 0 else 1
+        g = grad_fn(out, label) * (grad_scale / num_output)
+        return g.astype(out.dtype), jnp.zeros_like(label)
+
+    core.defvjp(fwd, bwd)
+
+    @register(name)
+    def op(data, label, grad_scale=1.0):
+        lab = label.reshape(data.shape) if label.size == data.size \
+            else label
+        return core(data, lab, grad_scale)
+
+    op.fn.__name__ = name
+    return op
+
+
+_make_output_op("LinearRegressionOutput",
+                lambda d: d,
+                lambda out, label: out - label)
+_make_output_op("MAERegressionOutput",
+                lambda d: d,
+                lambda out, label: jnp.sign(out - label))
+_make_output_op("LogisticRegressionOutput",
+                jax.nn.sigmoid,
+                lambda out, label: out - label)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, use_linear, res, cot):
+    """parity: svm_output-inl.h — L1/L2 hinge gradient on the true-class
+    margin versus every other class."""
+    data, label = res
+    num_classes = data.shape[-1]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), num_classes,
+                            dtype=data.dtype)
+    score_true = jnp.sum(data * onehot, axis=-1, keepdims=True)
+    viol = margin - (score_true - data)  # margin violation per class
+    viol = jnp.where(onehot > 0, 0.0, viol)
+    if use_linear:
+        mask = (viol > 0).astype(data.dtype)
+        g_other = mask * reg_coef
+    else:
+        g_other = jnp.maximum(viol, 0.0) * 2.0 * reg_coef
+    g_true = -jnp.sum(g_other, axis=-1, keepdims=True)
+    g = g_other + g_true * onehot
+    return g.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    return _svm_core(data, label, margin, regularization_coefficient,
+                     use_linear)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _kl_sparse_core(data, sparseness_target, penalty, momentum):
+    return data
+
+
+def _kl_fwd(data, sparseness_target, penalty, momentum):
+    return data, data
+
+
+def _kl_bwd(sparseness_target, penalty, momentum, data, cot):
+    """parity: src/operator/identity_attach_KL_sparse_reg.cc — identity
+    forward; backward adds the KL sparsity penalty gradient on the mean
+    activation rho_hat per hidden unit."""
+    rho_hat = jnp.clip(jnp.mean(data, axis=0, keepdims=True), 1e-6,
+                       1 - 1e-6)
+    rho = sparseness_target
+    kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+    return (cot + kl_grad / data.shape[0]).astype(data.dtype),
+
+
+_kl_sparse_core.defvjp(_kl_fwd, _kl_bwd)
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_attach_kl(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9):
+    return _kl_sparse_core(data, sparseness_target, penalty, momentum)

@@ -40,3 +40,6 @@ for _name in _registry.list_ops():
     for _exposed in (_name,) + _op.aliases:
         if not hasattr(_mod, _exposed):
             setattr(_mod, _exposed, _make_wrapper(_name))
+
+# contrib namespace (imported last: needs _make_wrapper + full registry)
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,21 @@
+"""`mx.sym.contrib` — symbolic contrib op namespace (parity:
+`python/mxnet/symbol/contrib.py`). The `_contrib_*` registry ops exposed
+unprefixed for graph building; symbolic control flow is served by the
+hybridize path (Python `mx.nd.contrib.foreach`/`while_loop`/`cond`
+callables trace into `lax.scan`/`cond` inside the compiled executable, so
+no separate subgraph-op representation is needed)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from . import _make_wrapper
+
+_mod = _sys.modules[__name__]
+for _name in _registry.list_ops():
+    _op = _registry.get(_name)
+    for _cand in (_name,) + _op.aliases:
+        if _cand.startswith("_contrib_"):
+            _short = _cand[len("_contrib_"):]
+            if not hasattr(_mod, _short):
+                setattr(_mod, _short, _make_wrapper(_name))

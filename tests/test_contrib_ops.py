@@ -1,0 +1,411 @@
+"""Op-corpus tail tests: control flow, la_op suite, fft, detection,
+ROI/STN, regression outputs (parity model:
+tests/python/unittest/test_contrib_control_flow.py, test_operator.py
+la_op / detection sections)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RS = onp.random.RandomState(7)
+
+
+def _rand(*shape):
+    return RS.randn(*shape).astype(onp.float32)
+
+
+# --------------------------------------------------------- control flow ----
+
+def test_foreach_cumsum():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    init = nd.zeros((3,))
+    outs, final = nd.contrib.foreach(lambda x, s: (x + s, x + s), data, init)
+    ref = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), ref)
+    onp.testing.assert_allclose(final.asnumpy(), ref[-1])
+
+
+def test_foreach_gradient_through_closure():
+    data = nd.array(_rand(4, 3))
+    init = nd.zeros((1,))
+    w = nd.array(onp.ones(3, "float32"))
+    w.attach_grad()
+    with mx.autograd.record():
+        o, _ = nd.contrib.foreach(lambda x, s: ((x * w).sum(), s), data,
+                                  init)
+        loss = o.sum()
+    loss.backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(),
+                                data.asnumpy().sum(axis=0), rtol=1e-5)
+
+
+def test_foreach_multiple_data_and_states():
+    d1, d2 = nd.array(_rand(3, 2)), nd.array(_rand(3, 2))
+    s1, s2 = nd.zeros((2,)), nd.ones((2,))
+
+    def body(xs, states):
+        a, b = xs
+        u, v = states
+        return [a + u, b * v], [u + a, v]
+
+    outs, states = nd.contrib.foreach(body, [d1, d2], [s1, s2])
+    assert len(outs) == 2 and len(states) == 2
+    onp.testing.assert_allclose(
+        states[0].asnumpy(), d1.asnumpy().sum(axis=0), rtol=1e-5)
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return (s,), (i + 1, s + i)
+
+    outs, (i_f, s_f) = nd.contrib.while_loop(
+        cond_fn, func, (nd.array([0.0]), nd.array([0.0])),
+        max_iterations=8)
+    assert float(i_f.asscalar()) == 5
+    assert float(s_f.asscalar()) == 10
+    assert outs[0].shape == (8, 1)  # padded to max_iterations
+
+
+def test_cond():
+    t = lambda: nd.array([2.0])  # noqa: E731
+    f = lambda: nd.array([3.0])  # noqa: E731
+    assert float(nd.contrib.cond(nd.array([1.0]), t, f).asscalar()) == 2.0
+    assert float(nd.contrib.cond(nd.array([0.0]), t, f).asscalar()) == 3.0
+
+
+def test_control_flow_in_hybrid_trace():
+    """foreach inside a hybridized block compiles to one executable."""
+    from mxnet_tpu.gluon import nn
+
+    class Scan(nn.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, _ = nd.contrib.foreach(
+                lambda xi, s: (xi * 2, s), x, nd.zeros((1,)))
+            return outs
+
+    net = Scan()
+    net.hybridize()
+    x = nd.array(_rand(4, 3))
+    out = net(x)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- la_op ----
+
+def test_linalg_gemm():
+    A, B, C = _rand(3, 4), _rand(4, 5), _rand(3, 5)
+    out = nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C), alpha=2.0,
+                         beta=0.5)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * A @ B + 0.5 * C,
+                                rtol=1e-4, atol=1e-5)
+    out_t = nd.linalg_gemm(nd.array(A.T), nd.array(B), nd.array(C),
+                           transpose_a=True)
+    onp.testing.assert_allclose(out_t.asnumpy(), A @ B + C, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_linalg_trsm_trmm():
+    A = onp.tril(RS.rand(4, 4).astype("float32")) + \
+        2 * onp.eye(4, dtype="float32")
+    B = _rand(4, 3)
+    X = nd.linalg_trsm(nd.array(A), nd.array(B))
+    onp.testing.assert_allclose(A @ X.asnumpy(), B, rtol=1e-4, atol=1e-4)
+    Y = nd.linalg_trmm(nd.array(A), nd.array(B))
+    onp.testing.assert_allclose(Y.asnumpy(), onp.tril(A) @ B, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_linalg_potri_inverse_det():
+    B = _rand(4, 4)
+    spd = B @ B.T + 4 * onp.eye(4, dtype="float32")
+    L = onp.linalg.cholesky(spd).astype(onp.float32)
+    inv = nd.linalg_potri(nd.array(L))
+    onp.testing.assert_allclose(inv.asnumpy(), onp.linalg.inv(spd),
+                                rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(
+        nd.linalg_inverse(nd.array(spd)).asnumpy(), onp.linalg.inv(spd),
+        rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(
+        nd.linalg_det(nd.array(spd)).asnumpy(), onp.linalg.det(spd),
+        rtol=1e-3)
+
+
+def test_linalg_syevd_gelqf():
+    B = _rand(4, 4)
+    spd = B @ B.T + 4 * onp.eye(4, dtype="float32")
+    U, L = nd.linalg_syevd(nd.array(spd))
+    onp.testing.assert_allclose(
+        U.asnumpy().T @ onp.diag(L.asnumpy()) @ U.asnumpy(), spd,
+        rtol=1e-3, atol=1e-3)
+    A = _rand(2, 4)
+    Lq, Q = nd.linalg_gelqf(nd.array(A))
+    onp.testing.assert_allclose(Lq.asnumpy() @ Q.asnumpy(), A, rtol=1e-4,
+                                atol=1e-5)
+    onp.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T,
+                                onp.eye(2), rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_diag_helpers():
+    A = _rand(3, 3)
+    onp.testing.assert_allclose(
+        nd.linalg_extractdiag(nd.array(A)).asnumpy(), onp.diag(A))
+    d = _rand(3)
+    onp.testing.assert_allclose(
+        nd.linalg_makediag(nd.array(d)).asnumpy(), onp.diag(d))
+    spd = A @ A.T + 4 * onp.eye(3, dtype="float32")
+    L = onp.linalg.cholesky(spd).astype(onp.float32)
+    onp.testing.assert_allclose(
+        nd.linalg_sumlogdiag(nd.array(L)).asnumpy(),
+        onp.log(onp.diag(L)).sum(), rtol=1e-5)
+
+
+def test_linalg_sumlogdiag_gradient():
+    B = _rand(3, 3)
+    spd = B @ B.T + 4 * onp.eye(3, dtype="float32")
+    L = onp.linalg.cholesky(spd).astype(onp.float32)
+    check_numeric_gradient("linalg_sumlogdiag", [nd.array(L)])
+
+
+# ------------------------------------------------------------------ fft ----
+
+def test_fft_roundtrip_and_oracle():
+    x = _rand(2, 8)
+    f = nd.contrib.fft(nd.array(x))
+    ref = onp.fft.fft(x, axis=-1)
+    inter = onp.stack([ref.real, ref.imag], axis=-1).reshape(2, 16)
+    onp.testing.assert_allclose(f.asnumpy(), inter, rtol=1e-4, atol=1e-4)
+    back = nd.contrib.ifft(f) / 8
+    onp.testing.assert_allclose(back.asnumpy(), x, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ detection ----
+
+def test_multibox_prior():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)),
+                                       sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at cell (0,0): centered at (0.125, 0.125), size 0.5
+    onp.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                       0.125 + 0.25, 0.125 + 0.25],
+                                atol=1e-6)
+
+
+def test_box_iou():
+    iou = nd.contrib.box_iou(nd.array([[0.0, 0.0, 1.0, 1.0]]),
+                             nd.array([[0.0, 0.0, 1.0, 1.0],
+                                       [0.5, 0.5, 1.5, 1.5]]))
+    onp.testing.assert_allclose(iou.asnumpy(), [[1.0, 0.25 / 1.75]],
+                                rtol=1e-5)
+
+
+def test_box_nms():
+    dets = nd.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                      [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                      [1, 0.7, 0.6, 0.6, 0.9, 0.9]]])
+    kept = nd.contrib.box_nms(dets, overlap_thresh=0.5)
+    k = kept.asnumpy()[0]
+    assert k[0][1] == pytest.approx(0.9)  # top box kept
+    assert k[1][0] == -1                  # overlapping same-class removed
+    assert k[2][0] == 1                   # other class kept
+    # force_suppress ignores class ids
+    dets2 = nd.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                       [1, 0.8, 0.1, 0.1, 0.5, 0.5]]])
+    k2 = nd.contrib.box_nms(dets2, overlap_thresh=0.5,
+                            force_suppress=True).asnumpy()[0]
+    assert k2[1][0] == -1
+
+
+def test_multibox_target_and_detection():
+    anc = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(0.5,),
+                                   ratios=(1.0,))
+    lab = nd.array([[[0, 0.1, 0.1, 0.6, 0.6]]])
+    cls_pred = nd.zeros((1, 2, 4))
+    lt, lm, ct = nd.contrib.MultiBoxTarget(anc, lab, cls_pred)
+    assert lt.shape == (1, 16) and lm.shape == (1, 16) and ct.shape == (1, 4)
+    assert ct.asnumpy().max() == 1.0  # one anchor matched to class 0 (+1)
+    cls_prob = nd.array(RS.rand(1, 2, 4).astype("float32"))
+    det = nd.contrib.MultiBoxDetection(cls_prob, nd.zeros((1, 16)), anc)
+    assert det.shape == (1, 4, 6)
+
+
+# ------------------------------------------------------------- roi / stn ----
+
+def test_roi_pooling_and_align():
+    img = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3]])
+    out = nd.ROIPooling(img, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    # max of each quadrant
+    onp.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+    ra = nd.contrib.ROIAlign(img, rois, pooled_size=(2, 2),
+                             spatial_scale=1.0)
+    assert ra.shape == (1, 1, 2, 2)
+
+
+def test_spatial_transformer_identity_and_shift():
+    img = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    ident = nd.array([[1.0, 0.0, 0.0, 0.0, 1.0, 0.0]])
+    out = nd.SpatialTransformer(img, ident, target_shape=(4, 4))
+    onp.testing.assert_allclose(out.asnumpy(), img.asnumpy(), atol=1e-4)
+    # zoom x2 (theta scales coordinates by 0.5 -> center crop upsampled)
+    zoom = nd.array([[0.5, 0.0, 0.0, 0.0, 0.5, 0.0]])
+    out2 = nd.SpatialTransformer(img, zoom, target_shape=(4, 4))
+    assert out2.shape == (1, 1, 4, 4)
+
+
+def test_bilinear_sampler_grad():
+    img = nd.array(_rand(1, 1, 4, 4))
+    ys = onp.linspace(-0.9, 0.9, 3, dtype="float32")
+    xs = onp.linspace(-0.9, 0.9, 3, dtype="float32")
+    gy, gx = onp.meshgrid(ys, xs, indexing="ij")
+    grid = nd.array(onp.stack([gx, gy])[None])
+    check_numeric_gradient("BilinearSampler", [img, grid], rtol=5e-2,
+                           atol=1e-2)
+
+
+def test_bilinear_resize():
+    img = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    out = nd.contrib.BilinearResize2D(img, height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0, 0, 0], 0.0, atol=1e-5)
+
+
+# ----------------------------------------------------------- loss heads ----
+
+def test_regression_outputs():
+    x = nd.array([[1.0, 2.0]])
+    lbl = nd.array([[0.5, 0.5]])
+    num_output = 2  # reference grad normalization: grad_scale / num_output
+    for op_name, fwd, grad in [
+        ("LinearRegressionOutput", lambda v: v, lambda v, l: v - l),
+        ("MAERegressionOutput", lambda v: v,
+         lambda v, l: onp.sign(v - l)),
+        ("LogisticRegressionOutput",
+         lambda v: 1 / (1 + onp.exp(-v)),
+         lambda v, l: 1 / (1 + onp.exp(-v)) - l),
+    ]:
+        xc = x.copy()
+        xc.attach_grad()
+        with mx.autograd.record():
+            out = nd.invoke(op_name, xc, lbl)
+        onp.testing.assert_allclose(out.asnumpy(), fwd(x.asnumpy()),
+                                    rtol=1e-5)
+        out.backward()
+        onp.testing.assert_allclose(
+            xc.grad.asnumpy(),
+            grad(x.asnumpy(), lbl.asnumpy()) / num_output, rtol=1e-5)
+
+
+def test_svm_output_grad():
+    x = nd.array([[2.0, 1.0, 0.0]])
+    lbl = nd.array([0.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        out = nd.SVMOutput(x, lbl, margin=1.0,
+                           regularization_coefficient=1.0, use_linear=True)
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    out.backward()
+    # class1 violates (margin 1 - (2-1) = 0, not > 0), class2 violates
+    # (1 - (2-0) = -1 < 0): actually neither violates -> zero grad
+    onp.testing.assert_allclose(x.grad.asnumpy(), [[0.0, 0.0, 0.0]])
+    x2 = nd.array([[0.5, 1.0, 0.0]])
+    x2.attach_grad()
+    with mx.autograd.record():
+        out = nd.SVMOutput(x2, lbl, use_linear=True)
+    out.backward()
+    g = x2.grad.asnumpy()[0]
+    assert g[1] > 0 and g[0] < 0  # violating class pushed down, true up
+
+
+def test_block_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * 2).sum() + nd.BlockGrad(x * 100).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+
+# ------------------------------------------------------------------ misc ----
+
+def test_im2col():
+    img = nd.array(_rand(1, 2, 4, 4))
+    out = nd.im2col(img, kernel=(2, 2), stride=(1, 1))
+    assert out.shape == (1, 2 * 4, 9)
+
+
+def test_multi_all_finite():
+    ok = nd.multi_all_finite(nd.ones((2, 2)), nd.ones((3,)))
+    assert float(ok.asscalar()) == 1.0
+    bad = nd.multi_all_finite(nd.array([onp.inf]), nd.ones((3,)))
+    assert float(bad.asscalar()) == 0.0
+
+
+def test_correlation_shape():
+    a = nd.array(_rand(1, 2, 4, 4))
+    out = nd.Correlation(a, a, max_displacement=1)
+    assert out.shape == (1, 9, 4, 4)
+    # zero displacement channel == mean over channels of a*a
+    onp.testing.assert_allclose(
+        out.asnumpy()[0, 4], (a.asnumpy()[0] ** 2).mean(axis=0), rtol=1e-4)
+
+
+def test_boolean_mask_index_copy():
+    bm = nd.contrib.boolean_mask(nd.array([[1.0, 2], [3, 4], [5, 6]]),
+                                 nd.array([1, 0, 1]))
+    onp.testing.assert_allclose(bm.asnumpy(), [[1, 2], [5, 6]])
+    ic = nd.contrib.index_copy(nd.zeros((3, 2)),
+                               nd.array([1], dtype="int32"),
+                               nd.array([[7.0, 8.0]]))
+    onp.testing.assert_allclose(ic.asnumpy(), [[0, 0], [7, 8], [0, 0]])
+
+
+def test_maketrian_roundtrip():
+    A = _rand(4, 4)
+    for offset, lower in [(0, True), (0, False), (-1, True), (1, False)]:
+        packed = nd.linalg_extracttrian(nd.array(A), offset=offset,
+                                        lower=lower)
+        back = nd.linalg_maketrian(packed, offset=offset, lower=lower)
+        tri = onp.tril(A, offset) if lower else onp.triu(A, offset)
+        if offset < 0:
+            tri = onp.tril(A, offset)
+        elif offset > 0:
+            tri = onp.triu(A, offset)
+        onp.testing.assert_allclose(back.asnumpy(), tri, rtol=1e-6)
+
+
+def test_box_nms_out_format():
+    # center in -> corner out conversion applied to surviving rows
+    dets = nd.array([[[0, 0.9, 0.5, 0.5, 0.4, 0.4]]])  # cx,cy,w,h
+    kept = nd.contrib.box_nms(dets, in_format="center", out_format="corner")
+    onp.testing.assert_allclose(kept.asnumpy()[0, 0, 2:],
+                                [0.3, 0.3, 0.7, 0.7], rtol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anc = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.3,),
+                                   ratios=(1.0,))
+    lab = nd.array([[[0, 0.1, 0.1, 0.4, 0.4]]])
+    cls_pred = nd.array(RS.rand(1, 2, 16).astype("float32"))
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        anc, lab, cls_pred, negative_mining_ratio=2.0, ignore_label=-1.0)
+    c = ct.asnumpy()[0]
+    num_pos = (c == 1.0).sum()
+    num_neg = (c == 0.0).sum()
+    num_ign = (c == -1.0).sum()
+    assert num_pos >= 1
+    assert num_neg <= 2 * num_pos
+    assert num_ign > 0  # the rest ignored
+
+
+def test_arange_like_repeat():
+    x = nd.zeros((6,))
+    out = nd.contrib.arange_like(x, start=1.0, step=0.5, repeat=2)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                [1.0, 1.0, 1.5, 1.5, 2.0, 2.0])
