@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""opperf: per-operator micro-benchmark harness over the registry.
+
+Parity target: `benchmark/opperf/opperf.py` — run every (or a chosen
+subset of) registered operator with default synthetic inputs, time
+forward (and backward where differentiable), and emit results as JSON or
+a console table.
+
+Usage:
+    python benchmark/opperf.py                      # common op set
+    python benchmark/opperf.py --ops dot,softmax    # chosen ops
+    python benchmark/opperf.py --all                # whole registry
+    python benchmark/opperf.py --output-format json
+
+Timing methodology matches the reference's profiler-driven runs: warmup
+iterations first (includes XLA compile), then `--runs` timed executions
+synchronized via wait_to_read (dispatch+device time per call).
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+
+# default input builders per op-shape family; (args, kwargs) given a size
+_DEFAULT_SIZE = 1024
+
+
+def _rand(*shape):
+    return mx.nd.array(np.random.rand(*shape).astype(np.float32))
+
+
+def _inputs_for(op_name, n):
+    """Best-effort default inputs for an op; None = not benchmarkable
+    with generic inputs."""
+    special = {
+        "dot": ([_rand(n, n), _rand(n, n)], {}),
+        "batch_dot": ([_rand(8, n // 8, n // 8), _rand(8, n // 8, n // 8)],
+                      {}),
+        "FullyConnected": ([_rand(64, n), _rand(256, n), _rand(256)],
+                           {"num_hidden": 256}),
+        "Convolution": ([_rand(8, 16, 32, 32), _rand(32, 16, 3, 3),
+                         _rand(32)],
+                        {"kernel": (3, 3), "num_filter": 32,
+                         "pad": (1, 1)}),
+        "Pooling": ([_rand(8, 16, 32, 32)],
+                    {"kernel": (2, 2), "stride": (2, 2),
+                     "pool_type": "max"}),
+        "BatchNorm": ([_rand(8, 16, 32, 32), _rand(16), _rand(16),
+                       _rand(16), _rand(16)], {}),
+        "softmax": ([_rand(64, n)], {}),
+        "log_softmax": ([_rand(64, n)], {}),
+        "sum": ([_rand(n, n)], {}),
+        "mean": ([_rand(n, n)], {}),
+        "transpose": ([_rand(n, n)], {}),
+        "sgd_update": ([_rand(n, n), _rand(n, n)], {"lr": 0.1}),
+        "sgd_mom_update": ([_rand(n, n), _rand(n, n), _rand(n, n)],
+                           {"lr": 0.1, "momentum": 0.9}),
+        "adam_update": ([_rand(n, n), _rand(n, n), _rand(n, n),
+                         _rand(n, n)], {"lr": 0.001}),
+    }
+    if op_name in special:
+        return special[op_name]
+    op = registry.get(op_name)
+    sig = inspect.signature(op.fn)
+    arrays = []
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            arrays.extend([_rand(n, n), _rand(n, n)])
+            break
+        if p.default is inspect.Parameter.empty and p.name not in (
+                "key", "training"):
+            arrays.append(_rand(n, n))
+        else:
+            break
+    if not arrays:
+        return None
+    return arrays, {}
+
+
+COMMON_OPS = [
+    "elemwise_add", "broadcast_add", "broadcast_mul", "dot", "batch_dot",
+    "FullyConnected", "Convolution", "Pooling", "BatchNorm", "softmax",
+    "log_softmax", "relu", "sigmoid", "exp", "log", "sum", "mean",
+    "transpose", "sgd_update", "sgd_mom_update", "adam_update",
+]
+
+
+def bench_op(op_name, size, runs, warmup, with_backward=True):
+    built = _inputs_for(op_name, size)
+    if built is None:
+        return None
+    arrays, kwargs = built
+    op = registry.get(op_name)
+
+    def run_fwd():
+        out = mx.nd.invoke(op_name, *arrays, **kwargs)
+        (out[0] if isinstance(out, tuple) else out).wait_to_read()
+        return out
+
+    try:
+        for _ in range(warmup):
+            run_fwd()
+    except Exception as exc:  # op not benchmarkable with generic inputs
+        return {"operator": op_name, "error": str(exc)[:80]}
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        run_fwd()
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    bwd_ms = None
+    if with_backward and op.differentiable:
+        try:
+            for a in arrays:
+                a.attach_grad()
+            with mx.autograd.record():
+                out = mx.nd.invoke(op_name, *arrays, **kwargs)
+                head = out[0] if isinstance(out, tuple) else out
+            head.backward()
+            t0 = time.perf_counter()
+            for _ in range(runs):
+                with mx.autograd.record():
+                    out = mx.nd.invoke(op_name, *arrays, **kwargs)
+                    head = out[0] if isinstance(out, tuple) else out
+                head.backward()
+                arrays[0].grad.wait_to_read()
+            bwd_ms = (time.perf_counter() - t0) / runs * 1e3
+        except Exception:
+            bwd_ms = None
+    entry = {"operator": op_name, "avg_fwd_ms": round(fwd_ms, 4)}
+    if bwd_ms is not None:
+        entry["avg_fwd_bwd_ms"] = round(bwd_ms, 4)
+    return entry
+
+
+def run_benchmark(ops, size=_DEFAULT_SIZE, runs=10, warmup=2):
+    results = []
+    for name in ops:
+        res = bench_op(name, size, runs, warmup)
+        if res is not None:
+            results.append(res)
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser(description="op micro-benchmarks")
+    parser.add_argument("--ops", type=str, default="",
+                        help="comma-separated op names (default: common set)")
+    parser.add_argument("--all", action="store_true",
+                        help="benchmark every registered op")
+    parser.add_argument("--size", type=int, default=_DEFAULT_SIZE)
+    parser.add_argument("--runs", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--output-format", type=str, default="table",
+                        choices=("table", "json"))
+    args = parser.parse_args()
+
+    if args.ops:
+        ops = args.ops.split(",")
+    elif args.all:
+        ops = registry.list_ops()
+    else:
+        ops = COMMON_OPS
+    results = run_benchmark(ops, args.size, args.runs, args.warmup)
+    if args.output_format == "json":
+        print(json.dumps(results, indent=2))
+    else:
+        print(f"{'Operator':<32s} {'Fwd (ms)':>10s} {'Fwd+Bwd (ms)':>14s}")
+        for r in results:
+            if "error" in r:
+                print(f"{r['operator']:<32s} {'SKIP: ' + r['error']}")
+            else:
+                bwd = r.get("avg_fwd_bwd_ms")
+                print(f"{r['operator']:<32s} {r['avg_fwd_ms']:>10.4f} "
+                      f"{bwd if bwd is not None else '-':>14}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Data loaders for the examples (parity:
+`example/image-classification/common/data.py`).
+
+The reference downloads MNIST/CIFAR from the web; this environment has no
+egress, so each loader uses the real dataset when its files are present
+(`--data-dir`) and otherwise generates a deterministic synthetic set with
+the same shapes/statistics — the training mechanics (iterator protocol,
+shape inference, lr schedule, checkpointing) are identical either way.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-dir", type=str, default="data/",
+                      help="the data directory")
+    data.add_argument("--num-examples", type=int, default=4096,
+                      help="the number of training examples")
+    data.add_argument("--num-val-examples", type=int, default=512,
+                      help="the number of validation examples")
+    return data
+
+
+def _synthetic(num, shape, num_classes, sample_seed, center_seed):
+    """Class-separable gaussian blobs with image-like statistics
+    (pixel std ~0.3 like normalized MNIST/CIFAR, so the example lr
+    settings behave as they do on the real data). The class centers come
+    from `center_seed` so train and val draw from the SAME distribution
+    while their samples differ."""
+    centers = 0.3 * np.random.RandomState(center_seed) \
+        .randn(num_classes, *shape).astype(np.float32)
+    rng = np.random.RandomState(sample_seed)
+    y = rng.randint(0, num_classes, num).astype(np.float32)
+    x = centers[y.astype(np.int32)] + \
+        0.15 * rng.randn(num, *shape).astype(np.float32)
+    return x, y
+
+
+def get_mnist_iter(args, kv):
+    """28x28x1, 10 classes (parity: data.py get_mnist_iter)."""
+    shape = (1, 28, 28)
+    path = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(path):
+        train = mx.io.MNISTIter(
+            image=path,
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size)
+        return train, val
+    x, y = _synthetic(args.num_examples, shape, 10, 42, center_seed=1)
+    xv, yv = _synthetic(args.num_val_examples, shape, 10, 43, center_seed=1)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size)
+    return train, val
+
+
+def get_cifar10_iter(args, kv):
+    """32x32x3, 10 classes (parity: data.py get_rec_iter on cifar10)."""
+    shape = (3, 32, 32)
+    rec = os.path.join(args.data_dir, "cifar10_train.rec")
+    if os.path.exists(rec):
+        train = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True)
+        val = mx.io.ImageRecordIter(
+            path_imgrec=os.path.join(args.data_dir, "cifar10_val.rec"),
+            data_shape=shape, batch_size=args.batch_size)
+        return train, val
+    x, y = _synthetic(args.num_examples, shape, 10, 7, center_seed=2)
+    xv, yv = _synthetic(args.num_val_examples, shape, 10, 8, center_seed=2)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size)
+    return train, val

@@ -103,24 +103,31 @@ LEAF, NODE, CONST = 0, 1, 2
 
 class TapeNode:
     """One recorded op application (parity: nnvm node + AGInfo,
-    `include/mxnet/imperative.h:53-92`)."""
+    `include/mxnet/imperative.h:53-92`).
+
+    `fwd_fn` (the pure op emitter with static kwargs bound) enables tape
+    REPLAY as a pure function of chosen leaves — the machinery behind
+    `grad(create_graph=True)` (higher-order gradients via composed
+    jax.vjp). Nodes that cannot be replayed (custom Functions) leave it
+    None."""
 
     __slots__ = ("op_name", "vjp_fn", "entries", "num_outputs", "out_shapes",
-                 "out_dtypes")
+                 "out_dtypes", "fwd_fn")
 
     def __init__(self, op_name, vjp_fn, entries, num_outputs, out_shapes,
-                 out_dtypes):
+                 out_dtypes, fwd_fn=None):
         self.op_name = op_name
         self.vjp_fn = vjp_fn  # pullback: cotangents -> input cotangents
         self.entries = entries  # [(kind, ndarray_or_node, out_idx)]
         self.num_outputs = num_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
+        self.fwd_fn = fwd_fn
 
 
 def make_entries(nd_inputs):
     """Classify each input for the tape: leaf (has grad buffer), node output,
-    or constant."""
+    or constant (the constant keeps its array ref for tape replay)."""
     entries = []
     for x in nd_inputs:
         node = getattr(x, "_tape_node", None)
@@ -129,7 +136,7 @@ def make_entries(nd_inputs):
         elif getattr(x, "_grad_req", "null") != "null":
             entries.append((LEAF, x, 0))
         else:
-            entries.append((CONST, None, 0))
+            entries.append((CONST, x, 0))
     return entries
 
 
@@ -264,22 +271,103 @@ def _accumulate_leaf(leaf, g, written):
         written.add(id(leaf))
 
 
+def _build_replay(heads, variables):
+    """Reconstruct the recorded computation as a pure function
+    leaf_raws -> head_raws by walking the tape with each node's stored
+    `fwd_fn`. Replay is what makes higher-order grads exact: re-deriving
+    through jax.vjp-of-replay sees the residuals' dependence on the
+    leaves, which the first-order pullbacks (closed over constant
+    residuals) cannot."""
+    roots = [h._tape_node for h in heads if h._tape_node is not None]
+    order = _toposort(roots)[::-1]  # leaves-first for forward replay
+    for node in order:
+        if node.fwd_fn is None:
+            raise NotImplementedError(
+                f"create_graph=True cannot replay node {node.op_name!r} "
+                "(hybridized/custom-Function nodes record no forward fn); "
+                "run the forward un-hybridized")
+    leaf_pos = {id(v): i for i, v in enumerate(variables)}
+
+    def replay(*leaf_raws):
+        vals = {}
+
+        def value_of(entry):
+            kind, ref, idx = entry
+            if kind == NODE:
+                return vals[id(ref)][idx]
+            pos = leaf_pos.get(id(ref))
+            if pos is not None:
+                return leaf_raws[pos]
+            return ref._data  # other leaf / constant: current value
+
+        for node in order:
+            ins = [value_of(e) for e in node.entries]
+            outs = node.fwd_fn(*ins)
+            vals[id(node)] = outs if isinstance(outs, tuple) else (outs,)
+
+        head_raws = []
+        for h in heads:
+            if h._tape_node is None:
+                pos = leaf_pos.get(id(h))
+                head_raws.append(leaf_raws[pos] if pos is not None
+                                 else h._data)
+            else:
+                head_raws.append(vals[id(h._tape_node)][h._tape_index])
+        return tuple(head_raws)
+
+    return replay
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """grad() with create_graph=True: differentiate the tape REPLAY inside
+    a recorded call, so the returned gradients are themselves on the tape
+    (second backward composes jax.vjp twice)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import _invoke_fn
+
+    for v in variables:
+        if v._grad_req == "null" or v._grad is None:
+            raise ValueError("variables passed to autograd.grad must have "
+                             "attach_grad() called (be tape leaves)")
+    replay = _build_replay(heads, variables)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    cots = tuple(
+        jnp.ones(h.shape, h._data.dtype) if g is None
+        else g._data.reshape(h.shape).astype(h._data.dtype)
+        for h, g in zip(heads, head_grads))
+
+    def g(*leaf_raws):
+        _, pull = jax.vjp(replay, *leaf_raws)
+        grads = pull(cots)
+        # single-variable: bare output so the tape's single-cotangent
+        # convention matches the pullback structure
+        return grads if len(grads) > 1 else grads[0]
+
+    out = _invoke_fn(g, "grad", list(variables), {})
+    return list(out) if isinstance(out, tuple) else [out]
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Compute and *return* gradients of heads w.r.t. variables.
 
-    parity: python/mxnet/autograd.py:271. ``create_graph=True`` (higher-order
-    imperative grads) is served by the hybrid path (`jax.grad` composition on
-    a hybridized block); the tape itself records first-order only.
+    parity: python/mxnet/autograd.py:271. ``create_graph=True`` replays
+    the tape as a pure function and differentiates it under recording, so
+    the result supports further `backward()`/`grad()` calls.
     """
     from .ndarray import NDArray
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the imperative tape is not supported; "
-            "hybridize the block and compose jax.grad instead")
+    if isinstance(heads, NDArray):
+        heads = [heads]
     if isinstance(variables, NDArray):
         variables = [variables]
+    if head_grads is not None and isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads)
     saved = [(v._grad_req, v._grad) for v in variables]
     from .ndarray import zeros_like
 

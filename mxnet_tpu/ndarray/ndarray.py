@@ -606,9 +606,16 @@ def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
         fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
         raw_out, vjp_fn = jax.vjp(fn, *raws)
         outs = raw_out if isinstance(raw_out, tuple) else (raw_out,)
+        if _amp_core.ACTIVE:
+            # replayable forward must include the AMP input casts (tape
+            # entries hold the UNCAST arrays)
+            def fwd_fn(*rs, _f=fn, _n=op_name):
+                return _f(*_amp_core.cast_inputs(_n, list(rs)))
+        else:
+            fwd_fn = fn
         node = autograd.TapeNode(op_name, vjp_fn, autograd.make_entries(nd_inputs),
                                  len(outs), [o.shape for o in outs],
-                                 [o.dtype for o in outs])
+                                 [o.dtype for o in outs], fwd_fn=fwd_fn)
         wrapped = tuple(wrap(o) for o in outs)
         for i, w in enumerate(wrapped):
             w._tape_node = node
@@ -650,7 +657,7 @@ def _invoke_fn(fn, name, nd_inputs, kwargs, wrap=None):
         outs = raw_out if isinstance(raw_out, tuple) else (raw_out,)
         node = autograd.TapeNode(name, vjp_fn, autograd.make_entries(nd_inputs),
                                  len(outs), [o.shape for o in outs],
-                                 [o.dtype for o in outs])
+                                 [o.dtype for o in outs], fwd_fn=fn)
         wrapped = tuple(wrap(o) for o in outs)
         for i, w in enumerate(wrapped):
             w._tape_node = node

@@ -432,9 +432,101 @@ class LARS(Optimizer):
 
 
 @register
-class LBSGD(SGD):
-    """Large-batch SGD with warmup (parity: optimizer.py LBSGD; realized as
-    SGD + LARS-style scaling is handled by LARS — kept for API parity)."""
+class LBSGD(Optimizer):
+    """Large-batch SGD: gradient accumulation over `batch_scale`
+    micro-batches + warmup lr multiplier ('linear'/'power2'/'sqrt') or
+    per-layer LARS scaling ('lars') (parity: optimizer.py:1057-1243)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.cumgrads = {}
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+        return None
+
+    def _get_lbmult(self, nup):
+        """Warmup multiplier ramping 1 -> batch_scale (parity: :1132)."""
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            return maxmult
+        if nwup <= 1:
+            return 1.0
+        if self.warmup_strategy == "linear":
+            return 1.0 + (maxmult - 1) * nup / nwup
+        if self.warmup_strategy == "power2":
+            return 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+        if self.warmup_strategy == "sqrt":
+            return 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+        return 1.0
+
+    def _get_lars(self, weight, g, wd):
+        """Layer-wise adaptive rate, computed ON DEVICE (no host syncs —
+        the naive form costs 2 blocking round trips per param per step).
+        Returns a scalar NDArray (parity math: :1154)."""
+        weight2 = (weight * weight).sum()
+        grad2 = (g * g).sum()
+        lars = ((weight2 / (grad2 + wd * weight2 + 1e-18)) ** 0.5)
+        return lars.clip(0.01, 100.0)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        # accumulate micro-batch gradients per layer (parity: :1186)
+        cgrad = self.cumgrads.get(index)
+        if cgrad and cgrad["num_cums"] > 0:
+            cgrad = {"cum_grad": cgrad["cum_grad"] + grad,
+                     "num_cums": cgrad["num_cums"] + 1}
+        else:
+            cgrad = {"cum_grad": grad, "num_cums": self.init_updates + 1}
+        self.cumgrads[index] = cgrad
+        if cgrad["num_cums"] % self.batch_scale != 0:
+            return  # mid macro-batch: no weight change
+        g = cgrad["cum_grad"] / self.batch_scale if self.batch_scale > 1 \
+            else cgrad["cum_grad"]
+        if self.warmup_strategy == "lars":
+            # device-scalar multiplier -> apply with nd ops (a static-lr
+            # fused kernel would force a host sync per layer)
+            lbmult = self._get_lars(weight, g, wd)
+            gr = g * self.rescale_grad
+            if self.clip_gradient:
+                gr = gr.clip(-self.clip_gradient, self.clip_gradient)
+            step = (lr * lbmult) * (gr + wd * weight)
+            if self.momentum != 0.0 and state is not None:
+                mom = self.momentum * state - step
+                state._rebind(mom._data)
+                weight._rebind((weight + mom)._data)
+            else:
+                weight._rebind((weight - step)._data)
+        else:
+            lbmult = self._get_lbmult(cgrad["num_cums"])
+            kwargs = {"lr": lr * lbmult, "wd": wd,
+                      "rescale_grad": self.rescale_grad,
+                      "clip_gradient": self.clip_gradient
+                      if self.clip_gradient else -1.0}
+            if self.momentum != 0.0 and state is not None:
+                (mom_new,) = _invoke_update("sgd_mom_update", weight,
+                                            [g, state],
+                                            {**kwargs,
+                                             "momentum": self.momentum})
+                state._rebind(mom_new._data)
+            else:
+                _invoke_update("sgd_update", weight, [g], kwargs)
+        self.cumgrads[index]["cum_grad"] = 0
 
 
 @register

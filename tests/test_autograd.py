@@ -195,3 +195,73 @@ def test_training_flag_dropout_semantics():
         assert ag.is_recording() and not ag.is_training()
     with ag.train_mode():
         assert ag.is_training()
+
+
+def test_grad_create_graph_second_order():
+    """grad-of-grad matches the analytic second derivative (parity:
+    reference autograd.py:271 create_graph)."""
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x ** 3).sum()
+        gx = mx.autograd.grad([y], [x], create_graph=True)[0]
+        z = gx.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * np.array([1.0, 2.0, 3.0]),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_matches_finite_differences():
+    xv = np.array([0.5, -0.7], np.float32)
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (mx.nd.exp(x) * mx.nd.sin(x)).sum()
+        g = mx.autograd.grad([y], [x], create_graph=True)[0]
+        z = (g * g).sum()
+    z.backward()
+
+    def first(v):
+        return np.exp(v) * (np.sin(v) + np.cos(v))
+
+    eps = 1e-3
+    fd = ((first(xv + eps) ** 2).astype(np.float64)
+          - (first(xv - eps) ** 2)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), fd, rtol=1e-2)
+
+
+def test_grad_create_graph_multi_variable():
+    a, b = mx.nd.array([1.5]), mx.nd.array([2.5])
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = (a * a * b).sum()
+        ga, gb = mx.autograd.grad([y], [a, b], create_graph=True)
+        z = (ga * gb).sum()  # (2ab)(a^2)
+    z.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [6 * 1.5 ** 2 * 2.5],
+                               rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), [2 * 1.5 ** 3], rtol=1e-5)
+
+
+def test_grad_create_graph_non_leaf_raises():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * x
+        z = (y * y).sum()
+        with pytest.raises(ValueError):
+            mx.autograd.grad([z], [y], create_graph=True)
+
+
+def test_grad_create_graph_none_head_grads():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y1 = (x * x).sum()
+        y2 = (x * x * x).sum()
+        g = mx.autograd.grad([y1, y2], [x],
+                             head_grads=[mx.nd.array([2.0]), None],
+                             create_graph=True)[0]
+    np.testing.assert_allclose(g.asnumpy(), [2 * 2 * 2.0 + 3 * 4.0],
+                               rtol=1e-5)

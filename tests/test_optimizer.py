@@ -232,3 +232,35 @@ def test_adam_clips_after_wd():
     np.testing.assert_allclose(mean2.asnumpy(), m_ref, rtol=1e-6)
     np.testing.assert_allclose(var2.asnumpy(), v_ref, rtol=1e-6)
     np.testing.assert_allclose(w2.asnumpy(), w_ref, rtol=1e-6)
+
+
+def test_lbsgd_accumulates_and_warms_up():
+    """LBSGD parity: gradient accumulation over batch_scale micro-batches;
+    weight only changes at macro-batch boundaries; warmup ramps the lr."""
+    opt = mx.optimizer.create("lbsgd", learning_rate=0.1, batch_scale=2,
+                              warmup_strategy="linear", warmup_epochs=1,
+                              updates_per_epoch=4)
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([0.5])
+    state = opt.create_state(0, w)
+    before = float(w.asscalar())
+    opt.update(0, w, g, state)  # micro-batch 1: accumulate only
+    assert float(w.asscalar()) == before
+    opt.update(0, w, g, state)  # micro-batch 2: apply averaged grad
+    after = float(w.asscalar())
+    assert after != before
+    # averaged grad = 0.5; lr warmup mult at nup=2, nwup=4 -> 1 + 1*2/4
+    expected = before - 0.1 * (1 + 1 * 2 / 4) * 0.5
+    np.testing.assert_allclose(after, expected, rtol=1e-5)
+
+
+def test_lbsgd_lars_strategy():
+    opt = mx.optimizer.create("lbsgd", learning_rate=0.1, batch_scale=1,
+                              warmup_strategy="lars")
+    w = mx.nd.array([3.0, 4.0])   # ||w|| = 5
+    g = mx.nd.array([0.3, 0.4])   # ||g|| = 0.5
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    # lars = sqrt(25 / 0.25) = 10 -> effective lr 1.0
+    np.testing.assert_allclose(w.asnumpy(), [3.0 - 0.3, 4.0 - 0.4],
+                               rtol=1e-5)

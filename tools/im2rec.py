@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""im2rec: build .lst / .rec image databases from an image folder.
+
+Parity target: `tools/im2rec.py` — `--list` mode walks a directory tree
+producing `prefix.lst` (index \\t label \\t relpath), optionally split by
+--train-ratio/--test-ratio; record mode packs each listed image into an
+IndexedRecordIO `.rec`/`.idx` pair via `recordio.pack_img`.
+
+The reference parallelizes JPEG encoding over worker processes + OpenCV;
+here PIL (when available) or raw passthrough does the encode — the
+output format is byte-compatible with the reference's RecordIO readers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def list_image(root, recursive, exts):
+    """parity: im2rec.py:38 — yield (index, relpath, label)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    """parity: im2rec.py:75."""
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    """parity: im2rec.py:93 — write train/val/test .lst splits."""
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = "_%dof%d" % (i, args.chunks) if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    """parity: im2rec.py:123."""
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + \
+                    [float(i) for i in line[1:-1]]
+            except ValueError:
+                continue
+            yield item
+
+
+def _encode_image(args, fullpath):
+    """Read + optionally resize/crop/re-encode one image; returns bytes."""
+    if args.pass_through:
+        with open(fullpath, "rb") as f:
+            return f.read()
+    try:
+        from PIL import Image
+    except ImportError:
+        with open(fullpath, "rb") as f:
+            return f.read()  # no PIL: pass bytes through
+    import io
+
+    img = Image.open(fullpath)
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w + s) // 2, (h + s) // 2))
+    if args.resize:
+        w, h = img.size
+        if w > h:
+            img = img.resize((int(w * args.resize / h), args.resize))
+        else:
+            img = img.resize((args.resize, int(h * args.resize / w)))
+    buf = io.BytesIO()
+    fmt = "JPEG" if args.encoding == ".jpg" else "PNG"
+    img.convert("RGB").save(buf, format=fmt, quality=args.quality)
+    return buf.getvalue()
+
+
+def make_record(args, lst_path):
+    """Pack one .lst into .rec/.idx (parity: im2rec.py read/write workers,
+    sequentially)."""
+    base = os.path.splitext(lst_path)[0]
+    record = recordio.MXIndexedRecordIO(base + ".idx", base + ".rec", "w")
+    count = 0
+    for item in read_list(lst_path):
+        idx, relpath, labels = item[0], item[1], item[2:]
+        fullpath = os.path.join(args.root, relpath)
+        label = labels[0] if len(labels) == 1 and not args.pack_label \
+            else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        try:
+            payload = _encode_image(args, fullpath)
+        except Exception as exc:
+            print("imread error trying to load file: %s (%s)"
+                  % (fullpath, exc))
+            continue
+        record.write_idx(idx, recordio.pack(header, payload))
+        count += 1
+        if count % 1000 == 0:
+            print("processed", count, "images")
+    record.close()
+    print("wrote %d records to %s.rec" % (count, base))
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+        description="Create an image list or RecordIO database")
+    parser.add_argument("prefix",
+                        help="prefix of input/output lst and rec files")
+    parser.add_argument("root", help="path to folder containing images")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true",
+                        help="make a list instead of a record database")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true",
+                        help="label by subdirectory")
+    cgroup.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true",
+                        help="pack multi-dimensional labels")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.list:
+        make_list(args)
+        return
+    working_dir = os.path.dirname(args.prefix) or "."
+    files = [os.path.join(working_dir, f)
+             for f in sorted(os.listdir(working_dir))]
+    count = 0
+    for f in files:
+        if f.startswith(args.prefix) and f.endswith(".lst"):
+            count += 1
+            make_record(args, f)
+    if not count:
+        print("did not find and process any .lst files with prefix "
+              f"{args.prefix!r}; run with --list first")
+
+
+if __name__ == "__main__":
+    main()
