@@ -94,14 +94,17 @@ class Context:
         """
         import jax
 
+        # LOCAL devices only: under jax.distributed the global list
+        # contains other processes' (non-addressable) devices
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            # the CPU backend always exists, even on accelerator hosts
+            devs = jax.local_devices(backend="cpu")
             return devs[self.device_id % len(devs)]
         # tpu: prefer real TPU devices, else whatever the default backend is
-        try:
-            devs = jax.devices("tpu")
-        except RuntimeError:
-            devs = jax.devices()
+        devs = [d for d in jax.local_devices()
+                if d.platform in ("tpu", "axon")]
+        if not devs:
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

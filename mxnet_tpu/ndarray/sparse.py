@@ -15,33 +15,114 @@ from __future__ import annotations
 
 import numpy as _np
 
+import functools as _functools
+
 from .ndarray import NDArray, _invoke_fn, array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage"]
+           "cast_storage", "sparse_add", "merge_duplicates"]
+
+
+@_functools.lru_cache(maxsize=None)
+def _densify_fn(shape):
+    """Cached jitted scatter (one executable per dense shape). `.add`, not
+    `.set`: duplicate indices (unmerged aggregates) must sum."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(vals, idx):
+        out = jnp.zeros(shape, vals.dtype)
+        return out.at[idx.astype(jnp.int32)].add(vals)
+
+    return fn
 
 
 class RowSparseNDArray(NDArray):
-    """values `data` for the rows listed in `indices`; other rows are zero."""
+    """values `data` for the rows listed in `indices`; other rows are zero.
 
-    __slots__ = ("_rs_data", "_rs_indices", "_dense_shape")
+    Storage is GENUINELY sparse: only (indices, values) live on device.
+    The dense view materializes lazily on first `_data` access (a dense
+    op touching the array), mirroring the reference's storage-fallback —
+    sparse-aware paths (kvstore push/pull, sparse optimizer updates,
+    `retain`) never pay the dense memory."""
+
+    __slots__ = ("_rs_data", "_rs_indices", "_dense_shape", "_dense_cache",
+                 "_rs_stale")
 
     def __init__(self, data, indices, shape):
         self._rs_data = data if isinstance(data, NDArray) else array(data)
         idx = indices if isinstance(indices, NDArray) else array(indices, dtype="int64")
         self._rs_indices = idx
         self._dense_shape = tuple(shape)
-        super().__init__(self._densify()._data)
+        self._dense_cache = None
+        self._rs_stale = False
+        # NDArray slot init without densifying (base __init__ needs data)
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._tape_index = 0
+        self._fresh_grad = False
+
+    @property
+    def _data(self):
+        """Lazy dense materialization (storage fallback)."""
+        if self._dense_cache is None:
+            self._dense_cache = _densify_fn(self._dense_shape)(
+                self._rs_data._data, self._rs_indices._data)
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, raw):
+        # dense write-back (e.g. _rebind after a dense op): the sparse
+        # components no longer describe the contents — mark them stale so
+        # sparse readers re-derive rather than reading pre-write values
+        self._dense_cache = raw
+        self._rs_stale = True
+
+    def _refresh_sparse(self):
+        """Re-derive (indices, values) from the dense contents after a
+        dense write (rare path; costs one host round trip)."""
+        dense = _np.asarray(self._dense_cache)
+        nz = _np.where(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+        self._rs_indices = array(nz, dtype="int64")
+        self._rs_data = array(dense[nz])
+        self._rs_stale = False
 
     def _densify(self) -> NDArray:
-        import jax.numpy as jnp
+        return NDArray(self._data)
 
-        def fn(vals, idx):
-            out = jnp.zeros(self._dense_shape, vals.dtype)
-            return out.at[idx.astype(jnp.int32)].set(vals)
+    # sparse-aware metadata: none of these touch the dense view
+    @property
+    def shape(self):
+        return self._dense_shape
 
-        return _invoke_fn(fn, "rowsparse_to_dense",
-                          [self._rs_data, self._rs_indices], {})
+    @property
+    def dtype(self):
+        if self._rs_stale:
+            import jax.numpy as jnp
+
+            dt = self._dense_cache.dtype
+            return jnp.bfloat16 if dt == jnp.bfloat16 \
+                else _np.dtype(dt.name)
+        return self._rs_data.dtype
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._dense_shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return len(self._dense_shape)
+
+    @property
+    def context(self):
+        return self._rs_data.context
+
+    ctx = context
 
     @property
     def stype(self):
@@ -49,11 +130,25 @@ class RowSparseNDArray(NDArray):
 
     @property
     def data(self):
+        if self._rs_stale:
+            self._refresh_sparse()
         return self._rs_data
 
     @property
     def indices(self):
+        if self._rs_stale:
+            self._refresh_sparse()
         return self._rs_indices
+
+    def wait_to_read(self):
+        if self._rs_stale:
+            self._dense_cache.block_until_ready()
+        else:
+            self._rs_data.wait_to_read()
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(),
+                                self.indices.copy(), self._dense_shape)
 
     def tostype(self, stype):
         if stype == "default":
@@ -64,20 +159,25 @@ class RowSparseNDArray(NDArray):
 
     def _update(self, rows, indices):
         """Replace contents with `rows` at `indices` (kvstore
-        row_sparse_pull writeback)."""
+        row_sparse_pull writeback) — stays sparse."""
         self._rs_data = rows if isinstance(rows, NDArray) else array(rows)
         self._rs_indices = indices if isinstance(indices, NDArray) \
             else array(indices, dtype="int64")
-        self._rebind(self._densify()._data)
+        self._dense_cache = None
+        self._rs_stale = False
 
     def retain(self, indices):
-        """Keep only the given rows (parity: sparse.retain)."""
-        keep = set(_np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
-                               else indices).astype(int).tolist())
-        cur = _np.asarray(self._rs_indices.asnumpy()).astype(int)
-        mask = _np.array([i in keep for i in cur])
-        new_idx = cur[mask]
-        new_data = _np.asarray(self._rs_data.asnumpy())[mask]
+        """Keep only the given rows (parity: sparse.retain) — on device."""
+        import jax.numpy as jnp
+
+        req = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(_np.asarray(indices))
+        cur = self.indices._data
+        # membership mask: cur[i] in req
+        mask = (cur[:, None] == req[None, :]).any(axis=1)
+        keep_np = _np.asarray(mask)  # host round trip sizes the result
+        new_idx = _np.asarray(cur)[keep_np]
+        new_data = _np.asarray(self.data._data)[keep_np]
         return RowSparseNDArray(new_data, new_idx, self._dense_shape)
 
 
@@ -127,6 +227,35 @@ class CSRNDArray(NDArray):
         if stype == "csr":
             return self
         raise ValueError(f"cannot cast csr to {stype}")
+
+
+def sparse_add(a: "RowSparseNDArray", b: "RowSparseNDArray"):
+    """Sum two row_sparse arrays WITHOUT densifying: row-union merge
+    (parity: the reference's sparse CommCPU reduce,
+    `src/kvstore/comm.h:103` ReduceRowSparse)."""
+    assert a._dense_shape == b._dense_shape
+    ia = _np.asarray(a.indices.asnumpy()).astype(_np.int64)
+    ib = _np.asarray(b.indices.asnumpy()).astype(_np.int64)
+    va = _np.asarray(a.data.asnumpy())
+    vb = _np.asarray(b.data.asnumpy())
+    union, inv = _np.unique(_np.concatenate([ia, ib]), return_inverse=True)
+    vals = _np.zeros((union.shape[0],) + va.shape[1:], va.dtype)
+    _np.add.at(vals, inv[:ia.shape[0]], va)
+    _np.add.at(vals, inv[ia.shape[0]:], vb)
+    return RowSparseNDArray(vals, union, a._dense_shape)
+
+
+def merge_duplicates(rs: "RowSparseNDArray"):
+    """Combine duplicate row indices by summation (sparse-aware consumers
+    require unique rows; aggregation may concatenate)."""
+    idx = _np.asarray(rs.indices.asnumpy()).astype(_np.int64)
+    if idx.size == _np.unique(idx).size:
+        return rs
+    vals = _np.asarray(rs.data.asnumpy())
+    uniq, inv = _np.unique(idx, return_inverse=True)
+    out = _np.zeros((uniq.shape[0],) + vals.shape[1:], vals.dtype)
+    _np.add.at(out, inv, vals)
+    return RowSparseNDArray(out, uniq, rs._dense_shape)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):

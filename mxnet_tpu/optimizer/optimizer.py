@@ -300,9 +300,13 @@ class SGD(Optimizer):
         return None
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            return self._sparse_update(weight, grad, state, lr, wd)
         kwargs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
                   "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0}
         if self.momentum != 0.0 and state is not None:
@@ -311,6 +315,48 @@ class SGD(Optimizer):
             state._rebind(mom_new._data)
         else:
             _invoke_update("sgd_update", weight, [grad], kwargs)
+
+    def _sparse_update(self, weight, grad, state, lr, wd):
+        """Lazy row_sparse SGD (parity: sgd_update kRowSparseStorage,
+        `optimizer_op.cc` SGDUpdateRowSparse): only the gradient's rows
+        are touched — weight decay and momentum included — via a cached
+        jitted gather/scatter, never densifying the gradient. Like the
+        reference, row indices are required unique (the RowSparseNDArray
+        contract; kvstore aggregation preserves it)."""
+        import jax
+        import jax.numpy as jnp
+
+        rg = self.rescale_grad
+        clip = self.clip_gradient if self.clip_gradient else 0.0
+        mom = self.momentum
+        key = ("sparse_sgd", tuple(weight.shape), str(weight.dtype),
+               tuple(grad.data.shape), rg, clip, mom,
+               state is not None)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            def apply(w, g_vals, idx, m, hyper):
+                lr_t, wd_t = hyper[0], hyper[1]
+                idx = idx.astype(jnp.int32)
+                g = g_vals * rg
+                if clip:
+                    g = jnp.clip(g, -clip, clip)
+                w_rows = w[idx]
+                g = g + wd_t * w_rows
+                if m is None:
+                    return w.at[idx].add(-lr_t * g), None
+                m_rows = mom * m[idx] - lr_t * g
+                return w.at[idx].add(m_rows), m.at[idx].set(m_rows)
+
+            fn = jax.jit(apply)
+            self._fused_cache[key] = fn
+        hyper = jnp.asarray([lr, wd], weight._data.dtype)
+        new_w, new_m = fn(weight._data, grad.data._data,
+                          grad.indices._data,
+                          state._data if state is not None else None,
+                          hyper)
+        weight._rebind(new_w)
+        if state is not None:
+            state._rebind(new_m)
 
     def fused_update_multi(self, indices, weights, grads, states):
         if not _fused_sgd_like(self, "sgd_mom_update", indices, weights,

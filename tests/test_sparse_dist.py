@@ -1,0 +1,187 @@
+"""Real sparse storage + dist kvstore hardening tests (parity model:
+tests/python/unittest/test_sparse_ndarray.py, test_kvstore.py dist
+sections, gradient_compression tests)."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.sparse import (RowSparseNDArray, merge_duplicates,
+                                      row_sparse_array, sparse_add)
+
+
+def test_row_sparse_is_lazy():
+    """Construction must NOT materialize dense storage."""
+    rs = row_sparse_array((onp.ones((2, 4), "float32"), [1, 5]),
+                          shape=(100, 4))
+    assert rs._dense_cache is None        # nothing densified yet
+    assert rs.shape == (100, 4)           # metadata without densify
+    assert rs.stype == "row_sparse"
+    assert rs._dense_cache is None
+    dense = rs.tostype("default")         # explicit densify
+    assert dense.shape == (100, 4)
+    onp.testing.assert_allclose(dense.asnumpy()[1], onp.ones(4))
+    onp.testing.assert_allclose(dense.asnumpy()[0], onp.zeros(4))
+
+
+def test_sparse_add_row_union():
+    a = row_sparse_array((onp.ones((2, 3), "float32"), [0, 2]), shape=(5, 3))
+    b = row_sparse_array((2 * onp.ones((2, 3), "float32"), [2, 4]),
+                         shape=(5, 3))
+    c = sparse_add(a, b)
+    assert c.stype == "row_sparse"
+    assert c.indices.asnumpy().tolist() == [0, 2, 4]
+    onp.testing.assert_allclose(c.data.asnumpy()[1], 3 * onp.ones(3))
+    ref = a.tostype("default").asnumpy() + b.tostype("default").asnumpy()
+    onp.testing.assert_allclose(c.tostype("default").asnumpy(), ref)
+
+
+def test_merge_duplicates():
+    rs = RowSparseNDArray(onp.ones((3, 2), "float32"), [1, 1, 3],
+                          shape=(5, 2))
+    m = merge_duplicates(rs)
+    assert m.indices.asnumpy().tolist() == [1, 3]
+    onp.testing.assert_allclose(m.data.asnumpy()[0], [2.0, 2.0])
+    # duplicate indices also densify correctly (scatter-ADD)
+    onp.testing.assert_allclose(rs.tostype("default").asnumpy()[1],
+                                [2.0, 2.0])
+
+
+def test_sparse_sgd_update_matches_dense():
+    """Lazy row_sparse SGD touches only the gradient's rows and matches
+    the dense update on those rows."""
+    w_np = onp.random.RandomState(0).rand(8, 3).astype("float32")
+    g_rows = onp.random.RandomState(1).rand(2, 3).astype("float32")
+    idx = [1, 5]
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, wd=0.01)
+    w_sparse = nd.array(w_np.copy())
+    state = opt.create_state(0, w_sparse)
+    opt.update(0, w_sparse, row_sparse_array((g_rows, idx), shape=(8, 3)),
+               state)
+    out = w_sparse.asnumpy()
+    # untouched rows identical (lazy update: no decay off-rows)
+    for r in range(8):
+        if r not in idx:
+            onp.testing.assert_allclose(out[r], w_np[r])
+    for j, r in enumerate(idx):
+        expect = w_np[r] - 0.1 * (g_rows[j] + 0.01 * w_np[r])
+        onp.testing.assert_allclose(out[r], expect, rtol=1e-5)
+
+
+def test_sparse_sgd_momentum_rows():
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    w = nd.array(onp.ones((6, 2), "float32"))
+    state = opt.create_state(0, w)
+    g = row_sparse_array((onp.ones((1, 2), "float32"), [3]), shape=(6, 2))
+    opt.update(0, w, g, state)
+    opt.update(0, w, g, state)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[0], [1.0, 1.0])  # untouched
+    # row 3: two momentum steps: m1=-0.1, w=0.9; m2=0.9*(-0.1)-0.1=-0.19
+    onp.testing.assert_allclose(out[3], [1.0 - 0.1 - 0.19] * 2, rtol=1e-5)
+
+
+def test_kvstore_sparse_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((10, 4)))
+    g1 = row_sparse_array((onp.ones((2, 4), "float32"), [0, 3]),
+                          shape=(10, 4))
+    g2 = row_sparse_array((onp.ones((2, 4), "float32"), [3, 7]),
+                          shape=(10, 4))
+    opt = mx.optimizer.create("sgd", learning_rate=1.0)
+    kv.set_optimizer(opt)
+    kv.push("emb", [g1, g2])
+    # row_sparse_pull of selected rows
+    out = row_sparse_array((onp.zeros((3, 4), "float32"), [0, 3, 7]),
+                           shape=(10, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([0, 3, 7]))
+    vals = out.data.asnumpy()
+    onp.testing.assert_allclose(vals[0], -onp.ones(4))       # grad 1
+    onp.testing.assert_allclose(vals[1], -2 * onp.ones(4))   # merged rows
+    onp.testing.assert_allclose(vals[2], -onp.ones(4))
+
+
+def test_gradient_compression_quantize_and_feedback():
+    kv = mx.kv.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = nd.array([0.7, -0.9, 0.2, 0.0])
+    out = kv._compressed_cross_host_sum("k", g)
+    # quantized to {-thr, 0, +thr}
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # error feedback: residual carries the quantization error
+    res = kv._residuals["k"].tolist() if hasattr(
+        kv._residuals["k"], "tolist") else list(kv._residuals["k"])
+    onp.testing.assert_allclose(
+        onp.asarray(res), [0.2, -0.4, 0.2, 0.0], atol=1e-6)
+    # a second small push accumulates: 0.2 + 0.31 > 0.5 -> fires
+    out2 = kv._compressed_cross_host_sum("k", nd.array([0.31, 0.0, 0.0,
+                                                        0.0]))
+    assert out2.asnumpy()[0] == 0.5
+
+
+def test_gradient_compression_rejects_unknown():
+    kv = mx.kv.create("local")
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "1bit"})
+
+
+_DIST_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=2, process_id=pid)
+    import mxnet_tpu as mx
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 2, kv.num_workers
+    kv.init("w", mx.nd.zeros((4,)))
+    g = mx.nd.array([float(kv.rank + 1)] * 4)
+    kv.push("w", g)
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    vals = out.asnumpy().tolist()
+    assert vals == [3.0] * 4, vals  # 1 + 2 summed across both workers
+    print("DIST_OK", kv.rank)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="distributed tests disabled")
+def test_two_process_dist_sync_exact_aggregate(tmp_path):
+    """2-process localhost jax.distributed: dist_sync push/pull must
+    produce the exact cross-worker sum on both ranks."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "dist_child.py"
+    script.write_text(_DIST_CHILD)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), port, str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.getcwd()) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed runtime hung in this environment")
+    if any(p.returncode != 0 for p in procs):
+        joined = "\n".join(outs)
+        if "DISTRIBUTED" in joined.upper() or "initialize" in joined:
+            pytest.skip(f"jax.distributed unavailable: {joined[-300:]}")
+        raise AssertionError(joined[-1500:])
+    assert all("DIST_OK" in o for o in outs), outs
