@@ -151,7 +151,10 @@ class KVStore(KVStoreBase):
         """parity: kvstore.py set_gradient_compression ('2bit', threshold).
         Compression applies to cross-host traffic (dist_* stores); the
         reference likewise ignores it for purely local stores."""
-        params = dict(compression_params or {})
+        if not compression_params:
+            self._compression = {}  # falsy input disables compression
+            return
+        params = dict(compression_params)
         ctype = params.get("type", "2bit")
         if ctype != "2bit":
             raise ValueError(f"unsupported gradient compression {ctype!r}; "
@@ -269,7 +272,13 @@ class _DistKVStore(KVStore):
             for v in vals[1:]:
                 agg = self._merge(agg, v)
             if self._procs > 1:
-                if self._compression:
+                from ..ndarray.sparse import RowSparseNDArray
+
+                if self._compression and \
+                        not isinstance(agg, RowSparseNDArray):
+                    # sparse grads bypass compression (reference parity:
+                    # GradientCompression supports dense only; compressing
+                    # would densify and defeat sparse storage)
                     agg = self._compressed_cross_host_sum(k, agg)
                 else:
                     agg = self._cross_host_sum(agg)

@@ -229,20 +229,49 @@ class CSRNDArray(NDArray):
         raise ValueError(f"cannot cast csr to {stype}")
 
 
+@_functools.lru_cache(maxsize=None)
+def _sparse_add_fn(na, nb, row_shape, dtype):
+    """Cached jitted row-union merge: concat + unique(size=n) +
+    segment-sum. Result is padded to na+nb rows; padding slots reuse the
+    fill index with zero values, which every consumer treats as a no-op
+    (densify scatter-ADDs, updates add zero)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = na + nb
+
+    @jax.jit
+    def fn(ia, va, ib, vb):
+        idx = jnp.concatenate([ia, ib])
+        vals = jnp.concatenate([va, vb])
+        uniq, inv = jnp.unique(idx, return_inverse=True, size=n,
+                               fill_value=0)
+        merged = jax.ops.segment_sum(vals, inv.reshape(-1),
+                                     num_segments=n)
+        return uniq, merged
+
+    return fn
+
+
 def sparse_add(a: "RowSparseNDArray", b: "RowSparseNDArray"):
-    """Sum two row_sparse arrays WITHOUT densifying: row-union merge
-    (parity: the reference's sparse CommCPU reduce,
-    `src/kvstore/comm.h:103` ReduceRowSparse)."""
+    """Sum two row_sparse arrays WITHOUT densifying: on-device row-union
+    merge (parity: the reference's sparse CommCPU reduce,
+    `src/kvstore/comm.h:103` ReduceRowSparse). Values never leave the
+    device; one small indices-only host read sizes the result (the
+    padded tail of jnp.unique repeats the fill value, so the real prefix
+    is the strictly-increasing run)."""
     assert a._dense_shape == b._dense_shape
-    ia = _np.asarray(a.indices.asnumpy()).astype(_np.int64)
-    ib = _np.asarray(b.indices.asnumpy()).astype(_np.int64)
-    va = _np.asarray(a.data.asnumpy())
-    vb = _np.asarray(b.data.asnumpy())
-    union, inv = _np.unique(_np.concatenate([ia, ib]), return_inverse=True)
-    vals = _np.zeros((union.shape[0],) + va.shape[1:], va.dtype)
-    _np.add.at(vals, inv[:ia.shape[0]], va)
-    _np.add.at(vals, inv[ia.shape[0]:], vb)
-    return RowSparseNDArray(vals, union, a._dense_shape)
+    ia, va = a.indices, a.data
+    ib, vb = b.indices, b.data
+    fn = _sparse_add_fn(ia.shape[0], ib.shape[0],
+                        tuple(va.shape[1:]), str(va.dtype))
+    uniq, merged = fn(ia._data, va._data, ib._data, vb._data)
+    uniq_np = _np.asarray(uniq)  # indices only: tiny transfer
+    d = _np.diff(uniq_np)
+    breaks = _np.nonzero(d <= 0)[0]
+    n_real = int(breaks[0] + 1) if breaks.size else uniq_np.size
+    return RowSparseNDArray(NDArray(merged[:n_real]),
+                            NDArray(uniq[:n_real]), a._dense_shape)
 
 
 def merge_duplicates(rs: "RowSparseNDArray"):

@@ -1,0 +1,21 @@
+"""ONNX export / import (parity: `python/mxnet/onnx/` — `mx2onnx/`
+~7.1k LoC of op translations + `onnx2mx/`).
+
+`export_model(sym, params, in_shapes, ...)` walks the Symbol DAG emitting
+ONNX (opset 13) nodes via the pure-Python wire codec in `proto.py` (the
+environment ships no onnx package); `import_model(path)` parses a .onnx
+file back into a Symbol + params. Covered op set: the whole model zoo
+(Conv, BatchNorm, activations, pooling incl. global, Gemm/FC, Flatten,
+Concat, elementwise arithmetic, softmax, Dropout, Reshape, transpose,
+LeakyRelu/Clip) — round-trip tested numerically in
+tests/test_onnx.py.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import proto
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model", "proto"]

@@ -1,0 +1,294 @@
+"""Symbol -> ONNX export (parity: `python/mxnet/onnx/mx2onnx/`).
+
+Each registry op gets a translation function emitting one or more ONNX
+NodeProtos; the graph walk mirrors `_export_onnx.py`'s topo traversal
+with params becoming initializers.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import proto
+
+_TRANSLATIONS = {}
+
+
+def register_translation(op_name):
+    def deco(fn):
+        _TRANSLATIONS[op_name] = fn
+        return fn
+
+    return deco
+
+
+def _pair(v, n=2, default=1):
+    if v is None or v == ():
+        return [default] * n
+    if isinstance(v, int):
+        return [v] * n
+    return list(v)
+
+
+class _Ctx:
+    """Per-export state handed to translation fns."""
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.counter = 0
+
+    def emit(self, op_type, inputs, outputs, **attrs):
+        self.nodes.append(proto.node(op_type, inputs, outputs, **attrs))
+
+    def const(self, base, arr):
+        name = f"{base}_const{self.counter}"
+        self.counter += 1
+        self.initializers.append(proto.tensor(name, _np.asarray(arr)))
+        return name
+
+
+@register_translation("Convolution")
+def _conv(ctx, name, ins, out, attrs):
+    kernel = list(attrs.get("kernel", ()))
+    n = len(kernel)
+    a = {"kernel_shape": kernel,
+         "strides": _pair(attrs.get("stride"), n, 1),
+         "dilations": _pair(attrs.get("dilate"), n, 1),
+         "group": int(attrs.get("num_group", 1)),
+         "pads": _pair(attrs.get("pad"), n, 0) * 2}
+    ctx.emit("Conv", ins, [out], **a)
+
+
+@register_translation("FullyConnected")
+def _fc(ctx, name, ins, out, attrs):
+    data = ins[0]
+    if not attrs.get("no_bias", False) and len(ins) < 3:
+        ins = ins + [ctx.const(name, _np.zeros(
+            (int(attrs.get("num_hidden", 1)),), _np.float32))]
+    flat = f"{name}_flat"
+    ctx.emit("Flatten", [data], [flat], axis=1)
+    gemm_ins = [flat] + list(ins[1:3])
+    ctx.emit("Gemm", gemm_ins, [out], alpha=1.0, beta=1.0, transA=0,
+             transB=1)
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@register_translation("Activation")
+def _act(ctx, name, ins, out, attrs):
+    ctx.emit(_ACT[attrs.get("act_type", "relu")], ins[:1], [out])
+
+
+@register_translation("LeakyReLU")
+def _leaky(ctx, name, ins, out, attrs):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.emit("LeakyRelu", ins[:1], [out],
+                 alpha=float(attrs.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.emit("Elu", ins[:1], [out],
+                 alpha=float(attrs.get("slope", 0.25)))
+    elif act == "gelu":
+        ctx.emit("Gelu", ins[:1], [out])
+    elif act == "prelu":
+        ctx.emit("PRelu", ins[:2], [out])
+    else:
+        raise ValueError(f"cannot export LeakyReLU act_type={act!r}")
+
+
+@register_translation("BatchNorm")
+def _bn(ctx, name, ins, out, attrs):
+    # mxnet order: data, gamma, beta, moving_mean, moving_var == onnx order
+    ctx.emit("BatchNormalization", ins[:5], [out],
+             epsilon=float(attrs.get("eps", 1e-5)),
+             momentum=float(attrs.get("momentum", 0.9)))
+
+
+@register_translation("Pooling")
+def _pool(ctx, name, ins, out, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        ctx.emit("GlobalMaxPool" if ptype == "max" else "GlobalAveragePool",
+                 ins[:1], [out])
+        return
+    kernel = list(attrs.get("kernel", ()))
+    n = len(kernel)
+    a = {"kernel_shape": kernel,
+         "strides": _pair(attrs.get("stride"), n, 1),
+         "pads": _pair(attrs.get("pad"), n, 0) * 2}
+    if attrs.get("pooling_convention", "valid") == "full":
+        a["ceil_mode"] = 1
+    if ptype == "avg":
+        a["count_include_pad"] = 1
+    ctx.emit("MaxPool" if ptype == "max" else "AveragePool", ins[:1],
+             [out], **a)
+
+
+@register_translation("Flatten")
+def _flatten(ctx, name, ins, out, attrs):
+    ctx.emit("Flatten", ins[:1], [out], axis=1)
+
+
+@register_translation("Concat")
+def _concat(ctx, name, ins, out, attrs):
+    ctx.emit("Concat", ins, [out], axis=int(attrs.get("dim", 1)))
+
+
+@register_translation("softmax")
+def _softmax(ctx, name, ins, out, attrs):
+    ctx.emit("Softmax", ins[:1], [out], axis=int(attrs.get("axis", -1)))
+
+
+@register_translation("SoftmaxOutput")
+def _softmax_output(ctx, name, ins, out, attrs):
+    # inference export: plain softmax over data (label dropped)
+    ctx.emit("Softmax", ins[:1], [out], axis=1
+             if attrs.get("multi_output") else -1)
+
+
+@register_translation("Dropout")
+def _dropout(ctx, name, ins, out, attrs):
+    ctx.emit("Dropout", ins[:1], [out],
+             ratio=float(attrs.get("p", 0.5)))
+
+
+@register_translation("Reshape")
+def _reshape(ctx, name, ins, out, attrs):
+    shape = ctx.const(name, _np.asarray(attrs.get("shape", (-1,)),
+                                        _np.int64))
+    ctx.emit("Reshape", [ins[0], shape], [out])
+
+
+@register_translation("transpose")
+def _transpose(ctx, name, ins, out, attrs):
+    ctx.emit("Transpose", ins[:1], [out],
+             perm=list(attrs.get("axes", ())))
+
+
+@register_translation("clip")
+def _clip(ctx, name, ins, out, attrs):
+    lo = ctx.const(name, _np.float32(attrs.get("a_min", 0.0)))
+    hi = ctx.const(name, _np.float32(attrs.get("a_max", 1.0)))
+    ctx.emit("Clip", [ins[0], lo, hi], [out])
+
+
+def _binary(onnx_op):
+    def tr(ctx, name, ins, out, attrs):
+        ctx.emit(onnx_op, ins[:2], [out])
+
+    return tr
+
+
+for _mx, _ox in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
+                 ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+                 ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+                 ("elemwise_div", "Div"), ("broadcast_div", "Div")]:
+    register_translation(_mx)(_binary(_ox))
+
+
+@register_translation("dot")
+def _dot(ctx, name, ins, out, attrs):
+    a, b = ins[:2]
+    if attrs.get("transpose_a", False):
+        ta = f"{name}_ta"
+        ctx.emit("Transpose", [a], [ta])
+        a = ta
+    if attrs.get("transpose_b", False):
+        tb = f"{name}_tb"
+        ctx.emit("Transpose", [b], [tb])
+        b = tb
+    ctx.emit("MatMul", [a, b], [out])
+
+
+def _scalar_op(onnx_op):
+    def tr(ctx, name, ins, out, attrs):
+        c = ctx.const(name, _np.float32(attrs.get("scalar", 0.0)))
+        ctx.emit(onnx_op, [ins[0], c], [out])
+
+    return tr
+
+
+for _mx, _ox in [("_plus_scalar", "Add"), ("_minus_scalar", "Sub"),
+                 ("_mul_scalar", "Mul"), ("_div_scalar", "Div")]:
+    register_translation(_mx)(_scalar_op(_ox))
+
+
+def _unary(onnx_op):
+    def tr(ctx, name, ins, out, attrs):
+        ctx.emit(onnx_op, ins[:1], [out])
+
+    return tr
+
+
+for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                 ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                 ("sqrt", "Sqrt"), ("negative", "Neg"), ("abs", "Abs"),
+                 ("copy", "Identity"), ("BlockGrad", "Identity"),
+                 ("identity", "Identity")]:
+    register_translation(_mx)(_unary(_ox))
+
+
+def export_model(sym, params, in_shapes=None, in_types=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False,
+                 dynamic=False, input_type=None, input_shape=None,
+                 run_shape_inference=False):
+    """Export a Symbol + params dict to an ONNX file (parity:
+    mx2onnx/_export_model.py export_model). Returns the path."""
+    from ..ndarray import NDArray
+    from ..symbol.symbol import _topo
+
+    in_shapes = in_shapes or input_shape
+    in_types = input_type or in_types
+    if not isinstance(in_types, (list, tuple)):
+        in_types = [in_types]
+
+    order = _topo(sym._entries)
+    param_names = set(params)
+    # also accept reference-style 'arg:'/'aux:' prefixed dicts
+    flat_params = {}
+    for k, v in params.items():
+        k = k.split(":", 1)[1] if ":" in k else k
+        flat_params[k] = v.asnumpy() if isinstance(v, NDArray) \
+            else _np.asarray(v)
+    param_names = set(flat_params)
+
+    ctx = _Ctx()
+    data_inputs = []
+    out_name = {}  # (id(node), idx) -> onnx tensor name
+    for node in order:
+        if node.is_var:
+            out_name[(id(node), 0)] = node.name
+            if node.name not in param_names:
+                data_inputs.append(node.name)
+            continue
+        ins = [out_name[(id(c), i)] for c, i in node.inputs]
+        trans = _TRANSLATIONS.get(node.op)
+        if trans is None:
+            raise NotImplementedError(
+                f"no ONNX translation registered for op {node.op!r}")
+        for i in range(node.num_outputs):
+            out_name[(id(node), i)] = node.name if node.num_outputs == 1 \
+                else f"{node.name}_{i}"
+        trans(ctx, node.name, ins, out_name[(id(node), 0)], node.attrs)
+
+    initializers = ctx.initializers + [
+        proto.tensor(k, v) for k, v in flat_params.items()]
+    if in_shapes is None:
+        raise ValueError("in_shapes is required")
+    if not isinstance(in_shapes[0], (list, tuple)):
+        in_shapes = [in_shapes]
+    if len(in_types) == 1 and len(data_inputs) > 1:
+        in_types = list(in_types) * len(data_inputs)
+    graph_inputs = [proto.value_info(n, t, s)
+                    for n, t, s in zip(data_inputs, in_types, in_shapes)]
+    outputs = []
+    for entry_node, idx in sym._entries:
+        outputs.append(proto.value_info(
+            out_name[(id(entry_node), idx)], _np.float32, ()))
+    g = proto.graph(ctx.nodes, "mxnet_tpu_model", initializers,
+                    graph_inputs, outputs)
+    with open(onnx_file_path, "wb") as f:
+        f.write(proto.model(g))
+    return onnx_file_path

@@ -1,0 +1,248 @@
+"""ONNX -> Symbol import (parity: `python/mxnet/onnx/onnx2mx/`).
+
+Parses a .onnx file with the pure-Python codec and rebuilds the graph
+with mx.sym ops. Covers the op set `mx2onnx` emits (the model-zoo
+subset), so export -> import round-trips numerically.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import proto
+
+_IMPORTS = {}
+
+
+def register_import(op_type):
+    def deco(fn):
+        _IMPORTS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def _halve_pads(pads):
+    if not pads:
+        return ()
+    n = len(pads) // 2
+    return tuple(pads[:n])
+
+
+@register_import("Conv")
+def _conv(sym, ins, attrs, name):
+    return sym.Convolution(
+        *ins, kernel=tuple(attrs.get("kernel_shape", ())),
+        stride=tuple(attrs.get("strides", ())),
+        dilate=tuple(attrs.get("dilations", ())),
+        pad=_halve_pads(attrs.get("pads", ())),
+        num_group=int(attrs.get("group", 1)),
+        num_filter=0,  # resolved from weight shape at eval
+        no_bias=len(ins) < 3, name=name)
+
+
+@register_import("Gemm")
+def _gemm(sym, ins, attrs, name):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    if attrs.get("transA", 0):
+        raise NotImplementedError("ONNX Gemm transA=1 is not supported")
+    w = ins[1]
+    if not attrs.get("transB", 0):
+        # FullyConnected computes x @ W^T; ONNX default transB=0 is x @ W
+        w = sym.transpose(w, name=f"{name}_wT")
+    if alpha == 1.0 and beta == 1.0:
+        return sym.FullyConnected(ins[0], w, *ins[2:3], num_hidden=0,
+                                  no_bias=len(ins) < 3, name=name)
+    out = sym.FullyConnected(ins[0], w, num_hidden=0, no_bias=True,
+                             name=name) * alpha
+    if len(ins) > 2:
+        out = out + ins[2] * beta
+    return out
+
+
+@register_import("BatchNormalization")
+def _bn(sym, ins, attrs, name):
+    return sym.BatchNorm(*ins, eps=float(attrs.get("epsilon", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)),
+                         name=name)
+
+
+def _pool_import(ptype):
+    def fn(sym, ins, attrs, name):
+        conv = "full" if attrs.get("ceil_mode", 0) else "valid"
+        return sym.Pooling(
+            ins[0], kernel=tuple(attrs.get("kernel_shape", ())),
+            stride=tuple(attrs.get("strides", ())),
+            pad=_halve_pads(attrs.get("pads", ())),
+            pool_type=ptype, pooling_convention=conv, name=name)
+
+    return fn
+
+
+register_import("MaxPool")(_pool_import("max"))
+register_import("AveragePool")(_pool_import("avg"))
+
+
+@register_import("GlobalAveragePool")
+def _gavg(sym, ins, attrs, name):
+    return sym.Pooling(ins[0], kernel=(1, 1), pool_type="avg",
+                       global_pool=True, name=name)
+
+
+@register_import("GlobalMaxPool")
+def _gmax(sym, ins, attrs, name):
+    return sym.Pooling(ins[0], kernel=(1, 1), pool_type="max",
+                       global_pool=True, name=name)
+
+
+@register_import("Flatten")
+def _flatten(sym, ins, attrs, name):
+    return sym.Flatten(ins[0], name=name)
+
+
+@register_import("Concat")
+def _concat(sym, ins, attrs, name):
+    return sym.Concat(*ins, dim=int(attrs.get("axis", 1)), name=name)
+
+
+@register_import("Softmax")
+def _softmax(sym, ins, attrs, name):
+    return sym.softmax(ins[0], axis=int(attrs.get("axis", -1)), name=name)
+
+
+@register_import("Dropout")
+def _dropout(sym, ins, attrs, name):
+    return sym.Dropout(ins[0], p=float(attrs.get("ratio", 0.5)), name=name)
+
+
+@register_import("LeakyRelu")
+def _leaky(sym, ins, attrs, name):
+    return sym.LeakyReLU(ins[0], act_type="leaky",
+                         slope=float(attrs.get("alpha", 0.01)), name=name)
+
+
+@register_import("Elu")
+def _elu(sym, ins, attrs, name):
+    return sym.LeakyReLU(ins[0], act_type="elu",
+                         slope=float(attrs.get("alpha", 1.0)), name=name)
+
+
+@register_import("Clip")
+def _clip(sym, ins, attrs, name):
+    # attribute-form Clip (opset < 11); input-form is handled specially
+    # in import_model
+    return sym.clip(ins[0], a_min=float(attrs.get("min", -3.4e38)),
+                    a_max=float(attrs.get("max", 3.4e38)), name=name)
+
+
+@register_import("Transpose")
+def _transpose(sym, ins, attrs, name):
+    return sym.transpose(ins[0], axes=tuple(attrs.get("perm", ())),
+                         name=name)
+
+
+@register_import("Reshape")
+def _reshape(sym, ins, attrs, name):
+    # shape comes as a second (initializer) input; resolved by caller
+    raise NotImplementedError  # handled specially in import_model
+
+
+for _ox, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                 ("Tanh", "tanh"), ("Softplus", "Activation"),
+                 ("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                 ("Neg", "negative"), ("Abs", "abs"),
+                 ("Identity", "copy")]:
+    def _mk(mx_name):
+        def fn(sym, ins, attrs, name):
+            if mx_name == "Activation":
+                return sym.Activation(ins[0], act_type="softrelu",
+                                      name=name)
+            return getattr(sym, mx_name)(ins[0], name=name)
+
+        return fn
+
+    register_import(_ox)(_mk(_mx))
+
+for _ox, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                 ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                 ("MatMul", "dot")]:
+    def _mk2(mx_name):
+        def fn(sym, ins, attrs, name):
+            return getattr(sym, mx_name)(ins[0], ins[1], name=name)
+
+        return fn
+
+    register_import(_ox)(_mk2(_mx))
+
+
+def import_model(model_file):
+    """Parse a .onnx file into (sym, arg_params, aux_params) (parity:
+    onnx2mx import_model)."""
+    import mxnet_tpu as mx
+    from ..ndarray import array
+
+    sym_mod = mx.sym
+    with open(model_file, "rb") as f:
+        m = proto.parse_model(f.read())
+    g = m["graph"]
+    inits = g["initializers"]
+    tensors = {}  # onnx tensor name -> Symbol
+    aux_names = set()
+    for vi in g["inputs"]:
+        if vi["name"] not in inits:
+            tensors[vi["name"]] = sym_mod.var(vi["name"])
+    arg_params, aux_params = {}, {}
+
+    def as_sym(tname, node_name):
+        if tname in tensors:
+            return tensors[tname]
+        if tname in inits:
+            # initializer consumed as graph input -> becomes a var/param
+            v = sym_mod.var(tname)
+            tensors[tname] = v
+            arg_params[tname] = array(inits[tname])
+            return v
+        raise KeyError(f"tensor {tname!r} not produced before use "
+                       f"(node {node_name!r})")
+
+    for n in g["nodes"]:
+        op = n["op_type"]
+        name = n["name"] or n["output"][0]
+        if op == "Reshape":
+            shape = tuple(int(x) for x in inits[n["input"][1]])
+            out = sym_mod.Reshape(as_sym(n["input"][0], name), shape=shape,
+                                  name=name)
+        elif op == "Clip" and len(n["input"]) == 3:
+            lo = float(inits[n["input"][1]])
+            hi = float(inits[n["input"][2]])
+            out = sym_mod.clip(as_sym(n["input"][0], name), a_min=lo,
+                               a_max=hi, name=name)
+        elif op == "BatchNormalization":
+            ins = [as_sym(i, name) for i in n["input"]]
+            # moving stats are aux params
+            for aux_in in n["input"][3:5]:
+                if aux_in in arg_params:
+                    aux_params[aux_in] = arg_params.pop(aux_in)
+                aux_names.add(aux_in)
+            out = _IMPORTS[op](sym_mod, ins, n["attrs"], name)
+        else:
+            fn = _IMPORTS.get(op)
+            if fn is None:
+                raise NotImplementedError(
+                    f"no import translation for ONNX op {op!r}")
+            ins = [as_sym(i, name) for i in n["input"]]
+            out = fn(sym_mod, ins, n["attrs"], name)
+        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        for i, oname in enumerate(n["output"]):
+            tensors[oname] = outs[0][i] if len(n["output"]) > 1 else outs[i] \
+                if i < len(outs) else outs[0]
+
+    out_syms = [tensors[o["name"]] for o in g["outputs"]]
+    sym = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+    # aux vars must be marked aux for bind/eval machinery
+    from ..symbol.symbol import _topo
+
+    for node in _topo(sym._entries):
+        if node.is_var and node.name in aux_names:
+            node.attrs["__is_aux__"] = True
+    return sym, arg_params, aux_params

@@ -1,0 +1,349 @@
+"""Minimal ONNX protobuf wire-format codec (no `onnx` package needed).
+
+The baked environment has no onnx/protobuf, so this module encodes and
+decodes the subset of onnx.proto needed for model export/import:
+ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto, TypeProto, TensorShapeProto, OperatorSetIdProto. Field
+numbers follow the official onnx.proto3 schema, so files written here
+load in netron/onnxruntime and files produced by other exporters load
+here.
+
+parity role: the serialization layer under
+`python/mxnet/onnx/mx2onnx/_export_model.py` (which uses the onnx pip
+package).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+# ---------------------------------------------------------------- encode ---
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16 = 1, 2, 3, 6, 7, 9, 10
+_NP2ONNX = {"float32": FLOAT, "uint8": UINT8, "int8": INT8, "int32": INT32,
+            "int64": INT64, "bool": BOOL, "float16": FLOAT16}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS, A_STRINGS = \
+    1, 2, 3, 4, 6, 7, 8
+
+
+def _varint(v):
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field, value):
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def f_float(field, value):
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_packed_int64(field, values):
+    payload = b"".join(_varint(int(v)) for v in values)
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def f_packed_float(field, values):
+    payload = struct.pack(f"<{len(values)}f", *values)
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def tensor(name, arr):
+    """TensorProto from a numpy array (raw_data layout)."""
+    arr = _np.ascontiguousarray(arr)
+    dt = _NP2ONNX[arr.dtype.name]
+    msg = f_packed_int64(1, arr.shape) if arr.ndim else b""
+    msg += f_varint(2, dt)
+    msg += f_bytes(8, name)
+    msg += f_bytes(9, arr.tobytes())
+    return msg
+
+
+def attribute(name, value):
+    """AttributeProto with type inferred from the python value."""
+    msg = f_bytes(1, name)
+    if isinstance(value, bool):
+        msg += f_varint(3, int(value)) + f_varint(20, A_INT)
+    elif isinstance(value, int):
+        msg += f_varint(3, value) + f_varint(20, A_INT)
+    elif isinstance(value, float):
+        msg += f_float(2, value) + f_varint(20, A_FLOAT)
+    elif isinstance(value, (bytes, str)):
+        msg += f_bytes(4, value) + f_varint(20, A_STRING)
+    elif isinstance(value, _np.ndarray):
+        msg += f_bytes(5, tensor("", value)) + f_varint(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                msg += f_float(7, v)
+            msg += f_varint(20, A_FLOATS)
+        else:
+            for v in value:
+                msg += f_varint(8, int(v))
+            msg += f_varint(20, A_INTS)
+    else:
+        raise TypeError(f"unsupported attribute value {value!r}")
+    return msg
+
+
+def node(op_type, inputs, outputs, name="", **attrs):
+    """NodeProto."""
+    msg = b"".join(f_bytes(1, i) for i in inputs)
+    msg += b"".join(f_bytes(2, o) for o in outputs)
+    msg += f_bytes(3, name or outputs[0])
+    msg += f_bytes(4, op_type)
+    for k, v in attrs.items():
+        msg += f_bytes(5, attribute(k, v))
+    return msg
+
+
+def value_info(name, dtype, shape):
+    shape_msg = b"".join(
+        f_bytes(1, f_varint(1, d) if isinstance(d, int)
+                else f_bytes(2, str(d)))
+        for d in shape)
+    ttype = f_varint(1, _NP2ONNX[_np.dtype(dtype).name]) + \
+        f_bytes(2, shape_msg)
+    return f_bytes(1, name) + f_bytes(2, f_bytes(1, ttype))
+
+
+def graph(nodes, name, initializers, inputs, outputs):
+    msg = b"".join(f_bytes(1, n) for n in nodes)
+    msg += f_bytes(2, name)
+    msg += b"".join(f_bytes(5, t) for t in initializers)
+    msg += b"".join(f_bytes(11, i) for i in inputs)
+    msg += b"".join(f_bytes(12, o) for o in outputs)
+    return msg
+
+
+def model(graph_msg, opset=13, producer="mxnet_tpu"):
+    msg = f_varint(1, 8)  # ir_version 8
+    msg += f_bytes(2, producer)
+    msg += f_bytes(7, graph_msg)
+    opset_msg = f_bytes(1, "") + f_varint(2, opset)
+    msg += f_bytes(8, opset_msg)
+    return msg
+
+
+# ---------------------------------------------------------------- decode ---
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def fields(buf):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    Length-delimited values come back as bytes; varints as int;
+    32-bit as float."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _sint(v):
+    """Two's-complement sign extension for int64 varints (axis=-1 etc.)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _unpack_int64s(buf):
+    out = []
+    pos = 0
+    while pos < len(buf):
+        v, pos = _read_varint(buf, pos)
+        out.append(_sint(v))
+    return out
+
+
+def parse_tensor(buf):
+    dims, dtype, name, raw = [], FLOAT, "", b""
+    i32, i64, f32 = [], [], []
+    for field, wire, val in fields(buf):
+        if field == 1:
+            dims.extend(_unpack_int64s(val) if wire == 2 else [val])
+        elif field == 2:
+            dtype = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+        elif field == 4:
+            f32 = list(struct.unpack(f"<{len(val) // 4}f", val)) \
+                if wire == 2 else f32 + [val]
+        elif field == 5:
+            i32 = _unpack_int64s(val) if wire == 2 else i32 + [val]
+        elif field == 7:
+            i64 = _unpack_int64s(val) if wire == 2 else i64 + [val]
+    np_dt = _np.dtype(_ONNX2NP.get(dtype, "float32"))
+    if raw:
+        arr = _np.frombuffer(raw, np_dt).reshape(dims)
+    elif f32:
+        arr = _np.asarray(f32, np_dt).reshape(dims)
+    elif i64:
+        arr = _np.asarray(i64, np_dt).reshape(dims)
+    elif i32:
+        arr = _np.asarray(i32, np_dt).reshape(dims)
+    else:
+        arr = _np.zeros(dims, np_dt)
+    return name, arr
+
+
+def parse_attribute(buf):
+    name, atype = "", None
+    f = i = s = t = None
+    floats, ints = [], []
+    for field, wire, val in fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            f = val
+        elif field == 3:
+            i = _sint(val)
+        elif field == 4:
+            s = val
+        elif field == 5:
+            t = parse_tensor(val)[1]
+        elif field == 7:
+            floats.extend(struct.unpack(f"<{len(val) // 4}f", val)
+                          if wire == 2 else [val])
+        elif field == 8:
+            ints.extend(_unpack_int64s(val) if wire == 2 else [_sint(val)])
+        elif field == 20:
+            atype = val
+    if atype == A_FLOAT:
+        return name, f
+    if atype == A_INT:
+        return name, i
+    if atype == A_STRING:
+        return name, s.decode() if s is not None else ""
+    if atype == A_TENSOR:
+        return name, t
+    if atype == A_FLOATS:
+        return name, list(floats)
+    if atype == A_INTS:
+        return name, list(ints)
+    # untyped (older writers): best effort
+    for v in (i, f, s, t):
+        if v is not None:
+            return name, v
+    return name, ints or floats
+
+
+def parse_node(buf):
+    n = {"input": [], "output": [], "name": "", "op_type": "", "attrs": {}}
+    for field, wire, val in fields(buf):
+        if field == 1:
+            n["input"].append(val.decode())
+        elif field == 2:
+            n["output"].append(val.decode())
+        elif field == 3:
+            n["name"] = val.decode()
+        elif field == 4:
+            n["op_type"] = val.decode()
+        elif field == 5:
+            k, v = parse_attribute(val)
+            n["attrs"][k] = v
+    return n
+
+
+def parse_value_info(buf):
+    name, dtype, shape = "", "float32", []
+    for field, wire, val in fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            for f2, _, v2 in fields(val):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in fields(v2):
+                        if f3 == 1:
+                            dtype = _ONNX2NP.get(v3, "float32")
+                        elif f3 == 2:  # shape
+                            for f4, _, v4 in fields(v3):
+                                if f4 == 1:  # dim
+                                    dv = 0
+                                    for f5, _, v5 in fields(v4):
+                                        if f5 == 1:
+                                            dv = v5
+                                    shape.append(dv)
+    return {"name": name, "dtype": dtype, "shape": tuple(shape)}
+
+
+def parse_graph(buf):
+    g = {"nodes": [], "name": "", "initializers": {}, "inputs": [],
+         "outputs": []}
+    for field, wire, val in fields(buf):
+        if field == 1:
+            g["nodes"].append(parse_node(val))
+        elif field == 2:
+            g["name"] = val.decode()
+        elif field == 5:
+            name, arr = parse_tensor(val)
+            g["initializers"][name] = arr
+        elif field == 11:
+            g["inputs"].append(parse_value_info(val))
+        elif field == 12:
+            g["outputs"].append(parse_value_info(val))
+    return g
+
+
+def parse_model(buf):
+    m = {"ir_version": None, "producer": "", "graph": None, "opset": None}
+    for field, wire, val in fields(buf):
+        if field == 1:
+            m["ir_version"] = val
+        elif field == 2:
+            m["producer"] = val.decode()
+        elif field == 7:
+            m["graph"] = parse_graph(val)
+        elif field == 8:
+            for f2, _, v2 in fields(val):
+                if f2 == 2:
+                    m["opset"] = v2
+    return m

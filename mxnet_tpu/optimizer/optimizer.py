@@ -326,6 +326,9 @@ class SGD(Optimizer):
         import jax
         import jax.numpy as jnp
 
+        from ..ndarray.sparse import merge_duplicates
+
+        grad = merge_duplicates(grad)  # indices-only sync when unique
         rg = self.rescale_grad
         clip = self.clip_gradient if self.clip_gradient else 0.0
         mom = self.momentum

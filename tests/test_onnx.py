@@ -1,0 +1,154 @@
+"""ONNX export/import tests (parity model: tests/python-pytest/onnx/).
+
+No onnx/onnxruntime in this environment, so verification is (a) codec
+round-trips through our own spec-conformant parser and (b) NUMERIC
+round-trips: export a zoo model, re-import, compare outputs bit-exactly.
+When the official onnx package is present, its checker also runs.
+"""
+import os
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import onnx as mx_onnx
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.onnx import proto
+
+
+def _export_zoo(name, shp, classes=10):
+    net = vision.get_model(name, classes=classes)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(0).rand(*shp).astype("float32"))
+    ref = net(x).asnumpy()
+    d = tempfile.mkdtemp()
+    net.export(os.path.join(d, "n"), 0)
+    sym, args, auxs = mx.model.load_checkpoint(os.path.join(d, "n"), 0)
+    path = mx_onnx.export_model(sym, {**args, **auxs}, in_shapes=[shp],
+                                onnx_file_path=os.path.join(d, "m.onnx"))
+    return path, x, ref
+
+
+# ---------------------------------------------------------------- codec ----
+
+def test_proto_tensor_roundtrip():
+    arr = onp.arange(24, dtype=onp.float32).reshape(2, 3, 4)
+    name, back = proto.parse_tensor(proto.tensor("t", arr))
+    assert name == "t"
+    onp.testing.assert_array_equal(back, arr)
+    iarr = onp.array([[1, -2], [3, 4]], onp.int64)
+    _, iback = proto.parse_tensor(proto.tensor("i", iarr))
+    onp.testing.assert_array_equal(iback, iarr)
+
+
+def test_proto_attribute_roundtrip():
+    for val in [3, 2.5, "hello", [1, 2, 3], [1.5, 2.5]]:
+        name, back = proto.parse_attribute(proto.attribute("a", val))
+        assert name == "a"
+        if isinstance(val, list):
+            assert list(back) == pytest.approx(val)
+        else:
+            assert back == val or back == pytest.approx(val)
+
+
+def test_proto_node_roundtrip():
+    buf = proto.node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                     group=1)
+    n = proto.parse_node(buf)
+    assert n["op_type"] == "Conv"
+    assert n["input"] == ["x", "w"] and n["output"] == ["y"]
+    assert list(n["attrs"]["kernel_shape"]) == [3, 3]
+
+
+# ----------------------------------------------------------- model level ----
+
+def test_export_produces_wellformed_graph():
+    path, _, _ = _export_zoo("resnet18_v1", (1, 3, 32, 32))
+    with open(path, "rb") as f:
+        m = proto.parse_model(f.read())
+    assert m["opset"] == 13 and m["producer"] == "mxnet_tpu"
+    g = m["graph"]
+    assert g["inputs"][0]["name"] == "data"
+    assert g["inputs"][0]["shape"] == (1, 3, 32, 32)
+    produced = {vi["name"] for vi in g["inputs"]} | set(g["initializers"])
+    for n in g["nodes"]:
+        for i in n["input"]:
+            assert i in produced, f"node {n['name']} consumes unknown {i}"
+        produced.update(n["output"])
+    assert g["outputs"][0]["name"] in produced
+    ops = {n["op_type"] for n in g["nodes"]}
+    assert {"Conv", "BatchNormalization", "Relu", "Gemm"} <= ops
+
+
+@pytest.mark.parametrize("name,shp", [
+    ("resnet18_v1", (1, 3, 32, 32)),
+    ("mobilenet0_25", (1, 3, 32, 32)),
+    ("squeezenet1_0", (1, 3, 64, 64)),
+])
+def test_numeric_roundtrip(name, shp):
+    path, x, ref = _export_zoo(name, shp)
+    sym2, args2, auxs2 = mx_onnx.import_model(path)
+    out = sym2.eval_with({"data": x, **args2, **auxs2}).asnumpy()
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert rel < 1e-4, rel
+
+
+def test_official_onnx_checker_if_available():
+    onnx = pytest.importorskip("onnx")
+    path, _, _ = _export_zoo("mobilenet0_25", (1, 3, 32, 32))
+    model = onnx.load(path)
+    onnx.checker.check_model(model)
+
+
+def test_negative_int_attributes_roundtrip():
+    # regression: varint decode must sign-extend (softmax axis=-1)
+    _, v = proto.parse_attribute(proto.attribute("axis", -1))
+    assert v == -1
+    _, vs = proto.parse_attribute(proto.attribute("perm", [2, -1, 0]))
+    assert list(vs) == [2, -1, 0]
+
+
+def test_softmax_export_import_roundtrip():
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    sm = mx.sym.softmax(fc, name="sm")
+    args = {"fc_weight": mx.nd.ones((4, 8)) * 0.1,
+            "fc_bias": mx.nd.zeros((4,))}
+    d = tempfile.mkdtemp()
+    path = mx_onnx.export_model(sm, args, in_shapes=[(2, 8)],
+                                onnx_file_path=os.path.join(d, "m.onnx"))
+    sym2, args2, _ = mx_onnx.import_model(path)
+    x = mx.nd.array(onp.random.RandomState(1).rand(2, 8).astype("float32"))
+    ref = sm.eval_with({"data": x, **args}).asnumpy()
+    out = sym2.eval_with({"data": x, **args2}).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_dot_transpose_export():
+    import mxnet_tpu as mx
+
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out_sym = mx.sym.dot(a, b, transpose_b=True, name="d")
+    av = onp.random.RandomState(2).rand(3, 4).astype("float32")
+    bv = onp.random.RandomState(3).rand(5, 4).astype("float32")
+    d = tempfile.mkdtemp()
+    path = mx_onnx.export_model(out_sym, {}, in_shapes=[(3, 4), (5, 4)],
+                                onnx_file_path=os.path.join(d, "m.onnx"))
+    sym2, args2, _ = mx_onnx.import_model(path)
+    out = sym2.eval_with({"a": mx.nd.array(av), "b": mx.nd.array(bv),
+                          **args2}).asnumpy()
+    onp.testing.assert_allclose(out, av @ bv.T, rtol=1e-5)
+
+
+def test_compression_disable_with_empty_params():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit"})
+    assert kv.gradient_compression
+    kv.set_gradient_compression({})
+    assert not kv.gradient_compression
