@@ -1,0 +1,8 @@
+"""gluon.contrib (parity: `python/mxnet/gluon/contrib/__init__.py`):
+experimental blocks (`nn`), the Estimator training facade
+(`estimator`), and contrib data helpers."""
+from __future__ import annotations
+
+from . import estimator, nn
+
+__all__ = ["nn", "estimator"]

@@ -1,0 +1,11 @@
+"""Contrib neural-network blocks (parity:
+`python/mxnet/gluon/contrib/nn/basic_layers.py`)."""
+from __future__ import annotations
+
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           PixelShuffle1D, PixelShuffle2D, PixelShuffle3D,
+                           SparseEmbedding, SyncBatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]

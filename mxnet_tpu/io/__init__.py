@@ -1,6 +1,6 @@
 """Data IO (parity: python/mxnet/io/)."""
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MNISTIter, CSVIter)
+                 PrefetchingIter, MNISTIter, CSVIter, LibSVMIter, ImageRecordIter)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter"]

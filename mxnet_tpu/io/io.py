@@ -435,3 +435,172 @@ class CSVIter(NDArrayIter):
             label = label.reshape((-1,) + tuple(label_shape))
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle="pad" if round_batch else "discard")
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR batches (parity:
+    `src/io/iter_libsvm.cc` MXNET_REGISTER_IO_ITER LibSVMIter).
+
+    Each line: ``<label> <idx>:<val> <idx>:<val> ...`` (indices
+    0-based like the reference's libsvm reader). `data_shape` is the
+    feature-vector length; batches carry a `CSRNDArray` so sparse-aware
+    consumers (linear models, FMs) keep sparse storage end-to-end.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=128, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        self._num_features = int(data_shape[-1])
+        labels, indptr, indices, values = [], [0], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        if label_libsvm is not None:
+            labels = [float(l.split()[0]) for l in open(label_libsvm)
+                      if l.strip()]
+        self._labels = _np.asarray(labels, _np.float32)
+        self._indptr = _np.asarray(indptr, _np.int64)
+        self._indices = _np.asarray(indices, _np.int64)
+        self._values = _np.asarray(values, _np.float32)
+        self._num = len(self._labels)
+        self._round_batch = round_batch
+        self._cursor = 0
+        self.provide_data = [DataDesc("data",
+                                      (batch_size, self._num_features))]
+        self.provide_label = [DataDesc("label",
+                                       (batch_size,) + tuple(label_shape))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _gather_rows(self, rows):
+        ind, vals, ptr = [], [], [0]
+        for r in rows:
+            lo, hi = int(self._indptr[r]), int(self._indptr[r + 1])
+            ind.append(self._indices[lo:hi])
+            vals.append(self._values[lo:hi])
+            ptr.append(ptr[-1] + hi - lo)
+        return (_np.concatenate(vals) if vals else self._values[:0],
+                _np.concatenate(ind) if ind else self._indices[:0],
+                _np.asarray(ptr, _np.int64))
+
+    def next(self):
+        from ..ndarray import array
+        from ..ndarray.sparse import CSRNDArray
+
+        if self._cursor >= self._num:
+            raise StopIteration
+        s = self._cursor
+        e = s + self.batch_size
+        pad = 0
+        if e > self._num:
+            if not self._round_batch:
+                raise StopIteration
+            pad = e - self._num  # wrap to the epoch start (parity:
+            e = self._num        # round_batch fills from the beginning)
+        rows = list(range(s, e)) + list(range(pad))
+        self._cursor = s + self.batch_size
+        vals, ind, ptr = self._gather_rows(rows)
+        csr = CSRNDArray(vals, ind, ptr,
+                         (self.batch_size, self._num_features))
+        label = array(self._labels[rows])
+        return DataBatch(data=[csr], label=[label], pad=pad, index=None)
+
+
+class ImageRecordIter(DataIter):
+    """Batched image iterator over .rec databases (parity:
+    `src/io/iter_image_recordio_2.cc:880` MXNET_REGISTER_IO_ITER
+    ImageRecordIter).
+
+    Decodes each packed image, resizes to `data_shape`, and assembles
+    NCHW float32 batches; the u8->f32 channel-normalization inner loop
+    runs in the native C++ library when built (`mxnet_tpu.native`),
+    matching the reference's C++ ProcessImage path."""
+
+    def __init__(self, path_imgrec, data_shape, path_imgidx=None,
+                 batch_size=128, shuffle=False, label_width=1,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 round_batch=True, seed=0, **kwargs):
+        from .. import recordio as _recordio
+
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)
+        if path_imgidx is None:
+            path_imgidx = path_imgrec[:-4] + ".idx" \
+                if path_imgrec.endswith(".rec") else path_imgrec + ".idx"
+        self._rec = _recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                "r")
+        self._order = list(self._rec.keys)
+        self._shuffle = shuffle
+        self._rng = _np.random.RandomState(seed)
+        self._label_width = label_width
+        self._mean = _np.asarray([mean_r, mean_g, mean_b], _np.float32)
+        self._std = _np.asarray([std_r, std_g, std_b], _np.float32)
+        self._scale = scale
+        self._round_batch = round_batch
+        self._cursor = 0
+        self.provide_data = [DataDesc("data",
+                                      (batch_size,) + self._data_shape)]
+        lshape = (batch_size,) if label_width == 1 \
+            else (batch_size, label_width)
+        self.provide_label = [DataDesc("label", lshape)]
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+
+    def _decode(self, key):
+        from .. import recordio as _recordio
+
+        header, img = _recordio.unpack_img(self._rec.read_idx(key))
+        arr = img.asnumpy() if hasattr(img, "asnumpy") else _np.asarray(img)
+        c, h, w = self._data_shape
+        if arr.shape[0] != h or arr.shape[1] != w:
+            from .. import image as image_mod
+            from ..ndarray import array as _array
+
+            arr = image_mod.imresize(_array(arr), w, h).asnumpy()
+        label = header.label
+        label = _np.asarray(label, _np.float32).reshape(-1)
+        return arr.astype(_np.uint8), label[:self._label_width]
+
+    def next(self):
+        from .. import native
+        from ..ndarray import array as _array
+
+        if self._cursor >= len(self._order):
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        if end > len(self._order) and not self._round_batch:
+            raise StopIteration
+        keys = self._order[self._cursor:end]
+        if len(keys) < self.batch_size:  # wrap (round_batch)
+            keys = keys + self._order[:self.batch_size - len(keys)]
+        self._cursor += self.batch_size
+        imgs, labels = [], []
+        for k in keys:
+            a, l = self._decode(k)
+            imgs.append(a)
+            labels.append(l)
+        batch_u8 = _np.stack(imgs)  # (N, H, W, C)
+        chw = native.normalize_batch(batch_u8, self._mean, self._std,
+                                     scale=self._scale)
+        label_arr = _np.stack(labels)
+        if self._label_width == 1:
+            label_arr = label_arr.reshape(-1)
+        return DataBatch(data=[_array(chw)], label=[_array(label_arr)],
+                         pad=0, index=None)

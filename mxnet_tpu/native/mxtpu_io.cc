@@ -1,0 +1,125 @@
+// Native IO runtime: RecordIO scanning + image batch normalization.
+//
+// Parity role: the reference's C++ data-pipeline hot paths —
+// dmlc-core's RecordIOReader (src/io/image_recordio.h framing) and the
+// image normalization inner loops of iter_image_recordio_2.cc
+// (ImageRecordIOParser2<DType>::ProcessImage). The Python framework
+// binds these through ctypes (no pybind11 in the image); everything here
+// is plain C ABI.
+//
+// Build: g++ -O3 -shared -fPIC (see native/build.py; rebuilt on demand,
+// cached next to this source).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+static const uint32_t kMagic = 0xced7230a;
+static const uint32_t kLRecBits = 29;
+
+// Scan a RecordIO file for magic-framed records. Fills caller-provided
+// arrays (capacity `cap`) with each record's payload offset and length.
+// Returns the number of records found, or -1 on IO error, or -(needed)
+// if cap was too small (caller retries with a larger buffer).
+long long mxtpu_recordio_scan(const char* path, uint64_t* offsets,
+                              uint64_t* lengths, long long cap) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  long long n = 0;
+  uint32_t header[2];
+  for (;;) {
+    long pos = ftell(f);
+    size_t got = fread(header, sizeof(uint32_t), 2, f);
+    if (got != 2) break;  // EOF
+    if (header[0] != kMagic) { fclose(f); return -1; }
+    uint64_t len = header[1] & ((1u << kLRecBits) - 1);
+    uint32_t cflag = header[1] >> kLRecBits;
+    if (cflag != 0) {
+      // multi-part records: skip continuation framing (rare; the
+      // Python path handles them; report as unsupported)
+      fclose(f);
+      return -1;
+    }
+    if (n >= cap) { fclose(f); return -(n + 1); }
+    offsets[n] = (uint64_t)pos + 2 * sizeof(uint32_t);
+    lengths[n] = len;
+    ++n;
+    uint64_t padded = (len + 3u) & ~3ull;
+    if (fseek(f, (long)(pos + 8 + (long)padded), SEEK_SET) != 0) break;
+  }
+  fclose(f);
+  return n;
+}
+
+// Read `count` records' payloads (given offsets/lengths from scan) into
+// one contiguous buffer `dst` (caller sized it as sum of lengths).
+// Returns 0 on success.
+int mxtpu_recordio_read(const char* path, const uint64_t* offsets,
+                        const uint64_t* lengths, long long count,
+                        uint8_t* dst) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint8_t* p = dst;
+  for (long long i = 0; i < count; ++i) {
+    if (fseek(f, (long)offsets[i], SEEK_SET) != 0) { fclose(f); return -1; }
+    if (fread(p, 1, (size_t)lengths[i], f) != lengths[i]) {
+      fclose(f);
+      return -1;
+    }
+    p += lengths[i];
+  }
+  fclose(f);
+  return 0;
+}
+
+// HWC uint8 image batch -> CHW float32 with per-channel mean/std
+// normalization (the ImageRecordIter inner loop; parity:
+// iter_image_recordio_2.cc ProcessImage). n images of h*w*c bytes.
+void mxtpu_normalize_hwc_u8_to_chw_f32(const uint8_t* src, float* dst,
+                                       long long n, long long h,
+                                       long long w, long long c,
+                                       const float* mean,
+                                       const float* std_inv,
+                                       float scale) {
+  const long long hw = h * w;
+  for (long long i = 0; i < n; ++i) {
+    const uint8_t* img = src + i * hw * c;
+    float* out = dst + i * hw * c;
+    for (long long ch = 0; ch < c; ++ch) {
+      const float m = mean ? mean[ch] : 0.0f;
+      const float s = std_inv ? std_inv[ch] : 1.0f;
+      float* plane = out + ch * hw;
+      for (long long p = 0; p < hw; ++p) {
+        plane[p] = ((float)img[p * c + ch] * scale - m) * s;
+      }
+    }
+  }
+}
+
+// Pack payloads into RecordIO framing in one pass: writes
+// magic|lrecord|payload|pad for each record into dst; returns bytes
+// written (caller sized dst as sum of 8 + padded lengths).
+long long mxtpu_recordio_pack(const uint8_t* payloads,
+                              const uint64_t* lengths, long long count,
+                              uint8_t* dst) {
+  const uint8_t* src = payloads;
+  uint8_t* p = dst;
+  for (long long i = 0; i < count; ++i) {
+    uint32_t len = (uint32_t)lengths[i];
+    uint32_t header[2] = {kMagic, len};
+    memcpy(p, header, 8);
+    p += 8;
+    memcpy(p, src, len);
+    src += len;
+    p += len;
+    uint32_t pad = ((len + 3u) & ~3u) - len;
+    memset(p, 0, pad);
+    p += pad;
+  }
+  return (long long)(p - dst);
+}
+
+}  // extern "C"

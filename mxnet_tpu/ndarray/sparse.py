@@ -182,9 +182,15 @@ class RowSparseNDArray(NDArray):
 
 
 class CSRNDArray(NDArray):
-    """Compressed sparse row matrix (data, indices, indptr)."""
+    """Compressed sparse row matrix (data, indices, indptr).
 
-    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr", "_dense_shape")
+    Like RowSparseNDArray, storage is genuinely sparse — the dense view
+    materializes lazily on first dense access (storage fallback), so a
+    LibSVM pipeline feeding sparse-aware consumers never pays the
+    (rows, num_features) dense memory."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr",
+                 "_dense_shape", "_dense_cache")
 
     def __init__(self, data, indices, indptr, shape):
         self._csr_data = data if isinstance(data, NDArray) else array(data)
@@ -193,17 +199,61 @@ class CSRNDArray(NDArray):
         self._csr_indptr = indptr if isinstance(indptr, NDArray) \
             else array(indptr, dtype="int64")
         self._dense_shape = tuple(shape)
-        super().__init__(self._densify_np())
+        self._dense_cache = None
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._tape_index = 0
+        self._fresh_grad = False
 
-    def _densify_np(self):
-        vals = _np.asarray(self._csr_data.asnumpy())
-        idx = _np.asarray(self._csr_indices.asnumpy()).astype(int)
-        ptr = _np.asarray(self._csr_indptr.asnumpy()).astype(int)
-        out = _np.zeros(self._dense_shape, vals.dtype)
-        for r in range(self._dense_shape[0]):
-            cols = idx[ptr[r]:ptr[r + 1]]
-            out[r, cols] = vals[ptr[r]:ptr[r + 1]]
-        return out
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._densify_raw()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, raw):
+        self._dense_cache = raw
+
+    def _densify_raw(self):
+        import jax.numpy as jnp
+
+        vals = self._csr_data._data
+        idx = self._csr_indices._data.astype(jnp.int32)
+        ptr = _np.asarray(self._csr_indptr.asnumpy()).astype(_np.int64)
+        # row id per nonzero from indptr (host side: ptr is tiny)
+        row_ids = _np.repeat(_np.arange(len(ptr) - 1), _np.diff(ptr))
+        out = jnp.zeros(self._dense_shape, vals.dtype)
+        return out.at[jnp.asarray(row_ids), idx].set(vals)
+
+    @property
+    def shape(self):
+        return self._dense_shape
+
+    @property
+    def dtype(self):
+        return self._csr_data.dtype
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._dense_shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return len(self._dense_shape)
+
+    @property
+    def context(self):
+        return self._csr_data.context
+
+    ctx = context
+
+    def wait_to_read(self):
+        self._csr_data.wait_to_read()
 
     @property
     def stype(self):

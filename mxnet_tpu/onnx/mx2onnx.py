@@ -91,7 +91,16 @@ def _leaky(ctx, name, ins, out, attrs):
         ctx.emit("Elu", ins[:1], [out],
                  alpha=float(attrs.get("slope", 0.25)))
     elif act == "gelu":
-        ctx.emit("Gelu", ins[:1], [out])
+        # Gelu is not in the default domain at opset 13: decompose to
+        # 0.5*x*(1+erf(x/sqrt(2)))
+        inv_sqrt2 = ctx.const(name, _np.float32(0.7071067811865476))
+        half = ctx.const(name, _np.float32(0.5))
+        one = ctx.const(name, _np.float32(1.0))
+        ctx.emit("Mul", [ins[0], inv_sqrt2], [f"{name}_scaled"])
+        ctx.emit("Erf", [f"{name}_scaled"], [f"{name}_erf"])
+        ctx.emit("Add", [f"{name}_erf", one], [f"{name}_1p"])
+        ctx.emit("Mul", [ins[0], f"{name}_1p"], [f"{name}_x1p"])
+        ctx.emit("Mul", [f"{name}_x1p", half], [out])
     elif act == "prelu":
         ctx.emit("PRelu", ins[:2], [out])
     else:
@@ -150,8 +159,9 @@ def _softmax_output(ctx, name, ins, out, attrs):
 
 @register_translation("Dropout")
 def _dropout(ctx, name, ins, out, attrs):
-    ctx.emit("Dropout", ins[:1], [out],
-             ratio=float(attrs.get("p", 0.5)))
+    # inference export: Dropout is identity (opset 13 moved ratio to an
+    # input; an Identity node is the valid always-inference encoding)
+    ctx.emit("Identity", ins[:1], [out])
 
 
 @register_translation("Reshape")
@@ -163,8 +173,12 @@ def _reshape(ctx, name, ins, out, attrs):
 
 @register_translation("transpose")
 def _transpose(ctx, name, ins, out, attrs):
-    ctx.emit("Transpose", ins[:1], [out],
-             perm=list(attrs.get("axes", ())))
+    axes = list(attrs.get("axes", ()) or ())
+    if axes:
+        ctx.emit("Transpose", ins[:1], [out], perm=axes)
+    else:
+        # omit perm: the ONNX default (reverse dims) matches mxnet's
+        ctx.emit("Transpose", ins[:1], [out])
 
 
 @register_translation("clip")
@@ -245,8 +259,7 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
         in_types = [in_types]
 
     order = _topo(sym._entries)
-    param_names = set(params)
-    # also accept reference-style 'arg:'/'aux:' prefixed dicts
+    # accept reference-style 'arg:'/'aux:' prefixed dicts too
     flat_params = {}
     for k, v in params.items():
         k = k.split(":", 1)[1] if ":" in k else k
@@ -286,7 +299,7 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
     outputs = []
     for entry_node, idx in sym._entries:
         outputs.append(proto.value_info(
-            out_name[(id(entry_node), idx)], _np.float32, ()))
+            out_name[(id(entry_node), idx)], _np.float32, None))
     g = proto.graph(ctx.nodes, "mxnet_tpu_model", initializers,
                     graph_inputs, outputs)
     with open(onnx_file_path, "wb") as f:

@@ -122,12 +122,16 @@ def node(op_type, inputs, outputs, name="", **attrs):
 
 
 def value_info(name, dtype, shape):
-    shape_msg = b"".join(
-        f_bytes(1, f_varint(1, d) if isinstance(d, int)
-                else f_bytes(2, str(d)))
-        for d in shape)
-    ttype = f_varint(1, _NP2ONNX[_np.dtype(dtype).name]) + \
-        f_bytes(2, shape_msg)
+    """ValueInfoProto. shape=None omits the shape message entirely
+    (unknown shape — the valid encoding; an empty present shape would
+    declare a scalar)."""
+    ttype = f_varint(1, _NP2ONNX[_np.dtype(dtype).name])
+    if shape is not None:
+        shape_msg = b"".join(
+            f_bytes(1, f_varint(1, d) if isinstance(d, int)
+                    else f_bytes(2, str(d)))
+            for d in shape)
+        ttype += f_bytes(2, shape_msg)
     return f_bytes(1, name) + f_bytes(2, f_bytes(1, ttype))
 
 

@@ -151,6 +151,17 @@ class MXIndexedRecordIO(MXRecordIO):
                     key = self.key_type(parts[0])
                     self.idx[key] = int(parts[1])
                     self.keys.append(key)
+        elif self.flag == "r":
+            # no .idx: rebuild by scanning the record framing (native C++
+            # scan when the toolchain is available — the reference requires
+            # the .idx and errors here)
+            from . import native
+
+            offsets, _ = native.recordio_scan(self.uri)
+            for i, off in enumerate(offsets):
+                key = self.key_type(i)
+                self.idx[key] = int(off) - 8  # record start incl. header
+                self.keys.append(key)
         elif self.flag == "w":
             self.fidx = open(self.idx_path, "w")
 

@@ -1,0 +1,108 @@
+"""gluon.contrib tests (parity model:
+tests/python/unittest/test_gluon_contrib.py + test_gluon_estimator.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib.estimator import (EarlyStoppingHandler,
+                                               Estimator, StoppingHandler)
+
+
+def test_identity_and_concurrent():
+    x = mx.nd.array(onp.random.rand(2, 8, 4, 4).astype("float32"))
+    assert (cnn.Identity()(x).asnumpy() == x.asnumpy()).all()
+    for cls in (cnn.Concurrent, cnn.HybridConcurrent):
+        c = cls(axis=1)
+        c.add(cnn.Identity(), cnn.Identity())
+        out = c(x)
+        assert out.shape == (2, 16, 4, 4)
+        onp.testing.assert_allclose(out.asnumpy()[:, :8], x.asnumpy())
+
+
+def test_pixelshuffle_oracle():
+    x = mx.nd.array(onp.arange(2 * 8 * 4 * 4,
+                               dtype="float32").reshape(2, 8, 4, 4))
+    out = cnn.PixelShuffle2D(2)(x)
+    xn = x.asnumpy()
+    n, c, h, w = xn.shape
+    ref = xn.reshape(n, 2, 2, 2, h, w).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(n, 2, h * 2, w * 2)
+    onp.testing.assert_allclose(out.asnumpy(), ref)
+    x1 = mx.nd.array(onp.arange(12, dtype="float32").reshape(1, 4, 3))
+    assert cnn.PixelShuffle1D(2)(x1).shape == (1, 2, 6)
+    x3 = mx.nd.ones((1, 8, 2, 2, 2))
+    assert cnn.PixelShuffle3D(2)(x3).shape == (1, 1, 4, 4, 4)
+
+
+def test_sparse_embedding_grad_rows():
+    se = cnn.SparseEmbedding(50, 8)
+    se.initialize(mx.init.Xavier())
+    idx = mx.nd.array([1, 3, 3], dtype="int32")
+    with mx.autograd.record():
+        out = se(idx)
+        loss = out.sum()
+    loss.backward()
+    rs = se.grad_rows(idx)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [1, 3]
+    onp.testing.assert_allclose(rs.data.asnumpy()[0], onp.ones(8))
+    onp.testing.assert_allclose(rs.data.asnumpy()[1], 2 * onp.ones(8))
+
+
+def test_sync_batchnorm_forward():
+    bn = cnn.SyncBatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(onp.random.rand(2, 4, 3, 3).astype("float32"))
+    out = bn(x)
+    assert out.shape == x.shape
+
+
+def _toy_data(n=256):
+    rs = onp.random.RandomState(0)
+    X = rs.randn(n, 10).astype("float32")
+    y = (X[:, 0] > 0).astype("float32")
+    return mx.io.NDArrayIter(X, y, batch_size=32)
+
+
+def _toy_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    return net
+
+
+def test_estimator_fit_and_evaluate():
+    mx.random.seed(0)
+    net = _toy_net()
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(), context=mx.cpu(),
+                    trainer=Trainer(net.collect_params(), "adam",
+                                    {"learning_rate": 0.01}))
+    it = _toy_data()
+    est.fit(it, epochs=8)
+    res = est.evaluate(_toy_data())
+    assert res["accuracy"] > 0.9, res
+    assert "val_loss" in res
+
+
+def test_estimator_early_stopping():
+    mx.random.seed(0)
+    net = _toy_net()
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(), context=mx.cpu())
+    handler = EarlyStoppingHandler(monitor=est.train_loss_metric,
+                                   patience=1, mode="min")
+    est.fit(_toy_data(64), epochs=50, event_handlers=[
+        handler, StoppingHandler(max_epoch=50)])
+    # either converged loss triggered early stop, or max epochs hit
+    assert handler.current_epoch <= 50
+
+
+def test_estimator_max_batches():
+    net = _toy_net()
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(), context=mx.cpu())
+    stopper = StoppingHandler(max_batch=3)
+    est.fit(_toy_data(), batches=3, event_handlers=[stopper])
+    assert stopper.current_batch == 3

@@ -293,3 +293,53 @@ def test_ndarray_iter_discard_protocol():
         assert batch is not None
         seen += 1
     assert seen == 2  # 10 // 4 full batches only
+
+
+def test_libsvm_iter():
+    import tempfile
+
+    f = tempfile.NamedTemporaryFile("w", suffix=".libsvm", delete=False)
+    f.write("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n0 0:0.25\n")
+    f.close()
+    it = mx.io.LibSVMIter(data_libsvm=f.name, data_shape=(4,), batch_size=2)
+    b = next(iter(it))
+    assert b.data[0].stype == "csr"
+    np.testing.assert_allclose(b.data[0].tostype("default").asnumpy(),
+                               [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+    b2 = it.next()
+    np.testing.assert_allclose(b2.data[0].tostype("default").asnumpy(),
+                               [[0, 0, 3.0, 1.0], [0.25, 0, 0, 0]])
+    it.reset()
+    assert next(iter(it)).label[0].asnumpy().tolist() == [1, 0]
+
+
+def test_libsvm_iter_round_batch():
+    import tempfile
+
+    f = tempfile.NamedTemporaryFile("w", suffix=".libsvm", delete=False)
+    f.write("1 0:1.0\n0 1:2.0\n1 2:3.0\n")  # 3 rows, batch 2
+    f.close()
+    it = mx.io.LibSVMIter(data_libsvm=f.name, data_shape=(4,), batch_size=2,
+                          round_batch=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 1  # wrapped one sample
+    np.testing.assert_allclose(
+        batches[1].data[0].tostype("default").asnumpy(),
+        [[0, 0, 3.0, 0], [1.0, 0, 0, 0]])  # row 2 then wrap to row 0
+    it2 = mx.io.LibSVMIter(data_libsvm=f.name, data_shape=(4,),
+                           batch_size=2, round_batch=False)
+    assert len(list(it2)) == 1
+
+
+def test_csr_is_lazy():
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+
+    csr = CSRNDArray(np.array([1.0, 2.0], np.float32),
+                     np.array([0, 2]), np.array([0, 1, 2]), (2, 1000))
+    assert csr._dense_cache is None
+    assert csr.shape == (2, 1000)  # metadata without densify
+    assert csr._dense_cache is None
+    dense = csr.tostype("default")
+    assert float(dense.asnumpy()[1, 2]) == 2.0
