@@ -16,5 +16,6 @@ from . import numpy_ops  # noqa: F401  (_npi_* NumPy-frontend ops)
 from . import la_op  # noqa: F401  (linalg_* suite)
 from . import contrib_ops  # noqa: F401  (fft/detection/roi/stn/misc)
 from . import output_ops  # noqa: F401  (regression/SVM loss heads)
+from . import pallas_ops  # noqa: F401  (flash attention TPU kernel)
 
 __all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]
