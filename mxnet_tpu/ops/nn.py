@@ -606,3 +606,46 @@ def _rnn(data, params, state, state_cell=None, state_size=0, num_layers=1,
     hn = jnp.stack(out_h, axis=0)
     cn = jnp.stack(out_c, axis=0) if mode == "lstm" else jnp.zeros_like(hn)
     return x, hn, cn
+
+
+@register("UpSampling")
+def _upsampling(data, weight=None, scale=1, sample_type="nearest",
+                num_filter=0, multi_input_mode="concat", num_args=1,
+                workspace=512):
+    """parity: src/operator/nn/upsampling.cc — nearest/bilinear 2x+
+    spatial upsampling (bilinear ignores the deconv weight and uses the
+    exact interpolation XLA provides)."""
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale),
+                            method="linear")
+
+
+@register("Crop")
+def _crop(data, like=None, offset=(0, 0), h_w=(0, 0), num_args=1,
+          center_crop=False):
+    """parity: src/operator/crop.cc — crop to `like`'s spatial size or an
+    explicit h_w, at offset (or centered)."""
+    if like is not None:
+        th, tw = like.shape[2], like.shape[3]
+    else:
+        th, tw = h_w
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register("make_loss")
+def _make_loss_op(data):
+    """parity: make_loss (tensor/elemwise_unary_op_basic.cc) — identity
+    marking a loss head."""
+    return data
+
+
+@register("relu6")
+def _relu6(data):
+    return jnp.clip(data, 0.0, 6.0)

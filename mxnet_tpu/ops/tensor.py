@@ -390,3 +390,78 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axi
     out = jnp.take_along_axis(moved, idx.reshape(idx.shape + (1,) * (moved.ndim - 2)),
                               axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+# ------------------------------------------------------- legacy tail ops ---
+
+@register("batch_take")
+def _batch_take(a, indices):
+    """parity: src/operator/tensor/indexing_op.cc batch_take — pick one
+    element per row."""
+    return a[jnp.arange(a.shape[0]), indices.astype(jnp.int32)]
+
+
+@register("diag")
+def _diag(data, k=0, axis1=0, axis2=1):
+    """parity: src/operator/tensor/diag_op.cc."""
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("split_v2", num_outputs=2)
+def _split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    """parity: matrix_op.cc split_v2 — split at explicit indices or into
+    equal sections."""
+    if sections:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("digamma")
+def _digamma(data):
+    return jax.scipy.special.digamma(data)
+
+
+@register("multi_sum_sq", num_outputs=2)
+def _multi_sum_sq(*arrays, num_arrays=1):
+    """parity: contrib/multi_sum_sq.cc — per-array sum of squares (used
+    by LANS/LAMB aggregated updates)."""
+    return tuple(jnp.sum(jnp.square(a)) for a in arrays)
+
+
+@register("unravel_index")
+def _unravel_index(data, shape=()):
+    """parity: tensor/ravel.cc — flat index -> coordinates (ndim, N)."""
+    coords = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(coords, axis=0)
+
+
+@register("ravel_multi_index")
+def _ravel_multi_index(data, shape=()):
+    """parity: tensor/ravel.cc — coordinates (ndim, N) -> flat index."""
+    return jnp.ravel_multi_index(
+        tuple(data[i].astype(jnp.int32) for i in range(data.shape[0])),
+        tuple(shape), mode="clip")
+
+
+@register("choose_element_0index")
+def _choose_element_0index(lhs, rhs):
+    """parity: legacy choose_element_0index == batch_take."""
+    return lhs[jnp.arange(lhs.shape[0]), rhs.astype(jnp.int32)]
+
+
+@register("fill_element_0index")
+def _fill_element_0index(lhs, mhs, rhs):
+    """parity: legacy fill_element_0index — set lhs[i, rhs[i]] = mhs[i]."""
+    return lhs.at[jnp.arange(lhs.shape[0]), rhs.astype(jnp.int32)].set(mhs)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(data):
+    """parity: broadcast_reduce_op_index.cc argmax_channel."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)

@@ -279,3 +279,39 @@ def test_multi_device_consistency():
 
     check_consistency(lambda a, b: mx.nd.dot(a, b), [(3, 4), (4, 5)])
     check_consistency(lambda a: a.sigmoid().sum() * 2, [(6, 6)])
+
+
+def test_legacy_tail_ops():
+    """batch_take/diag/split_v2/UpSampling/Crop/relu6/fill_element_0index/
+    unravel+ravel/multi_sum_sq/digamma (parity: indexing_op.cc,
+    diag_op.cc, matrix_op.cc split_v2, upsampling.cc, crop.cc)."""
+    a = mx.nd.array(np.arange(12, dtype="float32").reshape(3, 4))
+    assert mx.nd.batch_take(a, mx.nd.array([1, 2, 0])).asnumpy().tolist() \
+        == [1.0, 6.0, 8.0]
+    np.testing.assert_allclose(
+        mx.nd.diag(mx.nd.array([1.0, 2.0])).asnumpy(),
+        np.diag([1.0, 2.0]))
+    p = mx.nd.split_v2(a, sections=2, axis=1)
+    assert p[0].shape == (3, 2) and p[1].shape == (3, 2)
+    p2 = mx.nd.split_v2(a, indices=(1, 3), axis=1)
+    assert [x.shape[1] for x in p2] == [1, 2, 1]
+    u = mx.nd.UpSampling(mx.nd.array(np.arange(4, dtype="f").reshape(
+        1, 1, 2, 2)), scale=2)
+    assert u.shape == (1, 1, 4, 4)
+    assert u.asnumpy()[0, 0, 0, 1] == 0.0  # nearest: repeated
+    c = mx.nd.Crop(mx.nd.ones((1, 1, 8, 8)), h_w=(4, 4), center_crop=True)
+    assert c.shape == (1, 1, 4, 4)
+    assert mx.nd.relu6(mx.nd.array([-1.0, 8.0])).asnumpy().tolist() \
+        == [0.0, 6.0]
+    fl = mx.nd.fill_element_0index(
+        a.copy(), mx.nd.array([9.0, 9.0, 9.0]), mx.nd.array([0, 1, 2]))
+    assert fl.asnumpy()[1, 1] == 9
+    ui = mx.nd.unravel_index(mx.nd.array([5, 7], dtype="int32"),
+                             shape=(3, 4))
+    assert ui.asnumpy().tolist() == [[1, 1], [1, 3]]
+    ri = mx.nd.ravel_multi_index(mx.nd.array([[1, 1], [1, 3]],
+                                             dtype="int32"), shape=(3, 4))
+    assert ri.asnumpy().tolist() == [5, 7]
+    s2 = mx.nd.multi_sum_sq(mx.nd.array([3.0, 4.0]), mx.nd.array([1.0]))
+    assert float(s2[0].asscalar()) == 25.0
+    assert float(mx.nd.digamma(mx.nd.array([1.0])).asscalar()) < 0
