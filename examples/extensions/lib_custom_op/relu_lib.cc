@@ -1,0 +1,108 @@
+// Sample mxtpu extension library (parity target:
+// example/extensions/lib_custom_op/relu_lib.cc in the reference, which
+// registers a custom relu through include/mxnet/lib_api.h).
+//
+// Exports two ops through the mxtpu extension ABI documented in
+// mxnet_tpu/library.py:
+//   my_relu(x)            elementwise max(x, 0)
+//   my_gemm(a, b)         naive host matmul (M,K)x(K,N)->(M,N)
+//
+// Build:  g++ -shared -fPIC -O2 -o librelu_lib.so relu_lib.cc
+// Use:    mx.library.load("librelu_lib.so"); mx.nd.my_relu(x)
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+int64_t numel(const int64_t *shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+template <typename T>
+void relu_kernel(const T *in, T *out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = in[i] > T(0) ? in[i] : T(0);
+}
+
+template <typename T>
+void gemm_kernel(const T *a, const T *b, T *c, int64_t m, int64_t k,
+                 int64_t n) {
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t j = 0; j < n; ++j) {
+      T acc = T(0);
+      for (int64_t p = 0; p < k; ++p) acc += a[i * k + p] * b[p * n + j];
+      c[i * n + j] = acc;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_lib_version(void) { return 1; }
+
+int mxtpu_lib_num_ops(void) { return 2; }
+
+const char *mxtpu_lib_op_name(int idx) {
+  switch (idx) {
+    case 0: return "my_relu";
+    case 1: return "my_gemm";
+    default: return "";
+  }
+}
+
+int mxtpu_lib_op_infer_shape(int idx, int num_in, const int64_t **in_shapes,
+                             const int *in_ndims, int64_t *out_shape,
+                             int *out_ndim) {
+  if (idx == 0) {
+    if (num_in != 1) return 1;
+    *out_ndim = in_ndims[0];
+    for (int i = 0; i < in_ndims[0]; ++i) out_shape[i] = in_shapes[0][i];
+    return 0;
+  }
+  if (idx == 1) {
+    if (num_in != 2 || in_ndims[0] != 2 || in_ndims[1] != 2) return 1;
+    if (in_shapes[0][1] != in_shapes[1][0]) return 2;
+    *out_ndim = 2;
+    out_shape[0] = in_shapes[0][0];
+    out_shape[1] = in_shapes[1][1];
+    return 0;
+  }
+  return 3;
+}
+
+int mxtpu_lib_op_forward(int idx, int num_in, const void **in,
+                         const int64_t **in_shapes, const int *in_ndims,
+                         int dtype, void *out, const int64_t *out_shape,
+                         int out_ndim) {
+  if (idx == 0) {
+    int64_t n = numel(in_shapes[0], in_ndims[0]);
+    switch (dtype) {
+      case 0: relu_kernel(static_cast<const float *>(in[0]),
+                          static_cast<float *>(out), n); return 0;
+      case 1: relu_kernel(static_cast<const double *>(in[0]),
+                          static_cast<double *>(out), n); return 0;
+      case 2: relu_kernel(static_cast<const int32_t *>(in[0]),
+                          static_cast<int32_t *>(out), n); return 0;
+      case 3: relu_kernel(static_cast<const int64_t *>(in[0]),
+                          static_cast<int64_t *>(out), n); return 0;
+      default: return 4;
+    }
+  }
+  if (idx == 1) {
+    int64_t m = in_shapes[0][0], k = in_shapes[0][1], n = in_shapes[1][1];
+    switch (dtype) {
+      case 0: gemm_kernel(static_cast<const float *>(in[0]),
+                          static_cast<const float *>(in[1]),
+                          static_cast<float *>(out), m, k, n); return 0;
+      case 1: gemm_kernel(static_cast<const double *>(in[0]),
+                          static_cast<const double *>(in[1]),
+                          static_cast<double *>(out), m, k, n); return 0;
+      default: return 4;
+    }
+  }
+  return 3;
+}
+
+}  // extern "C"

@@ -42,7 +42,7 @@ def __getattr__(name):
                 "symbol", "sym", "module", "mod", "kvstore", "kv",
                 "profiler", "recordio", "callback", "monitor", "model",
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
-                "contrib", "util", "runtime", "onnx"):
+                "contrib", "util", "runtime", "onnx", "operator", "library"):
         import importlib
 
         try:

@@ -1,0 +1,175 @@
+"""``mx.library`` — load external operator libraries at runtime.
+
+Parity: ``python/mxnet/library.py`` (``load`` → ``MXLoadLib``) and the
+extension framework ``include/mxnet/lib_api.h`` (custom ops / passes /
+partitioners compiled into a standalone ``.so`` and registered at load
+time, demoed in ``example/extensions/lib_custom_op``).
+
+TPU-native redesign: the reference's lib_api ships a 4k-line header whose
+custom ops implement CPU/GPU kernels and get woven into the NNVM graph.
+On TPU the compute graph belongs to XLA, so an extension library exposes a
+small C ABI (below) and each exported op is registered as a JAX op whose
+body is a :func:`jax.pure_callback` into the library's kernel — the same
+mechanism the ``Custom`` python op uses, so loaded ops work eagerly, in
+``hybridize``d blocks and in Symbol graphs. Python extension files
+(``.py``) are also accepted: they are exec'd and may register ops via
+``mx.operator.register`` or ``mxnet_tpu.ops.registry.register``.
+
+Required C ABI for a ``.so`` extension (see
+``examples/extensions/lib_custom_op/`` for a complete sample)::
+
+    int         mxtpu_lib_version(void);           // must return 1
+    int         mxtpu_lib_num_ops(void);
+    const char *mxtpu_lib_op_name(int op_idx);
+    // dtype codes: 0=float32 1=float64 2=int32 3=int64
+    int mxtpu_lib_op_infer_shape(int op_idx, int num_in,
+                                 const int64_t **in_shapes,
+                                 const int *in_ndims,
+                                 int64_t *out_shape /* cap 8 */,
+                                 int *out_ndim);
+    int mxtpu_lib_op_forward(int op_idx, int num_in,
+                             const void **in, const int64_t **in_shapes,
+                             const int *in_ndims, int dtype,
+                             void *out, const int64_t *out_shape,
+                             int out_ndim);
+
+All entry points return 0 on success. Kernels run on host memory (XLA
+stages the callback around device execution); gradients are not provided —
+loaded ops register as non-differentiable, matching reference extension
+ops that omit a backward.
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import runpy
+
+import numpy as np
+
+__all__ = ["load", "loaded_libraries"]
+
+_DTYPE_CODES = {np.dtype("float32"): 0, np.dtype("float64"): 1,
+                np.dtype("int32"): 2, np.dtype("int64"): 3}
+_MAX_NDIM = 8
+
+_LOADED = {}
+
+
+def loaded_libraries():
+    """Paths of every library loaded so far this process."""
+    return list(_LOADED)
+
+
+def _shape_args(shapes_in):
+    shapes = [(ctypes.c_int64 * len(s))(*s) for s in shapes_in]
+    shape_ptrs = (ctypes.POINTER(ctypes.c_int64) * len(shapes_in))(
+        *[ctypes.cast(s, ctypes.POINTER(ctypes.c_int64)) for s in shapes])
+    ndims = (ctypes.c_int * len(shapes_in))(*[len(s) for s in shapes_in])
+    return shapes, shape_ptrs, ndims
+
+
+def _infer_shape(lib, idx, in_shapes):
+    _keep, shape_ptrs, ndims = _shape_args(in_shapes)
+    out_shape = (ctypes.c_int64 * _MAX_NDIM)()
+    out_ndim = ctypes.c_int()
+    rc = lib.mxtpu_lib_op_infer_shape(idx, len(in_shapes), shape_ptrs, ndims,
+                                      out_shape, ctypes.byref(out_ndim))
+    if rc != 0:
+        raise RuntimeError(f"extension infer_shape failed with code {rc}")
+    return tuple(out_shape[i] for i in range(out_ndim.value))
+
+
+def _host_call(lib, idx, out_shape, out_dtype, *np_in):
+    np_in = [np.ascontiguousarray(a) for a in np_in]
+    code = _DTYPE_CODES[np.dtype(out_dtype)]
+    _keep, shape_ptrs, ndims = _shape_args([a.shape for a in np_in])
+    in_ptrs = (ctypes.c_void_p * len(np_in))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in np_in])
+    out = np.empty(out_shape, out_dtype)
+    oshape = (ctypes.c_int64 * len(out_shape))(*out_shape)
+    rc = lib.mxtpu_lib_op_forward(
+        idx, len(np_in), in_ptrs, shape_ptrs, ndims, code,
+        out.ctypes.data_as(ctypes.c_void_p), oshape, len(out_shape))
+    if rc != 0:
+        raise RuntimeError(f"extension op forward failed with code {rc}")
+    return out
+
+
+def _register_lib_op(lib, idx, name, verbose):
+    import jax
+
+    from .ops import registry
+
+    def ext_op(*arrays, **kwargs):
+        if kwargs:
+            raise TypeError(f"extension op {name!r} takes no keyword args")
+        dt = np.dtype(arrays[0].dtype)
+        if dt not in _DTYPE_CODES:
+            raise TypeError(f"extension op {name!r}: unsupported dtype {dt}")
+        out_shape = _infer_shape(lib, idx, [tuple(a.shape) for a in arrays])
+        return jax.pure_callback(
+            functools.partial(_host_call, lib, idx, out_shape, dt),
+            jax.ShapeDtypeStruct(out_shape, dt), *arrays)
+
+    ext_op.__name__ = name
+    ext_op.__doc__ = f"extension op {name!r} loaded via mx.library.load"
+    registry.register(name, differentiable=False, eager=True)(ext_op)
+    _expose_ops([name])
+    if verbose:
+        import logging
+
+        logging.getLogger("mxnet_tpu").info("loaded extension op %s", name)
+
+
+def _expose_ops(names):
+    """Add mx.nd.<name> / mx.sym.<name> wrappers for ops registered after
+    import time (the import-time wrapper loops have already run)."""
+    import sys
+
+    for mod_name in ("mxnet_tpu.ndarray", "mxnet_tpu.symbol"):
+        mod = sys.modules.get(mod_name)
+        if mod is None:
+            continue
+        for name in names:
+            if not hasattr(mod, name):
+                setattr(mod, name, mod._make_wrapper(name))
+
+
+def load(path, verbose=True):
+    """Load an extension library (parity: python/mxnet/library.py:32
+    ``load`` → ``MXLoadLib`` → ``c_api.cc:1536``).
+
+    ``path`` may be a compiled ``.so`` implementing the mxtpu extension ABI
+    (ops are registered under their exported names) or a ``.py`` file that
+    registers ops itself when executed."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise ValueError(f"library {path!r} does not exist")
+    if path in _LOADED:
+        return _LOADED[path]
+    if path.endswith(".py"):
+        from .ops import registry
+
+        before = set(registry.list_ops())
+        ns = runpy.run_path(path)
+        _expose_ops(sorted(set(registry.list_ops()) - before))
+        _LOADED[path] = ns
+        return ns
+    lib = ctypes.CDLL(path)
+    for sym in ("mxtpu_lib_version", "mxtpu_lib_num_ops", "mxtpu_lib_op_name",
+                "mxtpu_lib_op_infer_shape", "mxtpu_lib_op_forward"):
+        if not hasattr(lib, sym):
+            raise ValueError(
+                f"{path!r} is not an mxtpu extension library (missing {sym})")
+    lib.mxtpu_lib_op_name.restype = ctypes.c_char_p
+    version = lib.mxtpu_lib_version()
+    if version != 1:
+        raise ValueError(f"extension ABI version {version} unsupported")
+    names = []
+    for idx in range(lib.mxtpu_lib_num_ops()):
+        name = lib.mxtpu_lib_op_name(idx).decode()
+        _register_lib_op(lib, idx, name, verbose)
+        names.append(name)
+    _LOADED[path] = {"handle": lib, "ops": names}
+    return _LOADED[path]

@@ -1,0 +1,129 @@
+"""``mx.operator`` — user-defined operators in Python.
+
+Parity: ``python/mxnet/operator.py`` (CustomOp :523, CustomOpProp :674,
+register :756) and its C++ host ``src/operator/custom/custom.cc``. The
+reference trampolines NDArray pointers through ctypes callbacks executed on
+a custom-op thread pool; here the registered prop drives a
+``jax.pure_callback``-based op (see :mod:`mxnet_tpu.ops.custom`), so custom
+Python ops compose with eager mode, ``autograd.record``, ``hybridize`` and
+the symbolic executor alike.
+
+Usage (identical to the reference)::
+
+    class Sigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            y = 1.0 / (1.0 + mx.nd.exp(-in_data[0]))
+            self.assign(out_data[0], req[0], y)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid()
+
+    out = mx.nd.Custom(x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+from .ops.custom import CUSTOM_PROPS
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+
+class CustomOp:
+    """Base class for custom imperative operators
+    (parity: python/mxnet/operator.py:523)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute ``out_data`` from ``in_data`` (NDArrays)."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute ``in_grad`` from ``out_grad`` (NDArrays)."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write request
+        (parity: operator.py:545 — 'null' | 'write' | 'inplace' | 'add')."""
+        if req == "null":
+            return
+        if req == "add":
+            dst[:] = dst + src
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Declares a custom op's signature: arguments, outputs, shape/type
+    inference, and the operator factory
+    (parity: python/mxnet/operator.py:674)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    # ------------------------------------------------------- signature ---
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    # ------------------------------------------------------- inference ---
+    def infer_shape(self, in_shape):
+        """Default (parity: operator.py:687): every output takes the shape
+        of the first input; aux states are empty."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, stype_vector):
+        return (stype_vector, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    # ----------------------------------------------------- grad wiring ---
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Kept for API parity; the XLA program retains exactly the buffers
+        the backward callback reads, so no manual dependency pruning is
+        needed (the reference uses this to shrink the saved set)."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    @property
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def register(reg_name):
+    """Decorator registering a :class:`CustomOpProp` subclass under
+    ``op_type=reg_name`` (parity: python/mxnet/operator.py:756)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered_operators():
+    """Names of every registered custom op type."""
+    return list(CUSTOM_PROPS)

@@ -17,5 +17,6 @@ from . import la_op  # noqa: F401  (linalg_* suite)
 from . import contrib_ops  # noqa: F401  (fft/detection/roi/stn/misc)
 from . import output_ops  # noqa: F401  (regression/SVM loss heads)
 from . import pallas_ops  # noqa: F401  (flash attention TPU kernel)
+from . import custom  # noqa: F401  (Custom op — user-defined Python operators)
 
 __all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]

@@ -1,0 +1,187 @@
+"""The ``Custom`` op — host for user-defined Python operators.
+
+Parity: ``src/operator/custom/custom.cc`` (the C++ side that trampolines
+into ``python/mxnet/operator.py`` callbacks via ctypes). The user API lives
+in :mod:`mxnet_tpu.operator` (CustomOp / CustomOpProp / register); this
+module owns the prop registry and the single registered op ``Custom`` that
+``mx.nd.Custom(..., op_type=name)`` / ``mx.sym.Custom`` dispatch to.
+
+TPU-native redesign: the reference runs custom-op callbacks on a dedicated
+``CustomOperator`` worker thread pool inside the engine
+(``src/operator/custom/custom-inl.h``). Here the op is a pure JAX function
+whose body is a :func:`jax.pure_callback` — XLA stages a host callback into
+the compiled program, so the same definition works eagerly, on the autograd
+tape (via ``jax.custom_vjp`` calling the user's ``backward``), and inside
+``hybridize``/Symbol executables. Shapes/dtypes come from the prop's
+``infer_shape``/``infer_type`` exactly as the reference queries them
+(``custom.cc:InferShape/InferType``).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .registry import register
+
+# op_type -> CustomOpProp subclass (filled by mxnet_tpu.operator.register)
+CUSTOM_PROPS = {}
+
+
+def _make_prop(op_type, kwargs):
+    try:
+        cls = CUSTOM_PROPS[op_type]
+    except KeyError:
+        raise ValueError(
+            f"custom op type {op_type!r} is not registered; decorate your "
+            "CustomOpProp subclass with mx.operator.register("
+            f"{op_type!r})") from None
+    prop = cls(**kwargs)
+    prop._kwargs = dict(kwargs)
+    return prop
+
+
+def _host_ndarrays(np_arrays):
+    """numpy -> NDArray (cpu) without touching the autograd tape."""
+    from .. import autograd
+    from ..ndarray import NDArray
+    import jax.numpy as jnp
+
+    with autograd.pause():
+        return [NDArray(jnp.asarray(np.asarray(a))) for a in np_arrays]
+
+
+def _host_forward(op, out_shapes, out_types, n_data, n_out, is_train,
+                  *np_arrays):
+    """Host side of the forward callback: allocate outputs, run the user's
+    ``CustomOp.forward``, hand the buffers back to XLA. Shapes/dtypes and
+    the operator instance were resolved once at trace time (the reference
+    likewise caches the created operator, custom-inl.h)."""
+    from .. import autograd
+
+    with autograd.pause():
+        arrays = _host_ndarrays(np_arrays)
+        in_data, aux = arrays[:n_data], arrays[n_data:]
+        from ..ndarray import zeros
+
+        out_data = [zeros(tuple(s), dtype=t)
+                    for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_data, out_data=out_data, aux=aux)
+        return tuple(np.asarray(o._data) for o in out_data)
+
+
+def _host_backward(op, n_data, n_out, *np_arrays):
+    """Host side of the backward callback.
+
+    ``np_arrays`` = in_data+aux, out_data, out_grad (concatenated). Returns
+    cotangents for every primal input (aux states get zeros, as in the
+    reference where aux carries no gradient)."""
+    from .. import autograd
+
+    with autograd.pause():
+        n_in_total = len(np_arrays) - 2 * n_out
+        arrays = _host_ndarrays(np_arrays)
+        ins, outs, cots = (arrays[:n_in_total],
+                           arrays[n_in_total:n_in_total + n_out],
+                           arrays[n_in_total + n_out:])
+        in_data, aux = ins[:n_data], ins[n_data:]
+        from ..ndarray import zeros
+
+        in_grad = [zeros(a.shape, dtype=a.dtype) for a in in_data]
+        op.backward(req=["write"] * n_data, out_grad=cots, in_data=in_data,
+                    out_data=outs, in_grad=in_grad, aux=aux)
+        zero_aux = [np.zeros(a.shape, dtype=a.dtype) for a in aux]
+        return tuple([np.asarray(g._data) for g in in_grad] + zero_aux)
+
+
+def _custom_num_outputs(n_inputs, static_kwargs):
+    kwargs = {k: v for k, v in static_kwargs.items() if k != "op_type"}
+    return len(_make_prop(static_kwargs["op_type"], kwargs).list_outputs())
+
+
+def _custom_input_names(static_kwargs):
+    kwargs = {k: v for k, v in static_kwargs.items() if k != "op_type"}
+    prop = _make_prop(static_kwargs["op_type"], kwargs)
+    return list(prop.list_arguments()) + list(prop.list_auxiliary_states())
+
+
+def _freeze(v):
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple, set)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+# (op_type, kwargs, in_avals, is_train) -> compiled custom_vjp runner.
+# The reference likewise creates the operator once per node and caches it
+# (custom-inl.h CustomOperator); here the cache also skips re-running the
+# prop's infer_shape/infer_type per invocation.
+_RUNNER_CACHE = {}
+
+
+@register("Custom", eager=True, num_outputs=_custom_num_outputs,
+          input_names=_custom_input_names)
+def custom(*arrays, op_type, **kwargs):
+    """parity: src/operator/custom/custom.cc — inputs are the op's declared
+    arguments followed by its auxiliary states; every kwarg is forwarded to
+    the registered CustomOpProp constructor. ``is_train`` mirrors the
+    reference's train_mode (autograd.is_training()), which is also the
+    CachedOp executable-cache key, so traced executables never bake a stale
+    mode."""
+    from .. import autograd
+
+    is_train = bool(autograd.is_training())
+    sig = (op_type, _freeze(kwargs),
+           tuple((tuple(a.shape), str(np.dtype(a.dtype))) for a in arrays),
+           is_train)
+    try:
+        run, n_out = _RUNNER_CACHE[sig]
+    except (KeyError, TypeError):
+        run, n_out = _build_runner(op_type, kwargs, arrays, is_train)
+        try:
+            _RUNNER_CACHE[sig] = (run, n_out)
+        except TypeError:
+            pass  # unhashable kwarg — skip caching
+    outs = run(*arrays)
+    return outs if n_out > 1 else outs[0]
+
+
+def _build_runner(op_type, kwargs, arrays, is_train):
+    import jax
+
+    prop = _make_prop(op_type, kwargs)
+    n_data = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+
+    in_shapes = [tuple(a.shape) for a in arrays[:n_data]]
+    in_types = [np.dtype(a.dtype) for a in arrays[:n_data]]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    tt = prop.infer_type(in_types)
+    out_types = [np.dtype(t) for t in tt[1]]
+    out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                      for s, t in zip(out_shapes, out_types))
+    in_avals = tuple(jax.ShapeDtypeStruct(tuple(a.shape), np.dtype(a.dtype))
+                     for a in arrays)
+    op_inst = prop.create_operator(None, in_shapes, in_types)
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(
+            functools.partial(_host_forward, op_inst, out_shapes, out_types,
+                              n_data, n_out, is_train),
+            out_avals, *xs)
+
+    def run_fwd(*xs):
+        ys = run(*xs)
+        return ys, (xs, ys)
+
+    def run_bwd(res, cts):
+        xs, ys = res
+        return jax.pure_callback(
+            functools.partial(_host_backward, op_inst, n_data, n_out),
+            in_avals, *(tuple(xs) + tuple(ys) + tuple(cts)))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run, n_out

@@ -609,17 +609,34 @@ def _rnn(data, params, state, state_cell=None, state_size=0, num_layers=1,
 
 
 @register("UpSampling")
-def _upsampling(data, weight=None, scale=1, sample_type="nearest",
-                num_filter=0, multi_input_mode="concat", num_args=1,
-                workspace=512):
-    """parity: src/operator/nn/upsampling.cc — nearest/bilinear 2x+
-    spatial upsampling (bilinear ignores the deconv weight and uses the
-    exact interpolation XLA provides)."""
-    n, c, h, w = data.shape
-    if sample_type == "nearest":
-        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
-    return jax.image.resize(data, (n, c, h * scale, w * scale),
-                            method="linear")
+def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
+                multi_input_mode="concat", num_args=1, workspace=512):
+    """parity: src/operator/nn/upsampling.cc — nearest/bilinear spatial
+    upsampling. Nearest mode accepts MULTIPLE data inputs: each is scaled
+    up to the first input's upsampled spatial size (its own factor =
+    out_size / in_size), then channel-concatenated ('concat') or summed
+    ('sum'). Bilinear mode takes (data, weight) and ignores the deconv
+    weight — XLA's exact interpolation replaces the learned-kernel trick."""
+    if sample_type != "nearest":
+        data = args[0]
+        n, c, h, w = data.shape
+        return jax.image.resize(data, (n, c, h * scale, w * scale),
+                                method="linear")
+    out_h, out_w = args[0].shape[2] * scale, args[0].shape[3] * scale
+    ups = []
+    for i, d in enumerate(args):
+        if out_h % d.shape[2] or out_w % d.shape[3]:
+            raise ValueError(
+                f"UpSampling: input {i} spatial {d.shape[2:]} does not "
+                f"divide the target size ({out_h}, {out_w}) (= first input "
+                f"* scale); the reference requires integer per-input scales")
+        fh, fw = out_h // d.shape[2], out_w // d.shape[3]
+        ups.append(jnp.repeat(jnp.repeat(d, fh, axis=2), fw, axis=3))
+    if len(ups) == 1:
+        return ups[0]
+    if multi_input_mode == "sum":
+        return sum(ups[1:], ups[0])
+    return jnp.concatenate(ups, axis=1)
 
 
 @register("Crop")

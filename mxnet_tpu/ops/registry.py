@@ -53,19 +53,27 @@ class Operator:
     fn : the pure function. All array arguments positional; every keyword
          argument is *static* (baked into the compiled executable) — the
          analogue of dmlc::Parameter op hyper-parameters.
-    num_outputs : number of outputs (or None = single array).
+    num_outputs : number of outputs (or None = single array). May be a
+         callable ``(n_inputs, static_kwargs) -> int`` for ops whose output
+         count depends on their hyper-parameters (split/SliceChannel,
+         split_v2, Custom) — the symbol layer resolves it per node.
     differentiable : set False for ops with no gradient (e.g. argmax);
          the tape records them as constants.
     """
 
     def __init__(self, name: str, fn: Callable, num_outputs: Optional[int] = None,
-                 differentiable: bool = True, aliases=(), eager: bool = False):
+                 differentiable: bool = True, aliases=(), eager: bool = False,
+                 input_names: Optional[Callable] = None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.differentiable = differentiable
         self.aliases = tuple(aliases)
         self.eager = eager  # dynamic-output-shape ops cannot be jitted
+        # optional (static_kwargs) -> [input names] for *arrays ops whose
+        # input list depends on hyper-parameters (Custom); lets the symbol
+        # layer accept keyword Symbol inputs by declared name
+        self.input_names = input_names
         self._jit_cache: Dict = {}
 
     def bound(self, kwargs: dict) -> Callable:
@@ -100,13 +108,13 @@ class Operator:
 
 
 def register(name: str, num_outputs: Optional[int] = None, differentiable: bool = True,
-             aliases=(), eager: bool = False):
+             aliases=(), eager: bool = False, input_names: Optional[Callable] = None):
     """Decorator: register a pure JAX function as a named op."""
 
     def deco(fn: Callable) -> Operator:
         op = Operator(name, fn, num_outputs=num_outputs,
                       differentiable=differentiable, aliases=aliases,
-                      eager=eager)
+                      eager=eager, input_names=input_names)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op

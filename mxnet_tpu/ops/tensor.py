@@ -273,7 +273,8 @@ def _split_impl(x, num_outputs=1, axis=1, squeeze_axis=False):
     return tuple(parts) if num_outputs > 1 else parts[0]
 
 
-register("SliceChannel", aliases=("split", "slice_channel"))(_split_impl)
+register("SliceChannel", aliases=("split", "slice_channel"),
+         num_outputs=lambda n_in, kw: int(kw.get("num_outputs", 1)))(_split_impl)
 
 
 @register("slice")
@@ -409,14 +410,26 @@ def _diag(data, k=0, axis1=0, axis2=1):
     return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
 
 
-@register("split_v2", num_outputs=2)
+def _split_v2_indices(indices):
+    """The reference python wrapper stores ``[0] + indices`` in the op attr
+    (ndarray.py split_v2); accept both that convention (reference-produced
+    symbol.json) and bare user indices."""
+    idx = list(indices)
+    if idx and idx[0] == 0:
+        idx = idx[1:]
+    return idx
+
+
+@register("split_v2", num_outputs=lambda n_in, kw:
+          int(kw["sections"]) if kw.get("sections")
+          else len(_split_v2_indices(kw.get("indices", ()))) + 1)
 def _split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
     """parity: matrix_op.cc split_v2 — split at explicit indices or into
     equal sections."""
     if sections:
         parts = jnp.split(data, sections, axis=axis)
     else:
-        parts = jnp.split(data, list(indices), axis=axis)
+        parts = jnp.split(data, _split_v2_indices(indices), axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]
     return tuple(parts)
@@ -427,11 +440,11 @@ def _digamma(data):
     return jax.scipy.special.digamma(data)
 
 
-@register("multi_sum_sq", num_outputs=2)
+@register("multi_sum_sq")
 def _multi_sum_sq(*arrays, num_arrays=1):
-    """parity: contrib/multi_sum_sq.cc — per-array sum of squares (used
-    by LANS/LAMB aggregated updates)."""
-    return tuple(jnp.sum(jnp.square(a)) for a in arrays)
+    """parity: contrib/multi_sum_sq.cc — ONE output vector holding each
+    array's sum of squares (used by LANS/LAMB aggregated updates)."""
+    return jnp.stack([jnp.sum(jnp.square(a)) for a in arrays])
 
 
 @register("unravel_index")

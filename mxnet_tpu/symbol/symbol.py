@@ -742,6 +742,15 @@ def _binary(lhs, rhs, elemwise_op, scalar_op, reverse=False):
     return _apply_op(scalar_op, [lhs], {"scalar": float(rhs)})
 
 
+def _resolve_num_outputs(op, n_inputs, attrs):
+    """Node output count: static int, or resolved from the node's
+    hyper-parameters for dynamic-output ops (split/split_v2/Custom)."""
+    n = op.num_outputs
+    if callable(n):
+        n = n(n_inputs, attrs)
+    return n or 1
+
+
 def _apply_op(op_name, args, kwargs):
     """Build an op node from Symbol args + static kwargs (the compose
     primitive behind every `mx.sym.<op>` wrapper)."""
@@ -765,6 +774,27 @@ def _apply_op(op_name, args, kwargs):
     inputs = []  # (sig_param_name, Symbol-or-None)
     pos_iter = iter(pos_syms)
     for p in sig:
+        if p.kind is inspect.Parameter.VAR_KEYWORD:
+            continue  # **kwargs catch-all (e.g. Custom) — statics, not inputs
+        if p.kind is inspect.Parameter.VAR_POSITIONAL:
+            # *arrays slot (concat/add_n/Custom/...): consume EVERY remaining
+            # positional symbol here so none spill into scalar-param slots
+            for nxt in pos_iter:
+                inputs.append((p.name, nxt))
+            # keyword Symbol inputs land here too (mx.sym.Custom(data=x)):
+            # ordered by the op's declared input names when it declares
+            # them, else by keyword order
+            if sym_kwargs:
+                order = None
+                if op.input_names is not None:
+                    try:
+                        order = [n for n in op.input_names(static)
+                                 if n in sym_kwargs]
+                    except Exception:
+                        order = None
+                for k in (order if order is not None else list(sym_kwargs)):
+                    inputs.append((p.name, sym_kwargs.pop(k)))
+            continue
         if p.name in _RUNTIME_PARAMS or p.name in static:
             continue
         if p.name in sym_kwargs:
@@ -792,7 +822,7 @@ def _apply_op(op_name, args, kwargs):
     node = _Node(op.name, name, static,
                  [(s._entries[0][0], s._entries[0][1])
                   for _, s in inputs if s is not None],
-                 num_outputs=op.num_outputs or 1)
+                 num_outputs=_resolve_num_outputs(op, len(inputs), static))
     return Symbol([(node, i) for i in range(node.num_outputs)]) \
         if node.num_outputs > 1 else Symbol([(node, 0)])
 
@@ -856,11 +886,13 @@ def load_json(json_str):
             node = _Node(None, rn["name"], attrs)
         else:
             op = _registry.get(op_name)
-            node = _Node(op.name, rn["name"], attrs,
-                         num_outputs=op.num_outputs or 1)
+            node = _Node(op.name, rn["name"], attrs)
         built.append(node)
     for rn, node in zip(raw_nodes, built):
         node.inputs = [(built[i], oi) for i, oi, *_ in rn["inputs"]]
+        if node.op is not None:
+            node.num_outputs = _resolve_num_outputs(
+                _registry.get(node.op), len(node.inputs), node.attrs)
     _mark_aux(built)
     heads = data.get("heads")
     if heads:

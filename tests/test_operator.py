@@ -313,5 +313,11 @@ def test_legacy_tail_ops():
                                              dtype="int32"), shape=(3, 4))
     assert ri.asnumpy().tolist() == [5, 7]
     s2 = mx.nd.multi_sum_sq(mx.nd.array([3.0, 4.0]), mx.nd.array([1.0]))
-    assert float(s2[0].asscalar()) == 25.0
+    assert s2.shape == (2,)  # ONE output vector (contrib/multi_sum_sq.cc)
+    assert s2.asnumpy().tolist() == [25.0, 1.0]
+    # multi-input nearest upsampling: inputs scaled to a common size, then
+    # channel-concatenated (upsampling.cc multi_input_mode='concat')
+    um = mx.nd.UpSampling(mx.nd.ones((1, 1, 2, 2)), mx.nd.ones((1, 2, 4, 4)),
+                          scale=2, num_args=2)
+    assert um.shape == (1, 3, 4, 4)
     assert float(mx.nd.digamma(mx.nd.array([1.0])).asscalar()) < 0
