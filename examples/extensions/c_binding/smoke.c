@@ -1,0 +1,113 @@
+/* Minimal C host driving the framework through libmxtpu — the "other
+ * language binding" demo (parity model: the reference's C-ABI consumers,
+ * e.g. cpp-package / c_predict_api users).
+ *
+ * Build (see tests/test_c_api.py for the exact commands):
+ *   g++ ... mxtpu_c_api.cc -o libmxtpu.so
+ *   gcc smoke.c -I include -L . -lmxtpu -Wl,-rpath,. -o smoke
+ * Run with PYTHONPATH pointing at the repo and MXTPU_PLATFORM=cpu.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(call)                                              \
+  do {                                                           \
+    if ((call) != 0) {                                           \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError()); \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+int main(void) {
+  int version = 0;
+  CHECK(MXGetVersion(&version));
+  printf("version=%d\n", version);
+
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreate(shape, 2, MXTPU_DTYPE_FLOAT32, &a));
+  CHECK(MXNDArrayCreate(shape, 2, MXTPU_DTYPE_FLOAT32, &b));
+
+  float av[6] = {1, 2, 3, 4, 5, 6};
+  float bv[6] = {10, 20, 30, 40, 50, 60};
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, sizeof(av)));
+  CHECK(MXNDArraySyncCopyFromCPU(b, bv, sizeof(bv)));
+
+  /* c = broadcast_add(a, b) */
+  NDArrayHandle inputs[2] = {a, b};
+  int num_out = 0;
+  NDArrayHandle *outputs = NULL;
+  CHECK(MXImperativeInvoke("broadcast_add", 2, inputs, &num_out, &outputs, 0,
+                           NULL, NULL));
+  if (num_out != 1) {
+    fprintf(stderr, "FAIL expected 1 output, got %d\n", num_out);
+    return 1;
+  }
+  float cv[6];
+  CHECK(MXNDArraySyncCopyToCPU(outputs[0], cv, sizeof(cv)));
+  for (int i = 0; i < 6; ++i) {
+    if (cv[i] != av[i] + bv[i]) {
+      fprintf(stderr, "FAIL add mismatch at %d: %f\n", i, cv[i]);
+      return 1;
+    }
+  }
+
+  /* string hyper-parameter: reshape to (3, 2) */
+  const char *keys[1] = {"shape"};
+  const char *vals[1] = {"(3, 2)"};
+  int num_out2 = 0;
+  NDArrayHandle *outputs2 = NULL;
+  CHECK(MXImperativeInvoke("reshape", 1, &outputs[0], &num_out2, &outputs2, 1,
+                           keys, vals));
+  int ndim = 0;
+  const int64_t *rshape = NULL;
+  CHECK(MXNDArrayGetShape(outputs2[0], &ndim, &rshape));
+  if (ndim != 2 || rshape[0] != 3 || rshape[1] != 2) {
+    fprintf(stderr, "FAIL reshape shape\n");
+    return 1;
+  }
+
+  /* split: multiple outputs */
+  const char *skeys[2] = {"num_outputs", "axis"};
+  const char *svals[2] = {"3", "1"};
+  int num_out3 = 0;
+  NDArrayHandle *outputs3 = NULL;
+  CHECK(MXImperativeInvoke("SliceChannel", 1, &a, &num_out3, &outputs3, 2,
+                           skeys, svals));
+  if (num_out3 != 3) {
+    fprintf(stderr, "FAIL split outputs=%d\n", num_out3);
+    return 1;
+  }
+
+  /* error path: bogus op must fail and set the error string */
+  NDArrayHandle *outputs4 = NULL;
+  int num_out4 = 0;
+  if (MXImperativeInvoke("definitely_not_an_op", 1, &a, &num_out4, &outputs4,
+                         0, NULL, NULL) == 0 ||
+      strlen(MXGetLastError()) == 0) {
+    fprintf(stderr, "FAIL error path\n");
+    return 1;
+  }
+
+  /* op registry is visible through the ABI */
+  int op_count = 0;
+  const char **op_names = NULL;
+  CHECK(MXListAllOpNames(&op_count, &op_names));
+  printf("ops=%d\n", op_count);
+
+  CHECK(MXNDArrayWaitAll());
+
+  for (int i = 0; i < num_out3; ++i) MXNDArrayFree(outputs3[i]);
+  MXHandleArrayFree(outputs3);
+  MXNDArrayFree(outputs2[0]);
+  MXHandleArrayFree(outputs2);
+  MXNDArrayFree(outputs[0]);
+  MXHandleArrayFree(outputs);
+  MXNDArrayFree(a);
+  MXNDArrayFree(b);
+  printf("C API OK\n");
+  return 0;
+}

@@ -1,0 +1,83 @@
+/*
+ * mxtpu C ABI — the language-binding surface of the TPU-native framework.
+ *
+ * Parity target: include/mxnet/c_api.h in the reference (MX* functions,
+ * int status returns, thread-local error string via MXGetLastError). The
+ * reference's C ABI fronts a C++ runtime; here it fronts the embedded
+ * Python/JAX runtime (the compute path is XLA), so a handle is an owned
+ * reference to a framework NDArray and every call is GIL-safe — callable
+ * from any thread of a C/C++/Rust/Java host.
+ *
+ * Conventions (same as the reference):
+ *   - every function returns 0 on success, -1 on failure
+ *   - on failure, MXGetLastError() returns a thread-local message
+ *   - hyper-parameters are passed as string key/value pairs; values are
+ *     parsed as Python literals ("2", "(1, 2)", "float32")
+ *
+ * Link with -lmxtpu (built from mxnet_tpu/native/mxtpu_c_api.cc; the
+ * library embeds the Python interpreter on first use — set PYTHONPATH so
+ * `import mxnet_tpu` resolves, and optionally MXTPU_PLATFORM=cpu|tpu).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+
+/* dtype codes (parity: mshadow type codes used across the reference ABI) */
+#define MXTPU_DTYPE_FLOAT32 0
+#define MXTPU_DTYPE_FLOAT64 1
+#define MXTPU_DTYPE_FLOAT16 2
+#define MXTPU_DTYPE_UINT8 3
+#define MXTPU_DTYPE_INT32 4
+#define MXTPU_DTYPE_INT8 5
+#define MXTPU_DTYPE_INT64 6
+#define MXTPU_DTYPE_BFLOAT16 7
+
+/* runtime ------------------------------------------------------------- */
+int MXGetVersion(int *out);
+const char *MXGetLastError(void);
+/* Drain pending work before host teardown. The embedded interpreter stays
+ * alive for the process lifetime (finalizing the JAX runtime mid-process
+ * is unsafe); parity: MXNotifyShutdown is likewise a sync/detach
+ * notification in the reference, not a teardown. */
+int MXNotifyShutdown(void);
+
+/* ndarray ------------------------------------------------------------- */
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
+                    NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+/* out_pdata points at thread-local storage valid until the next
+ * MXNDArrayGetShape call on this thread */
+int MXNDArrayGetShape(NDArrayHandle handle, int *out_ndim,
+                      const int64_t **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArraySize(NDArrayHandle handle, int64_t *out_size);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t nbytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t nbytes);
+int MXNDArrayWaitAll(void);
+
+/* operators ----------------------------------------------------------- */
+/* names array is owned by the library; do not free */
+int MXListAllOpNames(int *out_size, const char ***out_array);
+/* Invoke a registered op. Outputs are returned in a malloc'd handle array
+ * the caller releases with MXHandleArrayFree (each handle additionally
+ * needs MXNDArrayFree). */
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+int MXHandleArrayFree(NDArrayHandle *handles);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

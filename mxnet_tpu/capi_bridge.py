@@ -1,0 +1,98 @@
+"""Python side of the C ABI (include/mxtpu/c_api.h).
+
+The native ``libmxtpu.so`` embeds CPython and calls the functions here;
+keeping the logic in Python keeps the C++ layer to reference-style
+handle/GIL/error plumbing (parity model: ``src/c_api/c_api.cc`` fronting
+the C++ runtime — here the runtime IS the Python/JAX framework).
+
+Honors ``MXTPU_PLATFORM`` (cpu|tpu) so embedded hosts can pin the JAX
+backend before first use.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+if os.environ.get("MXTPU_PLATFORM"):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", os.environ["MXTPU_PLATFORM"])
+    except Exception:  # backend already initialised — keep its platform
+        pass
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+
+__version_int__ = 10000  # 1.00.00, parity with MXGetVersion conventions
+
+# mshadow-style dtype codes (include/mxtpu/c_api.h)
+_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+           4: "int32", 5: "int8", 6: "int64", 7: "bfloat16"}
+_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+def version():
+    return __version_int__
+
+
+def create(shape, dtype_code):
+    return mx.nd.zeros(tuple(int(s) for s in shape),
+                       dtype=_DTYPES[int(dtype_code)])
+
+
+def shape(nd):
+    return tuple(int(s) for s in nd.shape)
+
+
+def dtype_code(nd):
+    return _CODES[str(np.dtype(nd.dtype))]
+
+
+def size(nd):
+    return int(np.prod(nd.shape, dtype=np.int64)) if nd.shape else 1
+
+
+def copy_from_bytes(nd, buf):
+    if str(nd.dtype) == "bfloat16":
+        import jax.numpy as jnp
+
+        arr = np.frombuffer(buf, dtype=np.uint16)
+        nd._rebind(jnp.asarray(arr).view(jnp.bfloat16).reshape(nd.shape))
+        return
+    arr = np.frombuffer(buf, dtype=np.dtype(str(nd.dtype)))
+    nd[:] = mx.nd.array(arr.reshape(nd.shape), dtype=str(nd.dtype))
+
+
+def to_bytes(nd):
+    if str(nd.dtype) == "bfloat16":
+        import jax.numpy as jnp
+
+        return bytes(np.asarray(nd._data.view(jnp.uint16)))
+    return np.ascontiguousarray(nd.asnumpy()).tobytes()
+
+
+def _parse(value):
+    try:
+        return ast.literal_eval(value)
+    except (ValueError, SyntaxError):
+        return value  # plain string (e.g. dtype="float32", mode="lstm")
+
+
+def invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvoke body: string hyper-parameters are parsed as
+    Python literals, exactly how the reference parses dmlc::Parameter
+    strings on its C boundary."""
+    kwargs = {k: _parse(v) for k, v in zip(keys, vals)}
+    out = mx.nd.invoke(op_name, *inputs, **kwargs)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def list_ops():
+    return sorted(set(registry.list_ops()))
+
+
+def waitall():
+    mx.nd.waitall()

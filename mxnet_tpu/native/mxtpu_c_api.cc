@@ -1,0 +1,330 @@
+// libmxtpu — the C ABI of the TPU-native framework.
+//
+// Parity target: src/c_api/c_api.cc in the reference (MX* entry points,
+// int status returns, thread-local error buffer). The reference's C layer
+// fronts a C++ runtime; this one embeds CPython and trampolines into
+// mxnet_tpu.capi_bridge, because the framework's runtime is the Python/JAX
+// stack and XLA owns the device code. Every entry point is GIL-safe so the
+// library can be driven from any host thread.
+//
+// Build:
+//   g++ -O2 -shared -fPIC -std=c++17 mxtpu_c_api.cc -o libmxtpu.so \
+//       $(python3-config --includes) $(python3-config --ldflags --embed)
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef void *NDArrayHandle;
+}
+
+namespace {
+
+thread_local std::string tls_error;
+thread_local std::vector<int64_t> tls_shape;
+
+std::once_flag g_init_flag;
+PyObject *g_bridge = nullptr;      // mxnet_tpu.capi_bridge module
+bool g_we_initialized = false;     // we own the interpreter lifecycle
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tls_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) tls_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// One-time interpreter + bridge import. Returns 0 on success.
+int ensure_init() {
+  std::call_once(g_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+    }
+    PyGILState_STATE gil = PyGILState_Ensure();
+    g_bridge = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+    if (g_bridge == nullptr) set_error_from_python();
+    PyGILState_Release(gil);
+    if (g_we_initialized) {
+      // release the GIL acquired by Py_Initialize so other threads (and
+      // later PyGILState_Ensure calls on this one) can take it
+      PyThreadState *ts = PyGILState_GetThisThreadState();
+      if (ts != nullptr && PyGILState_Check()) PyEval_SaveThread();
+    }
+  });
+  if (g_bridge == nullptr) {
+    if (tls_error.empty()) tls_error = "mxnet_tpu.capi_bridge import failed";
+    return -1;
+  }
+  return 0;
+}
+
+// Call bridge.<fn>(*args) with the GIL held; returns new reference or
+// nullptr (error already recorded).
+PyObject *bridge_call(const char *fn, PyObject *args) {
+  PyObject *callable = PyObject_GetAttrString(g_bridge, fn);
+  if (callable == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *result = PyObject_CallObject(callable, args);
+  Py_DECREF(callable);
+  Py_XDECREF(args);
+  if (result == nullptr) set_error_from_python();
+  return result;
+}
+
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return tls_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *r = bridge_call("version", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown(void) {
+  // The embedded interpreter stays alive for the process (finalizing JAX
+  // runtimes mid-process is unsafe); parity: MXNotifyShutdown is likewise
+  // a sync-and-detach notification, not a teardown.
+  if (g_bridge == nullptr) return 0;
+  GilGuard gil;
+  PyObject *r = bridge_call("waitall", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
+                    NDArrayHandle *out) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject *args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, shp);
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dtype));
+  PyObject *r = bridge_call("create", args);
+  if (r == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(r);  // owned reference
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, int *out_ndim,
+                      const int64_t **out_pdata) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyObject *r = bridge_call("shape", args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  tls_shape.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls_shape[static_cast<size_t>(i)] =
+        PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  Py_DECREF(r);
+  *out_ndim = static_cast<int>(n);
+  *out_pdata = tls_shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyObject *r = bridge_call("dtype_code", args);
+  if (r == nullptr) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySize(NDArrayHandle handle, int64_t *out_size) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyObject *r = bridge_call("size", args);
+  if (r == nullptr) return -1;
+  *out_size = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t nbytes) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *buf =
+      PyBytes_FromStringAndSize(static_cast<const char *>(data),
+                                static_cast<Py_ssize_t>(nbytes));
+  PyObject *args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 1, buf);
+  PyObject *r = bridge_call("copy_from_bytes", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t nbytes) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyObject *r = bridge_call("to_bytes", args);
+  if (r == nullptr) return -1;
+  char *src = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &src, &len) != 0) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  if (static_cast<size_t>(len) != nbytes) {
+    tls_error = "MXNDArraySyncCopyToCPU: byte-size mismatch (have " +
+                std::to_string(len) + ", caller asked " +
+                std::to_string(nbytes) + ")";
+    Py_DECREF(r);
+    return -1;
+  }
+  std::memcpy(data, src, nbytes);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *r = bridge_call("waitall", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXListAllOpNames(int *out_size, const char ***out_array) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  // cached for the process lifetime — callers never free. call_once guards
+  // the fill: bridge_call may yield the GIL mid-way, so a bare empty()
+  // check would let a second thread double-fill and dangle the pointers.
+  static std::once_flag fill_flag;
+  static std::vector<std::string> storage;
+  static std::vector<const char *> pointers;
+  static bool fill_ok = false;
+  std::call_once(fill_flag, []() {
+    PyObject *r = bridge_call("list_ops", PyTuple_New(0));
+    if (r == nullptr) return;
+    Py_ssize_t n = PyList_Size(r);
+    storage.reserve(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+      storage.emplace_back(c != nullptr ? c : "");
+    }
+    Py_DECREF(r);
+    pointers.reserve(storage.size());
+    for (const auto &s : storage) pointers.push_back(s.c_str());
+    fill_ok = true;
+  });
+  if (!fill_ok) {
+    if (tls_error.empty()) tls_error = "MXListAllOpNames: op query failed";
+    return -1;
+  }
+  *out_size = static_cast<int>(pointers.size());
+  *out_array = pointers.data();
+  return 0;
+}
+
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *h = static_cast<PyObject *>(inputs[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(ins, i, h);
+  }
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(op_name));
+  PyTuple_SET_ITEM(args, 1, ins);
+  PyTuple_SET_ITEM(args, 2, keys);
+  PyTuple_SET_ITEM(args, 3, vals);
+  PyObject *r = bridge_call("invoke", args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  NDArrayHandle *out = static_cast<NDArrayHandle *>(
+      std::malloc(sizeof(NDArrayHandle) * static_cast<size_t>(n)));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);  // handle owns a reference
+    out[i] = static_cast<NDArrayHandle>(o);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = out;
+  return 0;
+}
+
+int MXHandleArrayFree(NDArrayHandle *handles) {
+  std::free(handles);
+  return 0;
+}
+
+}  // extern "C"
