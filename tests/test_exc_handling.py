@@ -1,0 +1,145 @@
+"""Exception propagation at sync points.
+
+Parity model: tests/python/unittest/test_exc_handling.py in the reference —
+ops that fail inside the engine must surface their exception at the next
+sync point (wait_to_read / waitall / asnumpy), in imperative, symbolic and
+Gluon paths, and synchronously under NaiveEngine. On TPU the async engine
+is PJRT; host-side failures (callbacks, shape/type validation) raise on the
+dispatching thread, device-side deferred errors drain at
+``jax.effects_barrier`` via ``mx.nd.waitall``."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+
+
+class _Exploding(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise ValueError("boom-forward")
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise ValueError("boom-backward")
+
+
+@mx.operator.register("test_exploding")
+class _ExplodingProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Exploding()
+
+
+class _ExplodingBwd(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise ValueError("boom-backward-only")
+
+
+@mx.operator.register("test_exploding_bwd")
+class _ExplodingBwdProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _ExplodingBwd()
+
+
+def _sync():
+    """Drain every pending computation, re-raising deferred errors
+    (Engine::WaitForAll parity)."""
+    mx.nd.waitall()
+
+
+def test_imperative_invalid_op_raises_immediately():
+    with pytest.raises(Exception):
+        mx.nd.invoke("not_a_real_op", mx.nd.ones((2,)))
+
+
+def test_imperative_shape_error_raises():
+    # dot with mismatched inner dims must fail on the dispatching thread
+    with pytest.raises(Exception):
+        mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 5)))
+        _sync()
+
+
+def test_engine_surfaces_callback_failure_at_sync_point():
+    """A failing engine task (here: a host callback inside the async
+    stream) must raise at wait/asnumpy, not be swallowed."""
+    x = mx.nd.ones((4,))
+    with pytest.raises(Exception, match="boom-forward"):
+        y = mx.nd.Custom(x, op_type="test_exploding")
+        y.asnumpy()  # sync point
+
+
+def test_engine_failure_surfaces_at_waitall():
+    x = mx.nd.ones((4,))
+    with pytest.raises(Exception, match="boom-forward"):
+        mx.nd.Custom(x, op_type="test_exploding")
+        _sync()
+    # engine must be usable again after a failure (reference: exception
+    # clears once thrown, threaded_engine.cc OnComplete)
+    _sync()
+    onp.testing.assert_allclose((x + 1).asnumpy(), onp.full(4, 2.0))
+
+
+def test_backward_failure_surfaces_on_backward_sync():
+    x = mx.nd.ones((3,))
+    x.attach_grad()
+    with pytest.raises(Exception, match="boom-backward-only"):
+        with mx.autograd.record():
+            y = mx.nd.Custom(x, op_type="test_exploding_bwd")
+        y.backward()
+        _sync()
+    _sync()
+
+
+def test_symbolic_executor_failure():
+    data = mx.sym.var("data")
+    s = mx.sym.Custom(data, op_type="test_exploding")
+    ex = s.simple_bind(mx.cpu(), data=(2, 2))
+    with pytest.raises(Exception, match="boom-forward"):
+        outs = ex.forward(data=mx.nd.ones((2, 2)))
+        outs[0].asnumpy()
+    _sync()
+
+
+def test_gluon_hybrid_failure():
+    class Net(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type="test_exploding")
+
+    net = Net()
+    net.hybridize()
+    with pytest.raises(Exception, match="boom-forward"):
+        net(mx.nd.ones((2, 2))).asnumpy()
+    _sync()
+
+
+def test_naive_engine_raises_synchronously(monkeypatch):
+    """MXNET_ENGINE_TYPE=NaiveEngine blocks after every op, so the failure
+    raises on the invoking statement itself (race-bisection debug mode,
+    naive_engine.cc parity)."""
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert engine.is_naive()
+    x = mx.nd.ones((4,))
+    with pytest.raises(Exception, match="boom-forward"):
+        mx.nd.Custom(x, op_type="test_exploding")
+    _sync()
+
+
+def test_exception_does_not_poison_later_work():
+    for _ in range(2):
+        with pytest.raises(Exception):
+            mx.nd.Custom(mx.nd.ones((2,)), op_type="test_exploding")
+            _sync()
+    _sync()
+    a = mx.nd.random.uniform(shape=(8, 8))
+    b = mx.nd.dot(a, a)
+    assert b.asnumpy().shape == (8, 8)
+
+
+def test_bad_simple_bind_shape_raises():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4)
+    with pytest.raises(Exception):
+        ex = out.simple_bind(mx.cpu(), data=(2, 3))
+        ex.forward(data=mx.nd.ones((5, 7)))  # mismatched bind vs feed
+        ex.outputs[0].asnumpy()
