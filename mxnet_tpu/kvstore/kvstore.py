@@ -239,11 +239,34 @@ class KVStore(KVStoreBase):
         return self._store[k]
 
 
+def _maybe_init_distributed():
+    """Join the multi-host rendezvous when launched by tools/launch.py
+    (parity: KVStoreDist workers connecting to the dmlc tracker via
+    DMLC_* env). No-op when the env is absent or jax.distributed is
+    already up."""
+    import os
+
+    import jax
+
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    if not coord or jax.process_count() > 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("MXTPU_NUM_WORKERS", "1")),
+            process_id=int(os.environ.get("MXTPU_WORKER_ID", "0")))
+    except RuntimeError:
+        pass  # already initialised
+
+
 class _DistKVStore(KVStore):
     """Multi-host store over jax.distributed (parity: KVStoreDist,
     src/kvstore/kvstore_dist.h:44 — push aggregates across workers, pull
     returns the aggregate; sync mode barriers each step).
 
+    Launched workers rendezvous via the MXTPU_COORDINATOR /
+    MXTPU_NUM_WORKERS / MXTPU_WORKER_ID env set by tools/launch.py.
     Without an initialised jax.distributed runtime this degenerates to a
     single-worker group, exactly like running the reference's dist_sync
     without a tracker spawning peers.
@@ -253,6 +276,7 @@ class _DistKVStore(KVStore):
         super().__init__(kv_type)
         import jax
 
+        _maybe_init_distributed()
         self._procs = jax.process_count()
         self._rank = jax.process_index()
         self._residuals = {}  # error-feedback buffers for 2bit compression

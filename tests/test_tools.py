@@ -1,0 +1,88 @@
+"""Tools layer (parity model: tools/ in the reference — launch.py,
+parse_log.py, diagnose.py, bandwidth/measure.py, rec2idx.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_parse_log_roundtrip(tmp_path):
+    import parse_log
+
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.812345\n"
+        "INFO Epoch[0] Time cost=12.345\n"
+        "INFO Epoch[0] Validation-accuracy=0.798000\n"
+        "INFO Epoch[1] Train-accuracy=0.901000\n"
+        "INFO Epoch[1] Time cost=11.000\n"
+        "INFO Epoch[1] Validation-accuracy=0.888000\n")
+    rows = parse_log.main([str(log), "--format", "none"])
+    assert rows[0]["train"]["accuracy"] == pytest.approx(0.812345)
+    assert rows[1]["val"]["accuracy"] == pytest.approx(0.888)
+    assert rows[1]["time"] == pytest.approx(11.0)
+
+
+def test_launch_local_sets_worker_env(tmp_path):
+    import launch
+
+    out = tmp_path / "env"
+    script = (
+        "import os, pathlib\n"
+        "p = pathlib.Path(%r) / os.environ['MXTPU_WORKER_ID']\n"
+        "p.write_text(os.environ['MXTPU_COORDINATOR'] + ' ' +\n"
+        "             os.environ['MXTPU_NUM_WORKERS'])\n" % str(out))
+    out.mkdir()
+    rc = launch.launch_local(3, [sys.executable, "-c", script])
+    assert rc == 0
+    files = sorted(os.listdir(out))
+    assert files == ["0", "1", "2"]
+    for f in files:
+        coord, n = (out / f).read_text().split()
+        assert coord.startswith("127.0.0.1:") and n == "3"
+
+
+def test_bandwidth_measure_cpu_mesh():
+    sys.path.insert(0, os.path.join(REPO, "tools", "bandwidth"))
+    import measure
+
+    rows = measure.measure([0.25], iters=2, warmup=1)
+    assert rows and rows[0]["algo_gbps"] > 0
+    assert rows[0]["devices"] >= 1
+
+
+def test_diagnose_runs(capsys):
+    import diagnose
+
+    diagnose.main()
+    out = capsys.readouterr().out
+    assert "Framework Info" in out and "Version" in out
+    assert "jax" in out
+
+
+def test_rec2idx_matches_writer(tmp_path):
+    import rec2idx
+
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "d.rec")
+    idx_path = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    payloads = [bytes([i]) * (10 + i) for i in range(12)]
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+    written = open(idx_path).read()
+
+    rebuilt = str(tmp_path / "rebuilt.idx")
+    rec2idx.main([rec_path, rebuilt])
+    assert open(rebuilt).read().split() == written.split()
+
+    # the rebuilt index actually seeks correctly
+    r = recordio.MXIndexedRecordIO(rebuilt, rec_path, "r")
+    assert r.read_idx(7) == payloads[7]

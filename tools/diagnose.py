@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Diagnose the runtime environment (parity: tools/diagnose.py — platform,
+package versions, hardware, environment variables; the script users attach
+to bug reports).
+
+    python tools/diagnose.py
+"""
+import importlib
+import os
+import platform
+import sys
+import time
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def check_pip():
+    print("------------Pip Info-----------")
+    try:
+        import pip
+
+        print("Version      :", pip.__version__)
+    except ImportError:
+        print("No corresponding pip install for current python.")
+
+
+def check_framework():
+    print("---------Framework Info--------")
+    try:
+        import mxnet_tpu as mx
+
+        print("Version      :", mx.__version__)
+        print("Directory    :", os.path.dirname(mx.__file__))
+        from mxnet_tpu import runtime
+
+        feats = runtime.Features()
+        on = [name for name in feats.keys() if feats.is_enabled(name)]
+        print("Features     :", ", ".join(sorted(on)))
+    except ImportError as e:
+        print("framework import failed:", e)
+
+
+def check_deps():
+    print("--------Dependency Info--------")
+    for name in ("jax", "jaxlib", "numpy", "flax", "optax"):
+        try:
+            mod = importlib.import_module(name)
+            print(f"{name:<13}:", getattr(mod, "__version__", "unknown"))
+        except ImportError:
+            print(f"{name:<13}: not installed")
+
+
+def check_hardware():
+    print("---------Hardware Info---------")
+    print("Machine      :", platform.machine())
+    print("Platform     :", platform.platform())
+    try:
+        import jax
+
+        t0 = time.time()
+        devices = jax.devices()
+        print("Devices      :", devices, f"(probe {time.time() - t0:.2f}s)")
+        print("Processes    :", jax.process_count())
+    except Exception as e:  # tunnel down, etc.
+        print("Device probe failed:", e)
+
+
+def check_environment():
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "MXTPU_", "JAX_", "XLA_", "TPU_",
+                         "DMLC_", "OMP_", "LD_", "PYTHON")):
+            print(f"{k}={v}")
+
+
+def main():
+    check_python()
+    check_pip()
+    check_framework()
+    check_deps()
+    check_hardware()
+    check_environment()
+
+
+if __name__ == "__main__":
+    main()

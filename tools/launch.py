@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Launch a distributed training job.
+
+Parity: tools/launch.py in the reference (dmlc-tracker: start scheduler +
+servers + workers over ssh/yarn/mpi). TPU-native redesign: there is no
+parameter-server topology to stand up — a multi-host JAX job is N identical
+processes that rendezvous at a coordinator via ``jax.distributed``
+(SURVEY §5.8: collectives ride ICI/DCN, placement picked by XLA). The
+launcher therefore
+  * local mode (default): spawns ``-n`` worker processes on this machine,
+    each with the ``jax.distributed`` rendezvous env
+    (MXTPU_COORDINATOR / MXTPU_NUM_WORKERS / MXTPU_WORKER_ID — consumed by
+    ``mxnet_tpu.kvstore`` dist stores),
+  * ssh mode (``-H hostfile``): runs one process per host line via ssh with
+    the same env, coordinator = first host.
+
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def _worker_env(base, coordinator, num_workers, worker_id):
+    env = dict(base)
+    env["MXTPU_COORDINATOR"] = coordinator
+    env["MXTPU_NUM_WORKERS"] = str(num_workers)
+    env["MXTPU_WORKER_ID"] = str(worker_id)
+    # reference-compat aliases (kvstore_dist reads DMLC_* in the reference)
+    env["DMLC_NUM_WORKER"] = str(num_workers)
+    env["DMLC_WORKER_ID"] = str(worker_id)
+    return env
+
+
+def launch_local(num_workers, command, coordinator_port=9357):
+    coordinator = f"127.0.0.1:{coordinator_port}"
+    procs = []
+    for rank in range(num_workers):
+        env = _worker_env(os.environ, coordinator, num_workers, rank)
+        procs.append(subprocess.Popen(command, env=env))
+
+    def _kill(signum, frame):
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_ssh(hostfile, command, coordinator_port=9357):
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    if not hosts:
+        raise SystemExit("hostfile is empty")
+    coordinator = f"{hosts[0]}:{coordinator_port}"
+    procs = []
+    for rank, host in enumerate(hosts):
+        env_prefix = " ".join(
+            f"{k}={v}" for k, v in _worker_env(
+                {}, coordinator, len(hosts), rank).items())
+        remote = f"cd {os.getcwd()} && {env_prefix} {' '.join(command)}"
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Launch a distributed job (jax.distributed rendezvous)")
+    p.add_argument("-n", "--num-workers", type=int, default=1,
+                   help="number of worker processes")
+    p.add_argument("-H", "--hostfile", type=str, default=None,
+                   help="one host per line; launches one worker per host "
+                        "over ssh (coordinator = first host)")
+    p.add_argument("-p", "--port", type=int, default=9357,
+                   help="coordinator port")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command to launch")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.hostfile:
+        return launch_ssh(args.hostfile, args.command, args.port)
+    return launch_local(args.num_workers, args.command, args.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown table.
+
+Parity: tools/parse_log.py in the reference — same log grammar
+(``Epoch[N] Train-<metric>=V``, ``Epoch[N] Validation-<metric>=V``,
+``Epoch[N] Time cost=S``), which is exactly what ``Module.fit`` and
+``mx.callback.LogValidationMetricsCallback`` emit here.
+
+    python tools/parse_log.py train.log --metric-names accuracy
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    train_re = [re.compile(r".*Epoch\[(\d+)\] Train-" + m + r".*=([.\d]+)")
+                for m in metric_names]
+    val_re = [re.compile(r".*Epoch\[(\d+)\] Validation-" + m + r".*=([.\d]+)")
+              for m in metric_names]
+    time_re = re.compile(r".*Epoch\[(\d+)\] Time cost=([.\d]+)")
+    rows = {}
+
+    def row(epoch):
+        return rows.setdefault(int(epoch), {"train": {}, "val": {}, "time": None})
+
+    for line in lines:
+        for m, rx in zip(metric_names, train_re):
+            g = rx.match(line)
+            if g:
+                row(g.group(1))["train"][m] = float(g.group(2))
+        for m, rx in zip(metric_names, val_re):
+            g = rx.match(line)
+            if g:
+                row(g.group(1))["val"][m] = float(g.group(2))
+        g = time_re.match(line)
+        if g:
+            row(g.group(1))["time"] = float(g.group(2))
+    return rows
+
+
+def render_markdown(rows, metric_names, out=sys.stdout):
+    heads = ["epoch"] + [f"train-{m}" for m in metric_names] + \
+        [f"val-{m}" for m in metric_names] + ["time(s)"]
+    out.write("| " + " | ".join(heads) + " |\n")
+    out.write("|" + "---|" * len(heads) + "\n")
+    for epoch in sorted(rows):
+        r = rows[epoch]
+        cells = [str(epoch)]
+        cells += [f"{r['train'].get(m, float('nan')):.6f}" for m in metric_names]
+        cells += [f"{r['val'].get(m, float('nan')):.6f}" for m in metric_names]
+        cells += ["" if r["time"] is None else f"{r['time']:.1f}"]
+        out.write("| " + " | ".join(cells) + " |\n")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Parse a training output log")
+    p.add_argument("logfile", type=str)
+    p.add_argument("--format", choices=["markdown", "none"],
+                   default="markdown")
+    p.add_argument("--metric-names", nargs="+", default=["accuracy"])
+    args = p.parse_args(argv)
+    with open(args.logfile) as f:
+        rows = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        render_markdown(rows, args.metric_names)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Rebuild the .idx file for a RecordIO .rec (parity: tools/rec2idx.py).
+
+    python tools/rec2idx.py data.rec data.idx
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="index a RecordIO file")
+    p.add_argument("record", type=str, help="path of the .rec file")
+    p.add_argument("index", type=str, help="path of the .idx to write")
+    args = p.parse_args(argv)
+    from mxnet_tpu import native
+
+    offsets, _lengths = native.recordio_scan(args.record)
+    with open(args.index, "w") as f:
+        for i, off in enumerate(offsets):
+            # scan returns payload offsets; the .idx convention stores the
+            # record start (8-byte magic+lrec header precedes the payload)
+            f.write(f"{i}\t{int(off) - 8}\n")
+    print(f"wrote {len(offsets)} entries to {args.index}")
+
+
+if __name__ == "__main__":
+    main()
