@@ -16,7 +16,12 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .base import MXNetError
+from .base import MXNetError, maybe_init_distributed as _midi
+
+# launched by tools/launch.py: join the jax.distributed rendezvous BEFORE
+# anything touches the XLA backend (the only moment it works)
+_midi()
+del _midi
 from .context import (Context, cpu, tpu, gpu, cpu_pinned, num_tpus, num_gpus,
                       current_context)
 from . import engine

@@ -81,3 +81,36 @@ class _NameManager(threading.local):
 
 
 name_manager = _NameManager()
+
+
+def maybe_init_distributed():
+    """Join the multi-host rendezvous when launched by tools/launch.py
+    (parity: KVStoreDist workers connecting to the dmlc tracker via
+    DMLC_* env). jax.distributed.initialize only works BEFORE the XLA
+    backend spins up, so mxnet_tpu/__init__ calls this at import; the
+    kvstore path calls it again as a fallback and warns loudly instead of
+    silently degrading to a single-worker group."""
+    import logging
+    import os
+
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    if not coord:
+        return
+    num = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+    if num <= 1:
+        return
+    import jax
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already joined
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=num,
+            process_id=int(os.environ.get("MXTPU_WORKER_ID", "0")))
+    except RuntimeError as e:
+        logging.getLogger("mxnet_tpu").error(
+            "MXTPU_COORDINATOR=%s is set but jax.distributed could not "
+            "initialize (%s) — this worker will run as an ISOLATED "
+            "single-process group and dist_* stores will NOT aggregate. "
+            "Import mxnet_tpu before running any computation.", coord, e)

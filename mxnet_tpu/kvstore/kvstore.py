@@ -239,25 +239,7 @@ class KVStore(KVStoreBase):
         return self._store[k]
 
 
-def _maybe_init_distributed():
-    """Join the multi-host rendezvous when launched by tools/launch.py
-    (parity: KVStoreDist workers connecting to the dmlc tracker via
-    DMLC_* env). No-op when the env is absent or jax.distributed is
-    already up."""
-    import os
-
-    import jax
-
-    coord = os.environ.get("MXTPU_COORDINATOR")
-    if not coord or jax.process_count() > 1:
-        return
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ.get("MXTPU_NUM_WORKERS", "1")),
-            process_id=int(os.environ.get("MXTPU_WORKER_ID", "0")))
-    except RuntimeError:
-        pass  # already initialised
+from ..base import maybe_init_distributed as _maybe_init_distributed
 
 
 class _DistKVStore(KVStore):

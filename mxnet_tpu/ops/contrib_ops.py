@@ -503,3 +503,246 @@ def _im2col(data, kernel=(), stride=(), dilate=(), pad=()):
 def _block_grad(data):
     """parity: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad."""
     return jax.lax.stop_gradient(data)
+
+
+# ------------------------------------------------- transformer matmuls -----
+# parity: src/operator/contrib/transformer.cc — the interleaved-projection
+# attention matmuls MXNet's transformer example uses. Layout: qkv is
+# (seq, batch, 3*heads*head_dim) with q/k/v interleaved per head. On TPU
+# these are einsums the MXU eats directly; no special kernel needed.
+
+def _split_interleaved(qkv, heads, parts):
+    seq, bsz, proj = qkv.shape
+    head_dim = proj // (parts * heads)
+    x = qkv.reshape(seq, bsz, heads, parts, head_dim)
+    return [x[:, :, :, i, :] for i in range(parts)]  # each (s, b, h, d)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def _interleaved_selfatt_qk(queries_keys_values, heads=1):
+    q, k, _ = _split_interleaved(queries_keys_values, heads, 3)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    att = jnp.einsum("qbhd,kbhd->bhqk", q * scale, k)
+    b, h, s, _ = att.shape
+    return att.reshape(b * h, s, s)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def _interleaved_selfatt_valatt(queries_keys_values, attention, heads=1):
+    _, _, v = _split_interleaved(queries_keys_values, heads, 3)
+    s, b, h, d = v.shape
+    att = attention.reshape(b, h, s, s)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att, v)
+    return out.reshape(s, b, h * d)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def _interleaved_encdec_qk(queries, keys_values, heads=1):
+    qs, b, proj = queries.shape
+    d = proj // heads
+    q = queries.reshape(qs, b, heads, d)
+    k, _ = _split_interleaved(keys_values, heads, 2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    att = jnp.einsum("qbhd,kbhd->bhqk", q * scale, k)
+    ks = k.shape[0]
+    return att.reshape(b * heads, qs, ks)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def _interleaved_encdec_valatt(keys_values, attention, heads=1):
+    _, v = _split_interleaved(keys_values, heads, 2)
+    ks, b, h, d = v.shape
+    qs = attention.shape[1]
+    att = attention.reshape(b, h, qs, ks)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att, v)
+    return out.reshape(qs, b, h * d)
+
+
+# ------------------------------------------------------------ box codec ----
+
+@register("_contrib_box_encode", num_outputs=2)
+def _box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+                stds=(0.1, 0.1, 0.2, 0.2)):
+    """parity: contrib/bounding_box.cc BoxEncode — corner boxes ->
+    regression targets for matched anchors (SSD/Faster-RCNN training)."""
+    ax, ay, aw, ah = _corner_to_center(anchors)
+    matched = jnp.take_along_axis(
+        refs, jnp.maximum(matches, 0).astype(jnp.int32)[..., None], axis=1)
+    gx, gy, gw, gh = _corner_to_center(matched)
+    means = jnp.asarray(means, anchors.dtype)
+    stds = jnp.asarray(stds, anchors.dtype)
+    t = jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                   jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+    t = (t - means) / stds
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, t, jnp.zeros_like(t)), \
+        jnp.broadcast_to(mask, t.shape).astype(t.dtype)
+
+
+def _corner_to_center(boxes):
+    xmin, ymin, xmax, ymax = [boxes[..., i] for i in range(4)]
+    w = xmax - xmin
+    h = ymax - ymin
+    return xmin + w / 2, ymin + h / 2, w, h
+
+
+@register("_contrib_box_decode")
+def _box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+                clip=-1.0, format="corner"):
+    """parity: contrib/bounding_box.cc BoxDecode — regression deltas back
+    to corner boxes."""
+    if format == "corner":
+        ax, ay, aw, ah = _corner_to_center(anchors)
+    else:
+        ax, ay, aw, ah = [anchors[..., i] for i in range(4)]
+    stds = jnp.asarray([std0, std1, std2, std3], data.dtype)
+    d = data * stds
+    cx = d[..., 0] * aw + ax
+    cy = d[..., 1] * ah + ay
+    dw, dh = d[..., 2], d[..., 3]
+    if clip is not None and clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2,
+          differentiable=False)
+def _bipartite_matching(data, is_ascend=False, threshold=0.0, topk=-1):
+    """parity: contrib/bounding_box.cc BipartiteMatching — greedy one-to-one
+    row/col matching by score (the SSD target matcher). lax.scan over the
+    match rounds keeps it jittable."""
+    b, rows, cols = data.shape
+    n_rounds = min(rows, cols) if topk <= 0 else min(topk, rows, cols)
+    big = jnp.asarray(float("inf"), data.dtype)
+    score = -data if is_ascend else data
+    passes = (data >= threshold) if not is_ascend else (data <= threshold)
+    score = jnp.where(passes, score, -big)
+
+    def one_round(state, _):
+        s, row_out, col_out = state
+        flat = s.reshape(b, -1)
+        best = jnp.argmax(flat, axis=1)
+        ri, ci = best // cols, best % cols
+        valid = jnp.take_along_axis(flat, best[:, None], 1)[:, 0] > -big
+        row_out = jnp.where(
+            valid[:, None] & (jnp.arange(rows)[None] == ri[:, None]),
+            ci[:, None].astype(row_out.dtype), row_out)
+        col_out = jnp.where(
+            valid[:, None] & (jnp.arange(cols)[None] == ci[:, None]),
+            ri[:, None].astype(col_out.dtype), col_out)
+        s = jnp.where(jnp.arange(rows)[None, :, None] == ri[:, None, None],
+                      -big, s)
+        s = jnp.where(jnp.arange(cols)[None, None, :] == ci[:, None, None],
+                      -big, s)
+        return (s, row_out, col_out), None
+
+    init = (score, jnp.full((b, rows), -1.0, data.dtype),
+            jnp.full((b, cols), -1.0, data.dtype))
+    (_, row_out, col_out), _ = jax.lax.scan(one_round, init, None,
+                                            length=n_rounds)
+    return row_out, col_out
+
+
+# -------------------------------------------------------------- misc -------
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    """parity: contrib/quadratic_op.cc (the extension-tutorial op)."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("_contrib_allclose", differentiable=False)
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register("_contrib_index_array", differentiable=False)
+def _index_array(data, axes=None):
+    """parity: contrib/index_array.cc — coordinates of every element."""
+    idx = jnp.stack(jnp.meshgrid(
+        *[jnp.arange(s) for s in data.shape], indexing="ij"), axis=-1)
+    if axes is not None:
+        idx = idx[..., tuple(axes)]
+    return idx.astype(jnp.int64)
+
+
+@register("_contrib_getnnz", differentiable=False)
+def _getnnz(data, axis=None):
+    """parity: contrib/nnz.cc — count of structurally nonzero entries."""
+    return jnp.sum(data != 0, axis=axis).astype(jnp.int64)
+
+
+def _register_batchnorm_variants():
+    """BatchNorm_v1 (legacy batch_norm_v1.cc) and SyncBatchNorm
+    (contrib/sync_batch_norm.cc) both reduce to the one BatchNorm emitter:
+    under pjit/GSPMD a batch-sharded mean/var already reduces GLOBALLY
+    (XLA inserts the cross-device psum), so the 'sync' variant needs no
+    separate communication path on TPU."""
+    from . import nn as _nn
+
+    bn = _nn._batch_norm
+    register("BatchNorm_v1", num_outputs=3)(bn.fn)
+    register("_contrib_SyncBatchNorm", num_outputs=3,
+             aliases=("SyncBatchNorm",))(
+        lambda data, gamma, beta, moving_mean, moving_var, key=None,
+        ndev=1, **kw: bn.fn(data, gamma, beta, moving_mean, moving_var,
+                            **{k: v for k, v in kw.items()
+                               if k in ("eps", "momentum", "fix_gamma",
+                                        "use_global_stats", "axis",
+                                        "training", "output_mean_var")}))
+
+
+_register_batchnorm_variants()
+
+
+# ----------------------------------------------------------- image ops -----
+# parity: src/operator/image/image_random.cc + resize.cc + crop.cc — the
+# `npx.image` device-side pipeline (distinct from mx.image's host-side
+# augmenters). Layout: HWC or NHWC, matching the reference.
+
+@register("_image_to_tensor")
+def _image_to_tensor(data):
+    """HWC/NHWC uint8 [0,255] -> CHW/NCHW float32 [0,1]."""
+    x = data.astype(jnp.float32) / 255.0
+    perm = (2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2)
+    return jnp.transpose(x, perm)
+
+
+@register("_image_normalize")
+def _image_normalize(data, mean=(0.0,), std=(1.0,)):
+    """CHW/NCHW normalize (runs after to_tensor, like the reference)."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    m = jnp.asarray(mean, data.dtype).reshape(shape)
+    s = jnp.asarray(std, data.dtype).reshape(shape)
+    return (data - m) / s
+
+
+@register("_image_resize")
+def _image_resize(data, size=(), keep_ratio=False, interp=1):
+    """HWC/NHWC resize; interp 0=nearest else bilinear."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = (size[0], size[1]) if len(size) == 2 else (size[0], size[0])
+    method = "nearest" if interp == 0 else "linear"
+    if data.ndim == 3:
+        return jax.image.resize(data.astype(jnp.float32),
+                                (h, w, data.shape[2]),
+                                method=method).astype(data.dtype)
+    return jax.image.resize(data.astype(jnp.float32),
+                            (data.shape[0], h, w, data.shape[3]),
+                            method=method).astype(data.dtype)
+
+
+@register("_image_crop")
+def _image_crop(data, x=0, y=0, width=1, height=1):
+    """HWC/NHWC spatial crop at (x, y)."""
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]

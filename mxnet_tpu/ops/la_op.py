@@ -143,3 +143,19 @@ def _linalg_slogdet(A):
 @register("linalg_inverse")
 def _linalg_inverse(A):
     return jnp.linalg.inv(A)
+
+
+# `_linalg_*` aliases — the registered names in the reference
+# (la_op.cc registers both `linalg_gemm` and the `_linalg_gemm` form).
+def _register_linalg_aliases():
+    from .registry import _REGISTRY, register as _reg
+
+    for name in [n for n in list(_REGISTRY) if n.startswith("linalg_")]:
+        alias = "_" + name
+        if alias not in _REGISTRY:
+            op = _REGISTRY[name]
+            _reg(alias, num_outputs=op.num_outputs,
+                 differentiable=op.differentiable, eager=op.eager)(op.fn)
+
+
+_register_linalg_aliases()

@@ -666,3 +666,25 @@ def _make_loss_op(data):
 @register("relu6")
 def _relu6(data):
     return jnp.clip(data, 0.0, 6.0)
+
+
+@register("_contrib_BatchNormWithReLU", num_outputs=3)
+def _batch_norm_with_relu(data, gamma, beta, moving_mean, moving_var,
+                          **kwargs):
+    """parity: contrib/batch_norm_relu.cc — BN + fused ReLU (XLA fuses the
+    max into the BN elementwise epilogue on its own)."""
+    out, mean, var = _batch_norm.fn(data, gamma, beta, moving_mean,
+                                    moving_var, **kwargs)
+    return jnp.maximum(out, 0), mean, var
+
+
+def _register_sparse_embedding():
+    """contrib/sparse_embedding -> the one Embedding emitter (row-sparse
+    gradient handling lives in ndarray/sparse.py + the optimizers)."""
+    from .registry import _REGISTRY
+
+    emb = _REGISTRY["Embedding"]
+    register("_contrib_SparseEmbedding")(emb.fn)
+
+
+_register_sparse_embedding()

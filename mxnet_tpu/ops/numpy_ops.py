@@ -352,3 +352,388 @@ _reg_fixed("_npi_random_bernoulli",
            jax.random.bernoulli(key, p, shape=tuple(size))
            .astype(jnp.dtype(dtype)),
            differentiable=False)
+
+
+# ----------------------------------------------------------- npi tail ------
+# parity: the remaining src/operator/numpy registrations (np_init_op.cc,
+# np_window_op.cc, np_insert/delete, random/*, linalg tensorinv/solve,
+# npx_*). Aliases keep the reference's exact `_npi_`/`_np_` names resolving
+# to the one emitter each.
+
+from .registry import get as _get
+
+
+def _alias(new, existing):
+    op = _get(existing)
+    register(new, num_outputs=op.num_outputs,
+             differentiable=op.differentiable, eager=op.eager)(op.fn)
+
+
+for _new, _old in [
+        ("_np_all", "_npi_all"), ("_np_any", "_npi_any"),
+        ("_np_cumsum", "_npi_cumsum"), ("_np_diag", "_npi_diag"),
+        ("_np_diagflat", "_npi_diagflat"),
+        ("_np_diagonal", "_npi_diagonal"), ("_np_dot", "_npi_dot"),
+        ("_np_moveaxis", "_npi_moveaxis"), ("_np_reshape", "_npi_reshape"),
+        ("_np_roll", "_npi_roll"), ("_np_squeeze", "_npi_squeeze"),
+        ("_np_trace", "_npi_trace"), ("_np_transpose", "_npi_transpose"),
+        ("_npi_bitwise_not", "_npi_invert"),
+        ("_npi_normal", "_npi_random_normal"),
+        ("_npi_uniform", "_npi_random_uniform"),
+        ("_npi_bernoulli", "_npi_random_bernoulli"),
+        ("_npi_exponential", "_npi_random_exponential"),
+        ("_npi_gamma", "_npi_random_gamma"),
+        ("_npi_choice", "_npi_random_choice"),
+]:
+    _alias(_new, _old)
+
+
+@register("_npi_multinomial", differentiable=False)
+def _npi_multinomial(pvals=None, n=1, key=None, size=()):
+    """parity: np_random multinomial — counts over categories from `n`
+    draws with probabilities `pvals` (categorical draws + one-hot sum)."""
+    pvals = jnp.asarray(pvals)
+    k = pvals.shape[-1]
+    draws = jax.random.categorical(
+        key, jnp.log(jnp.maximum(pvals, 1e-38)),
+        shape=tuple(size) + (int(n),) if size else (int(n),))
+    return jnp.sum(jax.nn.one_hot(draws, k, dtype=jnp.int64), axis=-2)
+
+_reg_fixed("_npi_around", jnp.round)
+_reg_fixed("_npi_deg2rad", jnp.deg2rad)
+_reg_fixed("_npi_rad2deg", jnp.rad2deg)
+_reg_fixed("_np_copy", lambda x: jnp.array(x))
+
+
+@register("_npi_hanning", differentiable=False)
+def _npi_hanning(M=0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.hanning(int(M)).astype(canonical_dtype(dtype))
+
+
+@register("_npi_hamming", differentiable=False)
+def _npi_hamming(M=0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.hamming(int(M)).astype(canonical_dtype(dtype))
+
+
+@register("_npi_blackman", differentiable=False)
+def _npi_blackman(M=0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.blackman(int(M)).astype(canonical_dtype(dtype))
+
+
+@register("_npi_logspace", differentiable=False)
+def _npi_logspace(start=0.0, stop=1.0, num=50, endpoint=True, base=10.0,
+                  dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.logspace(start, stop, int(num), endpoint=endpoint,
+                        base=base).astype(canonical_dtype(dtype))
+
+
+@register("_npi_polyval")
+def _npi_polyval(p, x):
+    return jnp.polyval(p, x)
+
+
+@register("_npi_ediff1d")
+def _npi_ediff1d(data, to_begin=None, to_end=None):
+    d = jnp.diff(data.reshape(-1))
+    parts = []
+    if to_begin is not None:
+        parts.append(jnp.atleast_1d(jnp.asarray(to_begin, d.dtype)).reshape(-1))
+    parts.append(d)
+    if to_end is not None:
+        parts.append(jnp.atleast_1d(jnp.asarray(to_end, d.dtype)).reshape(-1))
+    return jnp.concatenate(parts) if len(parts) > 1 else d
+
+
+@register("_npi_delete", eager=True, differentiable=False)
+def _npi_delete(data, obj=None, start=None, stop=None, step=None, axis=None):
+    import numpy as onp
+
+    arr = onp.asarray(data)
+    if obj is None:
+        obj = slice(start, stop, step)
+    elif hasattr(obj, "shape"):
+        obj = onp.asarray(obj).astype(onp.int64)
+    else:
+        obj = int(obj)
+    return jnp.asarray(onp.delete(arr, obj, axis=axis))
+
+
+@register("_npi_insert_scalar", eager=True, differentiable=False)
+def _npi_insert_scalar(data, obj=None, val=0.0, axis=None):
+    import numpy as onp
+
+    return jnp.asarray(onp.insert(onp.asarray(data), int(obj), val,
+                                  axis=axis))
+
+
+@register("_npi_insert_slice", eager=True, differentiable=False)
+def _npi_insert_slice(data, values, start=None, stop=None, step=None,
+                      axis=None):
+    import numpy as onp
+
+    return jnp.asarray(onp.insert(onp.asarray(data),
+                                  slice(start, stop, step),
+                                  onp.asarray(values), axis=axis))
+
+
+@register("_npi_insert_tensor", eager=True, differentiable=False)
+def _npi_insert_tensor(data, obj, values, axis=None):
+    import numpy as onp
+
+    return jnp.asarray(onp.insert(onp.asarray(data),
+                                  onp.asarray(obj).astype(onp.int64),
+                                  onp.asarray(values), axis=axis))
+
+
+@register("_npi_diag_indices_from", differentiable=False)
+def _npi_diag_indices_from(data):
+    return jnp.stack(jnp.diag_indices(data.shape[0], data.ndim))
+
+
+def _hsplit_n(n_in, kw):
+    ios = kw.get("indices_or_sections", 1)
+    return int(ios) if not isinstance(ios, (tuple, list)) else len(ios) + 1
+
+
+@register("_npi_hsplit", num_outputs=_hsplit_n)
+def _npi_hsplit(data, indices_or_sections=1):
+    return tuple(jnp.split(data, indices_or_sections
+                           if not isinstance(indices_or_sections, (tuple, list))
+                           else list(indices_or_sections),
+                           axis=1 if data.ndim > 1 else 0))
+
+
+@register("_npi_dsplit", num_outputs=_hsplit_n)
+def _npi_dsplit(data, indices_or_sections=1):
+    return tuple(jnp.split(data, indices_or_sections
+                           if not isinstance(indices_or_sections, (tuple, list))
+                           else list(indices_or_sections), axis=2))
+
+
+@register("_npi_vsplit", num_outputs=_hsplit_n)
+def _npi_vsplit(data, indices_or_sections=1):
+    return tuple(jnp.split(data, indices_or_sections
+                           if not isinstance(indices_or_sections, (tuple, list))
+                           else list(indices_or_sections), axis=0))
+
+
+# creation ops (np_init_op.cc)
+
+@register("_npi_zeros", differentiable=False)
+def _npi_zeros(shape=(), dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.zeros(tuple(shape), canonical_dtype(dtype))
+
+
+@register("_npi_ones", differentiable=False)
+def _npi_ones(shape=(), dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.ones(tuple(shape), canonical_dtype(dtype))
+
+
+@register("_npi_full", differentiable=False, aliases=("_npi_full_like",))
+def _npi_full(a=None, shape=(), fill_value=0.0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    if a is not None:
+        return jnp.full_like(a, fill_value)
+    return jnp.full(tuple(shape), fill_value, canonical_dtype(dtype))
+
+
+@register("_npi_arange", differentiable=False)
+def _npi_arange(start=0.0, stop=None, step=1.0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    if stop is None:
+        start, stop = 0.0, start
+    return jnp.arange(start, stop, step, canonical_dtype(dtype))
+
+
+@register("_npi_linspace", differentiable=False)
+def _npi_linspace(start=0.0, stop=1.0, num=50, endpoint=True,
+                  dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.linspace(start, stop, int(num),
+                        endpoint=endpoint).astype(canonical_dtype(dtype))
+
+
+@register("_npi_eye", differentiable=False,
+          aliases=("_npi_identity", "_eye"))
+def _npi_eye(N=1, M=None, k=0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.eye(int(N), None if M is None else int(M), int(k),
+                   dtype=canonical_dtype(dtype))
+
+
+@register("_npi_tensorinv")
+def _npi_tensorinv(a, ind=2):
+    return jnp.linalg.tensorinv(a, ind=ind)
+
+
+@register("_npi_tensorsolve")
+def _npi_tensorsolve(a, b, a_axes=None):
+    return jnp.linalg.tensorsolve(a, b, axes=tuple(a_axes) if a_axes else None)
+
+
+@register("_npi_pinv_scalar_rcond")
+def _npi_pinv_scalar_rcond(a, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian)
+
+
+@register("_npx_nonzero", eager=True, differentiable=False)
+def _npx_nonzero(data):
+    import numpy as onp
+
+    return jnp.asarray(onp.stack(onp.nonzero(onp.asarray(data)),
+                                 axis=-1).astype(onp.int64))
+
+
+@register("_npx_constraint_check", differentiable=False)
+def _npx_constraint_check(data, msg="constraint violated"):
+    """parity: npx_constraint_check.cc — passes data through when every
+    element is true; the framework surfaces `msg` at the sync point
+    otherwise (jax error-check semantics: returns bool scalar)."""
+    return jnp.all(data.astype(bool))
+
+
+@register("_npx_reshape")
+def _npx_reshape(data, newshape=(), reverse=False, order="C"):
+    """parity: npx_reshape special codes — -1 infer one dim, -2 copy all
+    remaining source dims, -3 merge the next two source dims, -4 split one
+    source dim into the next two newshape entries, -5 merge all remaining
+    source dims. A source cursor advances as codes consume dims."""
+    src = list(data.shape)
+    tgt = []
+    cursor = 0
+    codes = list(newshape)
+    i = 0
+    while i < len(codes):
+        s = codes[i]
+        if s == -2:
+            tgt.extend(src[cursor:])
+            cursor = len(src)
+        elif s == -3:
+            tgt.append(src[cursor] * src[cursor + 1])
+            cursor += 2
+        elif s == -4:
+            d1, d2 = codes[i + 1], codes[i + 2]
+            whole = src[cursor]
+            if d1 == -1:
+                d1 = whole // d2
+            if d2 == -1:
+                d2 = whole // d1
+            tgt.extend([int(d1), int(d2)])
+            cursor += 1
+            i += 2
+        elif s == -5:
+            prod = 1
+            for d in src[cursor:]:
+                prod *= d
+            tgt.append(prod)
+            cursor = len(src)
+        elif s == -1:
+            tgt.append(-1)
+            cursor += 1
+        else:
+            tgt.append(int(s))
+            cursor += 1
+        i += 1
+    return jnp.reshape(data, tuple(tgt))
+
+
+@register("_npi_share_memory", eager=True, differentiable=False)
+def _npi_share_memory(a, b):
+    """XLA buffers never alias across arrays from Python's view."""
+    return jnp.asarray(False)
+
+
+@register("_npi_lcm_scalar", differentiable=False)
+def _npi_lcm_scalar(data, scalar=1):
+    return jnp.lcm(data.astype(jnp.int64), jnp.asarray(int(scalar)))
+
+
+@register("_npi_bitwise_and_scalar", differentiable=False)
+def _npi_bitwise_and_scalar(data, scalar=0):
+    return jnp.bitwise_and(data.astype(jnp.int64), int(scalar))
+
+
+@register("_npi_bitwise_or_scalar", differentiable=False)
+def _npi_bitwise_or_scalar(data, scalar=0):
+    return jnp.bitwise_or(data.astype(jnp.int64), int(scalar))
+
+
+@register("_npi_bitwise_xor_scalar", differentiable=False)
+def _npi_bitwise_xor_scalar(data, scalar=0):
+    return jnp.bitwise_xor(data.astype(jnp.int64), int(scalar))
+
+
+@register("_npi_where_lscalar")
+def _npi_where_lscalar(cond, x, scalar=0.0):
+    return jnp.where(cond.astype(bool), x, scalar)
+
+
+@register("_npi_where_rscalar")
+def _npi_where_rscalar(cond, y, scalar=0.0):
+    return jnp.where(cond.astype(bool), scalar, y)
+
+
+@register("_npi_where_scalar2")
+def _npi_where_scalar2(cond, lscalar=0.0, rscalar=0.0):
+    return jnp.where(cond.astype(bool), lscalar, rscalar)
+
+
+@register("_npi_boolean_mask_assign_scalar")
+def _npi_boolean_mask_assign_scalar(data, mask, value=0.0):
+    return jnp.where(mask.astype(bool), value, data)
+
+
+@register("_npi_boolean_mask_assign_tensor")
+def _npi_boolean_mask_assign_tensor(data, mask, value):
+    return jnp.where(mask.astype(bool), value, data)
+
+
+# remaining reference sampler names (np_random ops) + tail distributions
+_reg_fixed("_npi_pareto",
+           lambda a=1.0, key=None, size=(), dtype="float32":
+           (jnp.exp(jax.random.exponential(key, shape=tuple(size),
+                                           dtype=jnp.dtype(dtype)) / a)
+            - 1.0),
+           differentiable=False)
+_reg_fixed("_npi_weibull",
+           lambda a=1.0, key=None, size=(), dtype="float32":
+           jnp.power(jax.random.exponential(key, shape=tuple(size),
+                                            dtype=jnp.dtype(dtype)),
+                     1.0 / a),
+           differentiable=False)
+_reg_fixed("_npi_rayleigh",
+           lambda scale=1.0, key=None, size=(), dtype="float32":
+           scale * jnp.sqrt(2.0 * jax.random.exponential(
+               key, shape=tuple(size), dtype=jnp.dtype(dtype))),
+           differentiable=False)
+# *_n variants: shape given as the size of an extra leading batch
+# (np_random ops `normal_n`/`uniform_n` used by mx.np.random with out=)
+_alias("_npi_normal_n", "_npi_random_normal")
+_alias("_npi_uniform_n", "_npi_random_uniform")
+_reg_fixed("_npi_powerd",
+           lambda a=1.0, key=None, size=(), dtype="float32":
+           jnp.power(jax.random.uniform(key, shape=tuple(size),
+                                        dtype=jnp.dtype(dtype)), 1.0 / a),
+           differentiable=False)
+
+# legacy internal names for ravel/unravel/split_v2 (matrix_op.cc/ravel.cc
+# register the underscore forms)
+_alias("_unravel_index", "unravel_index")
+_alias("_ravel_multi_index", "ravel_multi_index")
+_alias("_split_v2", "split_v2")

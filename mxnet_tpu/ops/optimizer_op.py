@@ -235,3 +235,388 @@ def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0,
     ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2,
                       jnp.ones_like(r1))
     return weight - lr * ratio * g_update
+
+
+# ------------------------------------------------------------- adamw -------
+
+def _adamw_core(weight32, g, mean, var, lr, beta1, beta2, epsilon, wd, eta,
+                rescale):
+    """Shared AdamW math (parity: src/operator/contrib/adamw.cc — decoupled
+    weight decay, NO bias correction, whole update skipped when the dynamic
+    rescale_grad tensor is non-finite — the loss-scaler contract)."""
+    ok = jnp.isfinite(rescale) & jnp.all(jnp.isfinite(g))
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    step = lr * (mean_new / (jnp.sqrt(var_new) + epsilon) + wd * weight32)
+    w_new = weight32 - eta * step
+    return (jnp.where(ok, w_new, weight32), jnp.where(ok, mean_new, mean),
+            jnp.where(ok, var_new, var))
+
+
+@register("_adamw_update", num_outputs=3, aliases=("adamw_update",))
+def adamw_update(weight, grad, mean, var, rescale_grad, lr=0.001, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0):
+    """parity: contrib/adamw.cc _adamw_update — rescale_grad is a TENSOR
+    input (1/loss_scale from the AMP scaler); non-finite skips the step."""
+    rescale = jnp.reshape(rescale_grad, ())
+    g = grad * rescale
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return _adamw_core(weight, g, mean, var, lr, beta1, beta2, epsilon, wd,
+                       eta, rescale)
+
+
+@register("_mp_adamw_update", num_outputs=4, aliases=("mp_adamw_update",))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                    lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0):
+    rescale = jnp.reshape(rescale_grad, ())
+    g = grad.astype(jnp.float32) * rescale
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32, m, v = _adamw_core(weight32, g, mean, var, lr, beta1, beta2,
+                            epsilon, wd, eta, rescale)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+@register("mp_nag_mom_update", num_outputs=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """parity: optimizer_op.cc mp_nag_mom_update — NAG on the fp32 master."""
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient) \
+        + wd * weight32
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register("mp_lamb_update_phase1", num_outputs=3)
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = mean_new, var_new
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    return m / (jnp.sqrt(v) + epsilon) + wd * weight32, mean_new, var_new
+
+
+@register("mp_lamb_update_phase2", num_outputs=2)
+def mp_lamb_update_phase2(weight, g_update, r1, r2, weight32, lr=0.01,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    w32 = lamb_update_phase2(weight32, g_update, r1, r2, lr=lr,
+                             lower_bound=lower_bound, upper_bound=upper_bound)
+    return w32.astype(weight.dtype), w32
+
+
+# ---------------------------------------------- multi-tensor variants ------
+# The reference ships fixed-arity fused kernels (optimizer_op.cc
+# MultiSGDUpdate, preloaded_multi_*, contrib multi_lamb/multi_adamw). Here
+# each is one jitted executable over the whole interleaved tensor list —
+# XLA fuses across parameters, which is the same batching the kernels
+# hand-roll. Functional convention: outputs are all updated tensors
+# (weights first, then state tensors per weight).
+
+def _multi_n(kw):
+    # multi_lamb ops use the reference's `num_tensors` name; the sgd/adamw
+    # families use `num_weights` — accept either so symbolic output counts
+    # always match the executed tuple
+    return int(kw.get("num_weights") or kw.get("num_tensors") or 1)
+
+
+@register("multi_sgd_update", num_outputs=lambda n, kw: _multi_n(kw))
+def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1):
+    """args = [w0, g0, w1, g1, ...] (parity: optimizer_op.cc:473)."""
+    outs = []
+    for i in range(num_weights):
+        w, g = args[2 * i], args[2 * i + 1]
+        outs.append(sgd_update.fn(w, g, lr=lrs[i], wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", num_outputs=lambda n, kw: 2 * _multi_n(kw))
+def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1):
+    """args = [w0, g0, mom0, ...]; returns weights then momenta."""
+    ws, moms = [], []
+    for i in range(num_weights):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        w2, m2 = sgd_mom_update.fn(w, g, m, lr=lrs[i], momentum=momentum,
+                                   wd=wds[i], rescale_grad=rescale_grad,
+                                   clip_gradient=clip_gradient)
+        ws.append(w2)
+        moms.append(m2)
+    return tuple(ws + moms)
+
+
+@register("multi_mp_sgd_update", num_outputs=lambda n, kw: 2 * _multi_n(kw))
+def multi_mp_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1):
+    """args = [w0, g0, w32_0, ...]; returns weights then fp32 masters."""
+    ws, w32s = [], []
+    for i in range(num_weights):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        w2, w32_2 = mp_sgd_update.fn(w, g, w32, lr=lrs[i], wd=wds[i],
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient)
+        ws.append(w2)
+        w32s.append(w32_2)
+    return tuple(ws + w32s)
+
+
+@register("multi_mp_sgd_mom_update",
+          num_outputs=lambda n, kw: 3 * _multi_n(kw))
+def multi_mp_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1):
+    """args = [w0, g0, mom0, w32_0, ...]."""
+    ws, moms, w32s = [], [], []
+    for i in range(num_weights):
+        w, g, m, w32 = args[4 * i:4 * i + 4]
+        w2, m2, w32_2 = mp_sgd_mom_update.fn(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        ws.append(w2)
+        moms.append(m2)
+        w32s.append(w32_2)
+    return tuple(ws + moms + w32s)
+
+
+@register("preloaded_multi_sgd_update",
+          num_outputs=lambda n, kw: _multi_n(kw))
+def preloaded_multi_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=1):
+    """args = [w0, g0, ..., lrs, wds] — lr/wd arrive as device tensors so
+    schedules never leave the device (parity: preloaded_multi_sgd_*)."""
+    lrs, wds = args[-2], args[-1]
+    outs = []
+    for i in range(num_weights):
+        w, g = args[2 * i], args[2 * i + 1]
+        outs.append(sgd_update.fn(w, g, lr=lrs[i], wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update",
+          num_outputs=lambda n, kw: 2 * _multi_n(kw))
+def preloaded_multi_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=1):
+    lrs, wds = args[-2], args[-1]
+    ws, moms = [], []
+    for i in range(num_weights):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        w2, m2 = sgd_mom_update.fn(w, g, m, lr=lrs[i], momentum=momentum,
+                                   wd=wds[i], rescale_grad=rescale_grad,
+                                   clip_gradient=clip_gradient)
+        ws.append(w2)
+        moms.append(m2)
+    return tuple(ws + moms)
+
+
+@register("preloaded_multi_mp_sgd_update",
+          num_outputs=lambda n, kw: 2 * _multi_n(kw))
+def preloaded_multi_mp_sgd_update(*args, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=1):
+    lrs, wds = args[-2], args[-1]
+    ws, w32s = [], []
+    for i in range(num_weights):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        w2, w32_2 = mp_sgd_update.fn(w, g, w32, lr=lrs[i], wd=wds[i],
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient)
+        ws.append(w2)
+        w32s.append(w32_2)
+    return tuple(ws + w32s)
+
+
+@register("preloaded_multi_mp_sgd_mom_update",
+          num_outputs=lambda n, kw: 3 * _multi_n(kw))
+def preloaded_multi_mp_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                      clip_gradient=-1.0, num_weights=1):
+    lrs, wds = args[-2], args[-1]
+    ws, moms, w32s = [], [], []
+    for i in range(num_weights):
+        w, g, m, w32 = args[4 * i:4 * i + 4]
+        w2, m2, w32_2 = mp_sgd_mom_update.fn(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        ws.append(w2)
+        moms.append(m2)
+        w32s.append(w32_2)
+    return tuple(ws + moms + w32s)
+
+
+@register("multi_lars")
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """parity: contrib/multi_lars.cc — layerwise LARS coefficients for a
+    whole parameter set in one op (inputs are the per-layer norms computed
+    by multi_sum_sq)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    coef = eta * w_norm / (g_norm + wds * w_norm + eps)
+    return lrs * jnp.where((w_norm > 0) & (g_norm > 0), coef,
+                           jnp.ones_like(coef))
+
+
+@register("_contrib_group_adagrad_update", num_outputs=2,
+          aliases=("group_adagrad_update",))
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """parity: contrib/optimizer_op.cc GroupAdagrad — one accumulator per
+    row (embedding-friendly Adagrad)."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    hist_new = history + jnp.mean(jnp.square(g), axis=axes, keepdims=True) \
+        if axes else history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(hist_new) + epsilon), hist_new
+
+
+@register("all_finite", differentiable=False)
+def all_finite(data, init_output=True):
+    """parity: contrib/all_finite.cc — scalar 1.0 iff every element is
+    finite (the AMP loss-scaler probe)."""
+    return jnp.all(jnp.isfinite(data)).astype(jnp.float32)
+
+
+@register("amp_multicast", num_outputs=lambda n, kw:
+          int(kw.get("num_outputs", n or 1)))
+def amp_multicast(*args, num_outputs=None, cast_narrow=False):
+    """parity: tensor/amp_cast.cc AMPMultiCast — cast every input to the
+    widest (or narrowest, cast_narrow=True) dtype among them."""
+    dtypes = [a.dtype for a in args]
+    target = dtypes[0]
+    order = {jnp.dtype(jnp.float16): 0, jnp.dtype(jnp.bfloat16): 0,
+             jnp.dtype(jnp.float32): 1, jnp.dtype(jnp.float64): 2}
+    for dt in dtypes[1:]:
+        a, b = order.get(jnp.dtype(dt), 1), order.get(jnp.dtype(target), 1)
+        if (a < b) if cast_narrow else (a > b):
+            target = dt
+    return tuple(a.astype(target) for a in args)
+
+
+@register("reset_arrays", num_outputs=lambda n, kw:
+          int(kw.get("num_arrays", n or 1)), differentiable=False)
+def reset_arrays(*args, num_arrays=1):
+    """parity: contrib/reset_arrays.cc — zero every input (functional:
+    returns zeroed tensors; callers rebind)."""
+    return tuple(jnp.zeros_like(a) for a in args)
+
+
+@register("_multi_adamw_update",
+          num_outputs=lambda n, kw: 3 * _multi_n(kw),
+          aliases=("multi_adamw_update",))
+def multi_adamw_update(*args, lrs=(), wds=(), etas=(), beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                       num_weights=1):
+    """args = [w0, g0, mean0, var0, ...] + [rescale_grad] (tensor).
+    parity: contrib/adamw.cc multi_adamw_update."""
+    rescale = jnp.reshape(args[-1], ())
+    ws, ms, vs = [], [], []
+    for i in range(num_weights):
+        w, g, m, v = args[4 * i:4 * i + 4]
+        gg = g * rescale
+        if clip_gradient is not None and clip_gradient > 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        w2, m2, v2 = _adamw_core(w, gg, m, v, lrs[i], beta1, beta2,
+                                 epsilon, wds[i], etas[i], rescale)
+        ws.append(w2)
+        ms.append(m2)
+        vs.append(v2)
+    return tuple(ws + ms + vs)
+
+
+@register("_multi_mp_adamw_update",
+          num_outputs=lambda n, kw: 4 * _multi_n(kw),
+          aliases=("multi_mp_adamw_update",))
+def multi_mp_adamw_update(*args, lrs=(), wds=(), etas=(), beta1=0.9,
+                          beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                          num_weights=1):
+    """args = [w0, g0, mean0, var0, w32_0, ...] + [rescale_grad]."""
+    rescale = jnp.reshape(args[-1], ())
+    ws, ms, vs, w32s = [], [], [], []
+    for i in range(num_weights):
+        w, g, m, v, w32 = args[5 * i:5 * i + 5]
+        gg = g.astype(jnp.float32) * rescale
+        if clip_gradient is not None and clip_gradient > 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        w32_2, m2, v2 = _adamw_core(w32, gg, m, v, lrs[i], beta1, beta2,
+                                    epsilon, wds[i], etas[i], rescale)
+        ws.append(w32_2.astype(w.dtype))
+        ms.append(m2)
+        vs.append(v2)
+        w32s.append(w32_2)
+    return tuple(ws + ms + vs + w32s)
+
+
+@register("_multi_lamb_update",
+          num_outputs=lambda n, kw: 3 * _multi_n(kw),
+          aliases=("multi_lamb_update",))
+def multi_lamb_update(*args, learning_rates=(), wds=(), beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, step_count=(),
+                      bias_correction=True, rescale_grad=1.0,
+                      lower_bound=-1.0, upper_bound=-1.0,
+                      clip_gradient=-1.0, num_tensors=1, num_weights=None):
+    """args = [w0, g0, mean0, var0, ...]; parity: contrib/multi_lamb.cc —
+    full LAMB (phase1+trust ratio+phase2) per tensor in one executable."""
+    n = num_weights or num_tensors
+    ws, ms, vs = [], [], []
+    for i in range(n):
+        w, g, m, v = args[4 * i:4 * i + 4]
+        t = step_count[i] if step_count else 1
+        upd, m2, v2 = lamb_update_phase1.fn(
+            w, g, m, v, beta1=beta1, beta2=beta2, epsilon=epsilon, t=t,
+            bias_correction=bias_correction, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        r1 = jnp.sqrt(jnp.sum(jnp.square(w)))
+        r2 = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        w2 = lamb_update_phase2.fn(w, upd, r1, r2, lr=learning_rates[i],
+                                   lower_bound=lower_bound,
+                                   upper_bound=upper_bound)
+        ws.append(w2)
+        ms.append(m2)
+        vs.append(v2)
+    return tuple(ws + ms + vs)
+
+
+@register("_multi_mp_lamb_update",
+          num_outputs=lambda n, kw: 4 * _multi_n(kw),
+          aliases=("multi_mp_lamb_update",))
+def multi_mp_lamb_update(*args, learning_rates=(), wds=(), beta1=0.9,
+                         beta2=0.999, epsilon=1e-6, step_count=(),
+                         bias_correction=True, rescale_grad=1.0,
+                         lower_bound=-1.0, upper_bound=-1.0,
+                         clip_gradient=-1.0, num_tensors=1,
+                         num_weights=None):
+    """args = [w0, g0, mean0, var0, w32_0, ...]."""
+    n = num_weights or num_tensors
+    ws, ms, vs, w32s = [], [], [], []
+    for i in range(n):
+        w, g, m, v, w32 = args[5 * i:5 * i + 5]
+        t = step_count[i] if step_count else 1
+        upd, m2, v2 = lamb_update_phase1.fn(
+            w32, g.astype(jnp.float32), m, v, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, t=t, bias_correction=bias_correction,
+            wd=wds[i], rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        r1 = jnp.sqrt(jnp.sum(jnp.square(w32)))
+        r2 = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        w32_2 = lamb_update_phase2.fn(w32, upd, r1, r2,
+                                      lr=learning_rates[i],
+                                      lower_bound=lower_bound,
+                                      upper_bound=upper_bound)
+        ws.append(w32_2.astype(w.dtype))
+        ms.append(m2)
+        vs.append(v2)
+        w32s.append(w32_2)
+    return tuple(ws + ms + vs + w32s)

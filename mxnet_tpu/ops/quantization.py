@@ -128,3 +128,175 @@ def _quantized_conv(data, weight, scale, bias=None, kernel=(), stride=(),
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out
+
+
+# ---------------------------------------------- quantized op tail ----------
+# parity: quantized_activation.cc, quantized_concat.cc,
+# quantized_elemwise_add/mul.cc, quantized_flatten.cc,
+# quantized_pooling.cc, quantized_batch_norm.cc, quantized_embedding
+# (quantized_indexing_op.cc), quantize_asym. Contract everywhere:
+# (int8 data, min_range, max_range) in, (int8 out, min, max) out.
+
+@register("_contrib_quantized_act", num_outputs=3)
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    if act_type != "relu":
+        return data, min_data, max_data
+    # the clipped range (0, max) has a new scale — requantize the payload,
+    # not just the range metadata
+    s_in = _scale(min_data, max_data)
+    min_out = jnp.maximum(min_data, 0.0)
+    s_out = _scale(min_out, max_data)
+    q = jnp.maximum(data, 0).astype(jnp.float32) * (s_in / s_out)
+    return jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8), \
+        min_out, max_data
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_concat", num_outputs=3)
+def _quantized_concat(*args, dim=1, num_args=None):
+    """args = [d0, d1, ..., min0, max0, min1, max1, ...] (reference input
+    layout: all data first, then min/max pairs). Requantizes every input
+    to the widest range before concatenating."""
+    n = len(args) // 3
+    datas, mins, maxs = args[:n], args[n::2][:n], args[n + 1::2][:n]
+    min_out = mins[0]
+    max_out = maxs[0]
+    for m in mins[1:]:
+        min_out = jnp.minimum(min_out, m)
+    for m in maxs[1:]:
+        max_out = jnp.maximum(max_out, m)
+    s_out = _scale(min_out, max_out)
+    parts = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        s_in = _scale(mn, mx)
+        parts.append(_quantize(d.astype(jnp.float32) * s_in, s_out))
+    return jnp.concatenate(parts, axis=dim), min_out, max_out
+
+
+@register("_contrib_quantized_elemwise_add", num_outputs=3)
+def _quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    sl = _scale(lhs_min, lhs_max)
+    sr = _scale(rhs_min, rhs_max)
+    out = lhs.astype(jnp.float32) * sl + rhs.astype(jnp.float32) * sr
+    min_out = jnp.min(out).astype(jnp.float32)
+    max_out = jnp.max(out).astype(jnp.float32)
+    s = _scale(min_out, max_out)
+    return _quantize(out, s), min_out, max_out
+
+
+@register("_contrib_quantized_elemwise_mul", num_outputs=3)
+def _quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    sl = _scale(lhs_min, lhs_max)
+    sr = _scale(rhs_min, rhs_max)
+    out = (lhs.astype(jnp.float32) * sl) * (rhs.astype(jnp.float32) * sr)
+    min_out = jnp.min(out).astype(jnp.float32)
+    max_out = jnp.max(out).astype(jnp.float32)
+    s = _scale(min_out, max_out)
+    return _quantize(out, s), min_out, max_out
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                       pool_type="max", stride=(1, 1), pad=(0, 0),
+                       global_pool=False, pooling_convention="valid"):
+    """int8 pooling: max-pool stays in int8 (order-preserving); avg-pool
+    accumulates in int32 like the reference."""
+    from .nn import _pooling
+
+    if pool_type == "max":
+        out = _pooling.fn(data.astype(jnp.float32), kernel=kernel,
+                          pool_type="max", stride=stride, pad=pad,
+                          global_pool=global_pool,
+                          pooling_convention=pooling_convention)
+        return out.astype(jnp.int8), min_data, max_data
+    out = _pooling.fn(data.astype(jnp.float32), kernel=kernel,
+                      pool_type=pool_type, stride=stride, pad=pad,
+                      global_pool=global_pool,
+                      pooling_convention=pooling_convention)
+    return jnp.clip(jnp.round(out), -127, 127).astype(jnp.int8), \
+        min_data, max_data
+
+
+@register("_contrib_quantized_batch_norm", num_outputs=3)
+def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          min_data, max_data, eps=1e-3, min_calib_range=None,
+                          max_calib_range=None, **kw):
+    s_in = _scale(min_data, max_data)
+    x = data.astype(jnp.float32) * s_in
+    shape = [1, -1] + [1] * (data.ndim - 2)
+    inv = gamma / jnp.sqrt(moving_var + eps)
+    out = (x - moving_mean.reshape(shape)) * inv.reshape(shape) + \
+        beta.reshape(shape)
+    if min_calib_range is not None:
+        min_o = jnp.float32(min_calib_range)
+        max_o = jnp.float32(max_calib_range)
+    else:
+        min_o = jnp.min(out).astype(jnp.float32)
+        max_o = jnp.max(out).astype(jnp.float32)
+    return _quantize(out, _scale(min_o, max_o)), min_o, max_o
+
+
+@register("_contrib_quantized_embedding", num_outputs=3)
+def _quantized_embedding(data, weight, min_weight, max_weight,
+                         input_dim=None, output_dim=None):
+    out = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    return out, min_weight, max_weight
+
+
+@register("_contrib_quantize_asym", num_outputs=3)
+def _quantize_asym(data, min_calib_range=None, max_calib_range=None):
+    """parity: quantize_asym-inl.h — affine uint8-style quantization
+    (scale + shift), returned as (int8 out, scale, shift)."""
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.min(data).astype(jnp.float32)
+        max_r = jnp.max(data).astype(jnp.float32)
+    else:
+        min_r = jnp.float32(min_calib_range)
+        max_r = jnp.float32(max_calib_range)
+    rng = jnp.where(max_r > min_r, max_r - min_r, 1.0)
+    scale = 255.0 / rng
+    shift = -min_r * scale - 128.0
+    q = jnp.clip(jnp.round(data * scale + shift), -128, 127)
+    return q.astype(jnp.int8), scale, shift
+
+
+@register("_contrib_calibrate_entropy", num_outputs=2)
+def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """parity: calibrate.cc — KL-divergence threshold selection over a
+    collected histogram; returns (min, max) calibration thresholds."""
+    # Symmetric search: evaluate thresholds at every bin boundary from the
+    # center out, pick the one minimizing KL(P || quantized P).
+    n_bins = hist.shape[0]
+    hist_f = hist.astype(jnp.float32)
+    centers = (hist_edges[:-1] + hist_edges[1:]) / 2.0
+    abs_max = jnp.maximum(jnp.abs(hist_edges[0]), jnp.abs(hist_edges[-1]))
+
+    def kl_for(threshold):
+        inside = jnp.abs(centers) <= threshold
+        p = jnp.where(inside, hist_f, 0.0)
+        outliers = jnp.sum(hist_f) - jnp.sum(p)
+        p = p + jnp.where(inside, outliers / jnp.maximum(
+            jnp.sum(inside), 1), 0.0)
+        # quantize into num_quantized_bins buckets then expand back
+        bucket = jnp.clip(((jnp.abs(centers) / jnp.maximum(threshold, 1e-12))
+                           * (num_quantized_bins - 1)).astype(jnp.int32),
+                          0, num_quantized_bins - 1)
+        q_sum = jax.ops.segment_sum(p, bucket, num_quantized_bins)
+        q_cnt = jax.ops.segment_sum(jnp.where(inside, 1.0, 0.0), bucket,
+                                    num_quantized_bins)
+        q = jnp.where(q_cnt > 0, q_sum / jnp.maximum(q_cnt, 1.0), 0.0)[bucket]
+        q = jnp.where(inside, q, 0.0)
+        p_n = p / jnp.maximum(jnp.sum(p), 1e-12)
+        q_n = q / jnp.maximum(jnp.sum(q), 1e-12)
+        return jnp.sum(jnp.where((p_n > 0) & (q_n > 0),
+                                 p_n * jnp.log(p_n / q_n), 0.0))
+
+    n_cand = 64
+    cands = jnp.linspace(abs_max / n_cand, abs_max, n_cand)
+    kls = jax.vmap(kl_for)(cands)
+    best = cands[jnp.argmin(kls)]
+    return -best, best

@@ -478,3 +478,182 @@ def _fill_element_0index(lhs, mhs, rhs):
 def _argmax_channel(data):
     """parity: broadcast_reduce_op_index.cc argmax_channel."""
     return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ------------------------------------------------------ legacy tail 2 ------
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def _add_n(*args, num_args=None):
+    """parity: tensor/elemwise_sum.cc — sum of N tensors in one op."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("moments", num_outputs=2)
+def _moments(data, axes=(), keepdims=False):
+    """parity: nn/moments.cc — (mean, variance) over `axes` in one pass."""
+    ax = tuple(axes) if axes else None
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    mk = mean if keepdims or ax is None else jnp.expand_dims(mean, ax)
+    var = jnp.mean(jnp.square(data - jnp.reshape(mk, mk.shape)), axis=ax,
+                   keepdims=keepdims)
+    return mean, var
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    """parity: loss_binary_op.cc softmax_cross_entropy — summed CE of
+    softmax(data) against integer labels."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[..., None], axis=-1)
+    return -jnp.sum(picked)
+
+
+@register("_histogram", num_outputs=2, differentiable=False,
+          aliases=("histogram",))
+def _histogram_op(data, bins=None, bin_cnt=10, range=None):
+    """parity: tensor/histogram.cc — counts + bin edges. `bins` may be an
+    explicit edge tensor (second input in the reference); otherwise
+    `bin_cnt` uniform bins over `range` (defaults to data min/max)."""
+    if bins is not None:
+        edges = bins
+        hist = jnp.histogram(data.reshape(-1), bins=edges)[0]
+        return hist, edges
+    lo, hi = (range if range is not None
+              else (jnp.min(data), jnp.max(data)))
+    hist, edges = jnp.histogram(data.reshape(-1), bins=int(bin_cnt),
+                                range=(lo, hi))
+    return hist, edges
+
+
+@register("col2im")
+def _col2im(data, output_size=(), kernel=(), stride=(1, 1), dilate=(1, 1),
+            pad=(0, 0)):
+    """parity: nn/im2col.cc col2im — fold sliding-window columns back into
+    the image by summing overlaps (the transpose of im2col)."""
+    n, ckk, l = data.shape
+    kh, kw = kernel
+    c = ckk // (kh * kw)
+    oh, ow = output_size
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    hpad, wpad = oh + 2 * ph, ow + 2 * pw
+    out_h = (hpad - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (wpad - (dw * (kw - 1) + 1)) // sw + 1
+    cols = data.reshape(n, c, kh, kw, out_h, out_w)
+    img = jnp.zeros((n, c, hpad, wpad), data.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            img = img.at[:, :, i * dh:i * dh + sh * out_h:sh,
+                         j * dw:j * dw + sw * out_w:sw].add(
+                cols[:, :, i, j])
+    return img[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@register("_slice_assign")
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """parity: matrix_op.cc _slice_assign — functional slice write (the
+    NDArray setitem fast path)."""
+    idx = tuple(slice(b if b is not None else None,
+                      e if e is not None else None,
+                      s if s else None)
+                for b, e, s in zip(begin, end,
+                                   step or (None,) * len(begin)))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_slice_assign_scalar")
+def _slice_assign_scalar(lhs, scalar=0.0, begin=(), end=(), step=()):
+    idx = tuple(slice(b if b is not None else None,
+                      e if e is not None else None,
+                      s if s else None)
+                for b, e, s in zip(begin, end,
+                                   step or (None,) * len(begin)))
+    return lhs.at[idx].set(scalar)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices):
+    """parity: indexing_op.cc _scatter_set_nd — advanced-index write."""
+    return lhs.at[tuple(indices.astype(jnp.int32))].set(rhs)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*args, dim=0, num_args=None):
+    """parity: rnn.cc _rnn_param_concat — flat fused-parameter pack."""
+    return jnp.concatenate([a.reshape(-1) if dim == 0 else a for a in args],
+                           axis=0 if dim == 0 else dim)
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """parity: elemwise_unary_op_basic.cc — identity of lhs, shape/stype
+    attrs borrowed from rhs during inference (shapes already agree here)."""
+    return lhs
+
+
+@register("cast_storage", eager=True)
+def _cast_storage(data, stype="default"):
+    """parity: tensor/cast_storage.cc. Dense XLA buffers back every
+    storage type here (ndarray/sparse.py wraps them in the row_sparse/csr
+    view classes at the NDArray layer); the op is the dense identity."""
+    return data
+
+
+# legacy internal creation-op names (init_op.cc registrations; the public
+# nd.zeros/ones/arange route here too)
+
+@register("_zeros", differentiable=False,
+          aliases=("_zeros_without_dtype",))
+def _zeros_op(shape=(), dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.zeros(tuple(shape), canonical_dtype(dtype or "float32"))
+
+
+@register("_ones", differentiable=False)
+def _ones_op(shape=(), dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.ones(tuple(shape), canonical_dtype(dtype or "float32"))
+
+
+@register("_full", differentiable=False)
+def _full_op(shape=(), value=0.0, dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.full(tuple(shape), value, canonical_dtype(dtype or "float32"))
+
+
+@register("_arange", differentiable=False)
+def _arange_op(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+               dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    out = jnp.arange(start, stop, step, canonical_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", differentiable=False)
+def _linspace_op(start=0.0, stop=1.0, num=50, endpoint=True,
+                 dtype="float32", ctx=None):
+    from ..base import canonical_dtype
+
+    return jnp.linspace(start, stop, int(num),
+                        endpoint=endpoint).astype(canonical_dtype(dtype))
+
+
+@register("_sparse_retain")
+def _sparse_retain_op(data, indices):
+    """parity: sparse_retain.cc — keep only the listed rows (dense
+    emitter; the NDArray layer keeps row_sparse structure)."""
+    mask = jnp.zeros((data.shape[0],), bool).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data,
+                     jnp.zeros_like(data))

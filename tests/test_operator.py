@@ -321,3 +321,135 @@ def test_legacy_tail_ops():
                           scale=2, num_args=2)
     assert um.shape == (1, 3, 4, 4)
     assert float(mx.nd.digamma(mx.nd.array([1.0])).asscalar()) < 0
+
+
+def test_multi_tensor_optimizer_ops():
+    """multi_sgd/preloaded/multi_lamb/adamw families (parity:
+    optimizer_op.cc MultiSGDUpdate, contrib/adamw.cc, multi_lamb.cc)."""
+    rs = np.random.RandomState(0)
+    w1, g1 = rs.rand(3).astype("f"), rs.rand(3).astype("f")
+    w2, g2 = rs.rand(2).astype("f"), rs.rand(2).astype("f")
+    o = mx.nd.multi_sgd_update(mx.nd.array(w1), mx.nd.array(g1),
+                               mx.nd.array(w2), mx.nd.array(g2),
+                               lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                               num_weights=2)
+    np.testing.assert_allclose(o[0].asnumpy(), w1 - 0.1 * g1, rtol=1e-5)
+    np.testing.assert_allclose(o[1].asnumpy(), w2 - 0.2 * g2, rtol=1e-5)
+    op = mx.nd.preloaded_multi_sgd_update(
+        mx.nd.array(w1), mx.nd.array(g1), mx.nd.array(w2), mx.nd.array(g2),
+        mx.nd.array([0.1, 0.2]), mx.nd.array([0.0, 0.0]), num_weights=2)
+    np.testing.assert_allclose(op[0].asnumpy(), o[0].asnumpy(), rtol=1e-6)
+
+    # adamw: loss-scale skip contract — non-finite rescale = no update
+    w = mx.nd.array(rs.rand(4).astype("f"))
+    g = mx.nd.array(rs.rand(4).astype("f"))
+    m, v = mx.nd.zeros((4,)), mx.nd.zeros((4,))
+    upd = mx.nd.adamw_update(w, g, m, v, mx.nd.array([1.0]), lr=0.1)
+    assert not np.allclose(upd[0].asnumpy(), w.asnumpy())
+    skip = mx.nd.adamw_update(w, g, m, v, mx.nd.array([np.inf]), lr=0.1)
+    np.testing.assert_allclose(skip[0].asnumpy(), w.asnumpy())
+
+    ml = mx.nd.multi_lamb_update(
+        mx.nd.array(w1), mx.nd.array(g1), mx.nd.zeros((3,)),
+        mx.nd.zeros((3,)), learning_rates=(0.01,), wds=(0.0,),
+        step_count=(1,), num_tensors=1)
+    assert len(ml) == 3 and not np.allclose(ml[0].asnumpy(), w1)
+
+    # all_finite / reset_arrays / amp_multicast
+    assert float(mx.nd.all_finite(mx.nd.array([1.0, 2.0])).asscalar()) == 1
+    assert float(mx.nd.all_finite(
+        mx.nd.array([1.0, np.inf])).asscalar()) == 0
+    z = mx.nd.reset_arrays(mx.nd.ones((2,)), mx.nd.ones((3,)),
+                           num_arrays=2)
+    assert z[0].asnumpy().sum() == 0 and z[1].asnumpy().sum() == 0
+    outs = mx.nd.amp_multicast(mx.nd.ones((2,)).astype("float16"),
+                               mx.nd.ones((2,)), num_outputs=2)
+    assert str(outs[0].dtype) == "float32"
+
+
+def test_quantized_op_tail():
+    """quantized act/flatten/concat/elemwise/pooling + asym quantize + KL
+    calibration (parity: src/operator/quantization/)."""
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 4).astype("f")
+    q, mn, mxr = mx.nd._contrib_quantize_v2(mx.nd.array(x))
+    scale = max(abs(float(mn.asscalar())), abs(float(mxr.asscalar()))) / 127
+    deq = mx.nd._contrib_dequantize(q, mn, mxr)
+    np.testing.assert_allclose(deq.asnumpy(), x, atol=scale * 1.01)
+    a = mx.nd._contrib_quantized_act(q, mn, mxr)
+    assert int(a[0].asnumpy().min()) >= 0
+    f = mx.nd._contrib_quantized_flatten(q, mn, mxr)
+    assert f[0].shape == (2, 4)
+    cc = mx.nd._contrib_quantized_concat(q, q, mn, mxr, mn, mxr, dim=1)
+    assert cc[0].shape == (2, 8)
+    ea = mx.nd._contrib_quantized_elemwise_add(q, q, mn, mxr, mn, mxr)
+    np.testing.assert_allclose(
+        mx.nd._contrib_dequantize(ea[0], ea[1], ea[2]).asnumpy(),
+        2 * x, atol=4 * scale)
+    qa = mx.nd._contrib_quantize_asym(mx.nd.array(x))
+    assert str(qa[0].dtype) == "int8"
+    h, e = mx.nd._histogram(mx.nd.array(x), bin_cnt=32, range=(-3, 3))
+    lo, hi = mx.nd._contrib_calibrate_entropy(h, e)
+    assert float(hi.asscalar()) > 0 > float(lo.asscalar())
+
+
+def test_transformer_interleaved_matmuls():
+    """parity: contrib/transformer.cc interleaved attention matmuls vs
+    einsum oracle."""
+    rs = np.random.RandomState(2)
+    seq, b, h, d = 5, 2, 3, 4
+    qkv = rs.randn(seq, b, 3 * h * d).astype("f")
+    att = mx.nd._contrib_interleaved_matmul_selfatt_qk(mx.nd.array(qkv),
+                                                       heads=h)
+    x = qkv.reshape(seq, b, h, 3, d)
+    q, k, v = x[:, :, :, 0], x[:, :, :, 1], x[:, :, :, 2]
+    ref = np.einsum("qbhd,kbhd->bhqk", q / np.sqrt(d), k) \
+        .reshape(b * h, seq, seq)
+    np.testing.assert_allclose(att.asnumpy(), ref, atol=1e-5)
+    out = mx.nd._contrib_interleaved_matmul_selfatt_valatt(
+        mx.nd.array(qkv), att, heads=h)
+    ref_out = np.einsum("bhqk,kbhd->qbhd", ref.reshape(b, h, seq, seq),
+                        v).reshape(seq, b, h * d)
+    np.testing.assert_allclose(out.asnumpy(), ref_out, atol=1e-5)
+
+
+def test_box_codec_and_matching():
+    anchors = np.array([[[0., 0., 2., 2.], [1., 1., 3., 3.]]], "f")
+    dec = mx.nd._contrib_box_decode(mx.nd.array(np.zeros((1, 2, 4), "f")),
+                                    mx.nd.array(anchors))
+    np.testing.assert_allclose(dec.asnumpy(), anchors, atol=1e-5)
+    data = np.array([[[0.9, 0.1], [0.8, 0.75]]], "f")
+    rowm, colm = mx.nd._contrib_bipartite_matching(mx.nd.array(data),
+                                                   threshold=0.0)
+    assert rowm.asnumpy().tolist() == [[0.0, 1.0]]
+    assert colm.asnumpy().tolist() == [[0.0, 1.0]]
+
+
+def test_npi_tail_and_image_ops():
+    rs = np.random.RandomState(3)
+    np.testing.assert_allclose(mx.nd._npi_hanning(M=5).asnumpy(),
+                               np.hanning(5), atol=1e-6)
+    assert mx.nd._npi_delete(mx.nd.array([1., 2., 3.]),
+                             obj=1).asnumpy().tolist() == [1., 3.]
+    parts = mx.nd._npi_hsplit(mx.nd.ones((2, 6)), indices_or_sections=3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    assert mx.nd._npi_ediff1d(mx.nd.array([1., 4., 9.]),
+                              to_begin=0.0).asnumpy().tolist() == [0., 3., 5.]
+    img = mx.nd.array(rs.randint(0, 255, (4, 6, 3)).astype("uint8"))
+    t = mx.nd._image_to_tensor(img)
+    assert t.shape == (3, 4, 6) and float(t.asnumpy().max()) <= 1.0
+    assert mx.nd._image_resize(img, size=(3, 2)).shape == (2, 3, 3)
+    assert mx.nd._image_crop(img, x=1, y=1, width=3,
+                             height=2).shape == (2, 3, 3)
+    # legacy creation + sparse_retain
+    assert mx.nd.invoke("_arange", start=0.0, stop=3.0,
+                        repeat=2).asnumpy().tolist() == [0, 0, 1, 1, 2, 2]
+    sr = mx.nd._sparse_retain(
+        mx.nd.array(np.arange(6, dtype="f").reshape(3, 2)),
+        mx.nd.array([0, 2]))
+    assert sr.asnumpy()[1].tolist() == [0, 0]
+    a = rs.rand(3, 3).astype("f")
+    a = a @ a.T + 3 * np.eye(3, dtype="f")
+    np.testing.assert_allclose(
+        mx.nd._linalg_det(mx.nd.array(a)).asnumpy(),
+        np.linalg.det(a), rtol=1e-4)
