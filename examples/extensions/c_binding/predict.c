@@ -1,0 +1,87 @@
+/* Standalone-inference demo over the MXPred C ABI (parity model:
+ * the reference's c_predict_api consumers, e.g. the C++ image-
+ * classification predictor example).
+ *
+ * Usage: predict <symbol.json path> <params path> — prints the argmax of
+ * a fixed all-ones input. Built and driven by tests/test_c_api.py.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(call)                                              \
+  do {                                                           \
+    if ((call) != 0) {                                           \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError()); \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: predict symbol.json model.params\n");
+    return 2;
+  }
+  long sym_size = 0, param_size = 0;
+  char *symbol_json = read_file(argv[1], &sym_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!symbol_json || !params) {
+    fprintf(stderr, "FAIL reading model files\n");
+    return 1;
+  }
+
+  const char *input_keys[1] = {"data"};
+  int64_t indptr[2] = {0, 2};
+  int64_t shape_data[2] = {1, 8}; /* batch 1, 8 features */
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreate(symbol_json, params, (int)param_size, 1, 0, 1,
+                     input_keys, indptr, shape_data, &pred));
+
+  float input[8];
+  for (int i = 0; i < 8; ++i) input[i] = 1.0f;
+  CHECK(MXPredSetInput(pred, "data", input, sizeof(input)));
+  CHECK(MXPredForward(pred));
+
+  int ndim = 0;
+  const int64_t *oshape = NULL;
+  CHECK(MXPredGetOutputShape(pred, 0, &ndim, &oshape));
+  if (ndim != 2 || oshape[0] != 1) {
+    fprintf(stderr, "FAIL output shape\n");
+    return 1;
+  }
+  int classes = (int)oshape[1];
+  float *out = (float *)malloc(sizeof(float) * classes);
+  CHECK(MXPredGetOutput(pred, 0, out, sizeof(float) * classes));
+  int best = 0;
+  float sum = 0.0f;
+  for (int i = 0; i < classes; ++i) {
+    sum += out[i];
+    if (out[i] > out[best]) best = i;
+  }
+  printf("argmax=%d sum=%.4f\n", best, sum);
+  CHECK(MXPredFree(pred));
+  free(out);
+  free(params);
+  free(symbol_json);
+  printf("PREDICT OK\n");
+  return 0;
+}

@@ -76,6 +76,26 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        const char **param_keys, const char **param_vals);
 int MXHandleArrayFree(NDArrayHandle *handles);
 
+/* predictor (standalone inference; parity: c_predict_api.h) ----------- */
+
+typedef void *PredictorHandle;
+
+/* input_shape_indptr has num_input_nodes+1 entries delimiting each
+ * input's dims inside input_shape_data (the reference's CSR layout) */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 int num_input_nodes, const char **input_keys,
+                 const int64_t *input_shape_indptr,
+                 const int64_t *input_shape_data, PredictorHandle *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const void *data, int64_t nbytes);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, int index, int *out_ndim,
+                         const int64_t **out_pdata);
+int MXPredGetOutput(PredictorHandle handle, int index, void *data,
+                    int64_t nbytes);
+int MXPredFree(PredictorHandle handle);
+
 #ifdef __cplusplus
 }
 #endif

@@ -96,3 +96,76 @@ def list_ops():
 
 def waitall():
     mx.nd.waitall()
+
+
+# ----------------------------------------------------------- predictor -----
+# parity: src/c_api/c_predict_api.cc — the standalone inference ABI
+# (MXPredCreate / SetInput / Forward / GetOutput). A predictor is a bound
+# symbolic executor over a checkpoint, driven entirely through C.
+
+class _Predictor:
+    def __init__(self, symbol_json, param_bytes, input_names, input_shapes):
+        import io
+
+        from mxnet_tpu import symbol as sym_mod
+        from mxnet_tpu.model import load_params
+
+        sym = sym_mod.load_json(symbol_json)
+        if param_bytes:
+            import tempfile
+
+            with tempfile.NamedTemporaryFile(suffix=".params") as f:
+                f.write(param_bytes)
+                f.flush()
+                arg_params, aux_params = load_params(f.name)
+        else:
+            arg_params, aux_params = {}, {}
+        shapes = {n: tuple(int(d) for d in s)
+                  for n, s in zip(input_names, input_shapes)}
+        self._input_names = list(input_names)
+        self._exe = sym.simple_bind(mx.cpu(), **shapes)
+        self._exe.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=True)
+        self._inputs = {n: mx.nd.zeros(shapes[n]) for n in input_names}
+        self._outputs = None
+
+    def set_input(self, name, buf):
+        nd = self._inputs[name]
+        copy_from_bytes(nd, buf)
+
+    def forward(self):
+        self._outputs = self._exe.forward(**self._inputs)
+
+    def num_outputs(self):
+        return len(self._exe.outputs if self._outputs is None
+                   else self._outputs)
+
+    def output(self, index):
+        outs = self._outputs if self._outputs is not None \
+            else self._exe.outputs
+        return outs[index]
+
+
+def pred_create(symbol_json, param_bytes, input_names, input_shapes):
+    return _Predictor(symbol_json, param_bytes, list(input_names),
+                      list(input_shapes))
+
+
+def pred_set_input(pred, name, buf):
+    pred.set_input(name, buf)
+
+
+def pred_forward(pred):
+    pred.forward()
+
+
+def pred_num_outputs(pred):
+    return pred.num_outputs()
+
+
+def pred_output_shape(pred, index):
+    return shape(pred.output(index))
+
+
+def pred_output_bytes(pred, index):
+    return to_bytes(pred.output(index))

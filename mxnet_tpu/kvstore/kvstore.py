@@ -277,6 +277,10 @@ class _DistKVStore(KVStore):
             agg = vals[0]
             for v in vals[1:]:
                 agg = self._merge(agg, v)
+            if self._procs > 1 and self._type == "dist_async" \
+                    and self._updater is not None:
+                self._async_push(k, agg)
+                continue
             if self._procs > 1:
                 from ..ndarray.sparse import RowSparseNDArray
 
@@ -294,6 +298,22 @@ class _DistKVStore(KVStore):
                 self._pending_setdefault(k)
                 self._pending[k] = agg if self._pending[k] is None \
                     else self._merge(self._pending[k], agg)
+
+    def _async_push(self, k, agg):
+        """dist_async optimizer-on-store semantics (parity:
+        kvstore_dist_server.h:325-346 ApplyUpdates in async mode): every
+        worker's push is a SEPARATE optimizer step on the store — N pushes
+        mean N updates, not one update on the summed gradient. The updates
+        are applied in rank order on every worker, which keeps replicas
+        bit-identical while preserving the async statistical semantics
+        (the reference's server applies them in arrival order instead)."""
+        from ..ndarray import NDArray
+        from jax.experimental.multihost_utils import process_allgather
+
+        gathered = process_allgather(agg._data)  # (procs, ...) per-worker
+        idx = self._key_index(k)
+        for r in range(self._procs):
+            self._updater(idx, NDArray(gathered[r]), self._store[k])
 
     def _proc_mesh(self):
         """One-device-per-process mesh (cached): the reduction axis spans

@@ -328,3 +328,130 @@ int MXHandleArrayFree(NDArrayHandle *handles) {
 }
 
 }  // extern "C"
+
+// ----------------------------------------------------------- predictor -----
+// parity: src/c_api/c_predict_api.cc (MXPredCreate/SetInput/Forward/
+// GetOutput/Free) — the standalone inference surface.
+
+extern "C" {
+
+typedef void *PredictorHandle;
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 int num_input_nodes, const char **input_keys,
+                 const int64_t *input_shape_indptr,
+                 const int64_t *input_shape_data, PredictorHandle *out) {
+  (void)dev_type;
+  (void)dev_id;
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *names = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (int i = 0; i < num_input_nodes; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(input_keys[i]));
+    int64_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shp = PyTuple_New(static_cast<Py_ssize_t>(hi - lo));
+    for (int64_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, static_cast<Py_ssize_t>(j - lo),
+                       PyLong_FromLongLong(input_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject *args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(symbol_json_str));
+  PyTuple_SET_ITEM(args, 1,
+                   PyBytes_FromStringAndSize(
+                       static_cast<const char *>(param_bytes), param_size));
+  PyTuple_SET_ITEM(args, 2, names);
+  PyTuple_SET_ITEM(args, 3, shapes);
+  PyObject *r = bridge_call("pred_create", args);
+  if (r == nullptr) return -1;
+  *out = static_cast<PredictorHandle>(r);
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const void *data, int64_t nbytes) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 1, PyUnicode_FromString(key));
+  PyTuple_SET_ITEM(args, 2,
+                   PyBytes_FromStringAndSize(
+                       static_cast<const char *>(data),
+                       static_cast<Py_ssize_t>(nbytes)));
+  PyObject *r = bridge_call("pred_set_input", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyObject *r = bridge_call("pred_forward", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, int index, int *out_ndim,
+                         const int64_t **out_pdata) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(index));
+  PyObject *r = bridge_call("pred_output_shape", args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  tls_shape.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls_shape[static_cast<size_t>(i)] =
+        PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  Py_DECREF(r);
+  *out_ndim = static_cast<int>(n);
+  *out_pdata = tls_shape.data();
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, int index, void *data,
+                    int64_t nbytes) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject *>(handle));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(index));
+  PyObject *r = bridge_call("pred_output_bytes", args);
+  if (r == nullptr) return -1;
+  char *src = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &src, &len) != 0 ||
+      len != static_cast<Py_ssize_t>(nbytes)) {
+    if (len != static_cast<Py_ssize_t>(nbytes))
+      tls_error = "MXPredGetOutput: byte-size mismatch";
+    else
+      set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  std::memcpy(data, src, static_cast<size_t>(nbytes));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  if (handle == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+}  // extern "C"

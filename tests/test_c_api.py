@@ -59,3 +59,64 @@ def test_c_host_program_end_to_end(capi_lib):
     # the ABI exposes the full op registry
     ops_line = [l for l in proc.stdout.splitlines() if l.startswith("ops=")]
     assert ops_line and int(ops_line[0].split("=")[1]) > 400
+
+
+@pytest.fixture(scope="module")
+def predict_exe(capi_lib):
+    build = os.path.dirname(capi_lib)
+    gcc = shutil.which("gcc") or shutil.which("g++")
+    exe = os.path.join(build, "predict")
+    subprocess.run(
+        [gcc, os.path.join(REPO, "examples", "extensions", "c_binding",
+                           "predict.c"),
+         "-I", os.path.join(REPO, "include"),
+         "-L", build, "-lmxtpu", f"-Wl,-rpath,{build}", "-o", exe],
+        check=True, capture_output=True)
+    return exe
+
+
+def test_predict_abi_end_to_end(predict_exe, tmp_path):
+    """MXPredCreate/SetInput/Forward/GetOutput from pure C against a
+    checkpoint produced by the Python frontend — the deployment handoff
+    the reference's c_predict_api exists for. The C result must match the
+    Python executor bit-for-bit (same executable)."""
+    gen = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "data = mx.sym.var('data')\n"
+        "net = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')\n"
+        "net = mx.sym.Activation(net, act_type='relu')\n"
+        "net = mx.sym.FullyConnected(net, num_hidden=4, name='fc2')\n"
+        "net = mx.sym.softmax(net)\n"
+        "ex = net.simple_bind(mx.cpu(), data=(1, 8))\n"
+        "rs = np.random.RandomState(7)\n"
+        "args = {n: mx.nd.array(rs.randn(*a.shape).astype('f') * 0.3)\n"
+        "        for n, a in ex.arg_dict.items() if n != 'data'}\n"
+        "ex.copy_params_from(args)\n"
+        "out = ex.forward(data=mx.nd.ones((1, 8)))[0].asnumpy()\n"
+        "np.save(%r, out)\n"
+        "from mxnet_tpu.model import save_checkpoint\n"
+        "save_checkpoint(%r, 0, net, args, {})\n"
+    )
+    prefix = str(tmp_path / "mlp")
+    ref_out = str(tmp_path / "ref.npy")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    subprocess.run([os.sys.executable, "-c", gen % (ref_out, prefix)],
+                   check=True, env=env, timeout=300)
+    import numpy as onp
+
+    ref = onp.load(ref_out)
+    env["MXTPU_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [predict_exe, f"{prefix}-symbol.json", f"{prefix}-0000.params"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "PREDICT OK" in proc.stdout
+    argmax_line = [l for l in proc.stdout.splitlines()
+                   if l.startswith("argmax=")][0]
+    c_argmax = int(argmax_line.split("=")[1].split()[0])
+    c_sum = float(argmax_line.split("sum=")[1])
+    assert c_argmax == int(ref.argmax())
+    assert abs(c_sum - float(ref.sum())) < 1e-4  # softmax sums to 1

@@ -185,3 +185,66 @@ def test_two_process_dist_sync_exact_aggregate(tmp_path):
             pytest.skip(f"jax.distributed unavailable: {joined[-300:]}")
         raise AssertionError(joined[-1500:])
     assert all("DIST_OK" in o for o in outs), outs
+
+
+_ASYNC_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=2, process_id=pid)
+    import mxnet_tpu as mx
+    kv = mx.kv.create("dist_async")
+    assert kv.num_workers == 2
+    kv.init("w", mx.nd.zeros((3,)))
+    # sign-SGD updater: nonlinear in the gradient, so per-push updates
+    # (async PS semantics) give a different result than one update on the
+    # summed gradient: async -> -2, sync-sum -> -1
+    def updater(idx, grad, weight):
+        weight[:] = weight - mx.nd.sign(grad)
+    kv._updater = updater
+    g = mx.nd.array([float(kv.rank + 1)] * 3)
+    kv.push("w", g)
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    vals = out.asnumpy().tolist()
+    assert vals == [-2.0] * 3, vals  # two separate sign-steps
+    print("ASYNC_OK", kv.rank)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="distributed tests disabled")
+def test_two_process_dist_async_per_push_updates(tmp_path):
+    """dist_async applies every worker's push as its own optimizer step
+    (kvstore_dist_server.h async ApplyUpdates parity), observable via a
+    gradient-nonlinear updater."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "async_child.py"
+    script.write_text(_ASYNC_CHILD)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), port, str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.getcwd()) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed runtime hung in this environment")
+    if any(p.returncode != 0 for p in procs):
+        joined = "\n".join(outs)
+        if "DISTRIBUTED" in joined.upper() or "initialize" in joined:
+            pytest.skip(f"jax.distributed unavailable: {joined[-300:]}")
+        raise AssertionError(joined[-1500:])
+    assert all("ASYNC_OK" in o for o in outs), outs
