@@ -14,7 +14,9 @@ Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline", ...}:
      extra fields: achieved_tflops + mfu vs BENCH_PEAK_TFLOPS, default 459
      = v5p bf16 peak)
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
-BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS.
+BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
+auto-detected from the chip generation — v5e 197, v5p 459, v4 275, ...;
+an on-chip measured peak is also reported as measured_peak_tflops).
 """
 import json
 import os
@@ -80,7 +82,8 @@ def bench_train(ctx, batch, dtype, iters, model):
                   "resnet18_v1": 1.82, "resnet101_v1": 7.8,
                   "resnet152_v1": 11.5, "vgg16": 15.5, "alexnet": 0.71}
     flops_per_img = 3 * fwd_gflops.get(model, 0.0) * 1e9
-    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", 459.0))
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", 0) or
+                        _nominal_peak_tflops())
 
     mx.random.seed(0)
     net = vision.get_model(model, classes=1000)
@@ -120,6 +123,25 @@ def bench_train(ctx, batch, dtype, iters, model):
             line["measured_peak_tflops"] = round(measured, 1)
             line["mfu_vs_measured"] = round(achieved / measured, 3)
     print(json.dumps(line), flush=True)
+
+
+def _nominal_peak_tflops():
+    """Nominal bf16 peak for the attached chip generation (public specs);
+    overridable via BENCH_PEAK_TFLOPS. Order matters: 'v5 lite'/'v5e'
+    must match before the bare 'v5'."""
+    table = [("v6e", 918.0), ("v6", 918.0), ("v5 lite", 197.0),
+             ("v5e", 197.0), ("v5p", 459.0), ("v5", 459.0),
+             ("v4", 275.0), ("v3", 123.0)]
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        for key, peak in table:
+            if key in kind:
+                return peak
+    except Exception:
+        pass
+    return 459.0
 
 
 def _measure_chip_peak(n=4096, chain=16):

@@ -16,12 +16,15 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .base import MXNetError, maybe_init_distributed as _midi
+from .base import (MXNetError, apply_platform_env as _ape,
+                   maybe_init_distributed as _midi)
 
-# launched by tools/launch.py: join the jax.distributed rendezvous BEFORE
-# anything touches the XLA backend (the only moment it works)
+# both must run BEFORE anything touches the XLA backend (the only moment
+# they work): MXTPU_PLATFORM platform pinning, then the tools/launch.py
+# jax.distributed rendezvous
+_ape()
 _midi()
-del _midi
+del _ape, _midi
 from .context import (Context, cpu, tpu, gpu, cpu_pinned, num_tpus, num_gpus,
                       current_context)
 from . import engine

@@ -83,6 +83,25 @@ class _NameManager(threading.local):
 name_manager = _NameManager()
 
 
+def apply_platform_env():
+    """Honor MXTPU_PLATFORM=cpu|tpu at import time. Environments that
+    pre-import jax with a pinned platform (sitecustomize) ignore a later
+    JAX_PLATFORMS env var, but jax.config.update still wins as long as no
+    backend has been initialised — this is the only portable hook worker
+    processes (tools/launch.py children, embedded C hosts) have."""
+    import os
+
+    plat = os.environ.get("MXTPU_PLATFORM")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass  # backend already initialised — keep its platform
+
+
 def maybe_init_distributed():
     """Join the multi-host rendezvous when launched by tools/launch.py
     (parity: KVStoreDist workers connecting to the dmlc tracker via
