@@ -50,7 +50,8 @@ def __getattr__(name):
                 "symbol", "sym", "module", "mod", "kvstore", "kv",
                 "profiler", "recordio", "callback", "monitor", "model",
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
-                "contrib", "util", "runtime", "onnx", "operator", "library"):
+                "contrib", "util", "runtime", "onnx", "operator", "library",
+                "log"):
         import importlib
 
         try:

@@ -38,4 +38,10 @@ def get_model(name, **kwargs):
     return _models[name](**kwargs)
 
 
+def get_model_names():
+    """Registered model-zoo constructor names (parity helper used by
+    benchmark_score-style scripts)."""
+    return sorted(_models)
+
+
 __all__ = ["get_model"] + sorted(_models)

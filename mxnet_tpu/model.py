@@ -48,3 +48,132 @@ def load_checkpoint(prefix, epoch):
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(f"{prefix}-{epoch:04d}.params")
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy model API (parity: python/mxnet/model.py:555 FeedForward —
+    deprecated in the reference in favor of Module, kept for the scripts
+    that still use it). Thin adapter over :class:`mxnet_tpu.module.Module`.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # ------------------------------------------------------------- train ---
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        import logging as _logging
+
+        from .module import Module
+
+        data = self._as_iter(X, y)
+        mod = Module(self.symbol, context=self.ctx,
+                     logger=logger or _logging)
+        mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer, optimizer_params=self.kwargs,
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=self.allow_extra_params,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch or 1, monitor=monitor)
+        self._module = mod
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+
+        mod = self._require_module(X)
+        out = mod.predict(self._as_iter(X), num_batch=num_batch)
+        return out.asnumpy() if hasattr(out, "asnumpy") else _np.asarray(out)
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        mod = self._require_module(X)
+        res = mod.score(self._as_iter(X), eval_metric, num_batch=num_batch)
+        return res[0][1] if isinstance(res, list) else res
+
+    # ------------------------------------------------------ persistence ---
+    def save(self, prefix, epoch=None):
+        epoch = self.num_epoch if epoch is None else epoch
+        save_checkpoint(prefix, epoch or 0, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """parity: model.py FeedForward.create — construct + fit."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        return model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, logger=logger)
+
+    # ---------------------------------------------------------- helpers ---
+    def _as_iter(self, X, y=None):
+        from .io import NDArrayIter, DataIter
+
+        if isinstance(X, DataIter):
+            if hasattr(X, "reset"):
+                X.reset()
+            return X
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size)
+
+    def _require_module(self, X):
+        if self._module is not None:
+            return self._module
+        from .module import Module
+
+        data = self._as_iter(X)
+        label_shapes = list(getattr(data, "provide_label", []) or [])
+        if not label_shapes:
+            # label-less prediction: the loss heads still declare label
+            # inputs (SoftmaxOutput), unused at inference — feed shapes
+            # (reference FeedForward.predict likewise tolerates no labels)
+            batch = data.provide_data[0][1][0]
+            label_shapes = [(n, (batch,))
+                            for n in self.symbol.list_arguments()
+                            if n.endswith("_label")]
+        mod = Module(self.symbol, context=self.ctx)
+        mod.bind(data_shapes=data.provide_data,
+                 label_shapes=label_shapes or None, for_training=False)
+        mod.set_params(self.arg_params or {}, self.aux_params or {},
+                       allow_missing=False)
+        self._module = mod
+        return mod
+
+
+__all__ += ["FeedForward", "load_params"]

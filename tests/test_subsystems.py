@@ -319,3 +319,65 @@ def test_amp_generation_invalidates_caches(amp_off):
     amp.turn_off()
     out3 = net(x)
     assert _dt(out3) == "float32"
+
+
+def test_log_get_logger(tmp_path):
+    """parity: python/mxnet/log.py getLogger + formatter."""
+    import logging
+
+    from mxnet_tpu import log as mxlog
+
+    logfile = str(tmp_path / "t.log")
+    lg = mxlog.get_logger("mxtpu_test_logger", filename=logfile,
+                          level=mxlog.INFO)
+    lg.info("hello %d", 42)
+    for h in lg.handlers:
+        h.flush()
+    assert "hello 42" in open(logfile).read()
+    # idempotent: second call must not duplicate handlers
+    lg2 = mxlog.get_logger("mxtpu_test_logger")
+    assert lg2 is lg and len(lg.handlers) == 1
+    assert mxlog.getLogger is mxlog.get_logger
+    logging.getLogger("mxtpu_test_logger").handlers.clear()
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """parity: model.py FeedForward — fit/predict/score/save/load over the
+    Module adapter."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import FeedForward
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(128, 8).astype("f")
+    w = rs.randn(8).astype("f")
+    y = (X @ w > 0).astype("f")
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                            name="softmax")
+
+    model = FeedForward.create(net, X, y, num_epoch=12, optimizer="adam",
+                               learning_rate=0.05, numpy_batch_size=32)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=32))
+    assert acc > 0.8, acc
+    preds = model.predict(mx.io.NDArrayIter(X, batch_size=32))
+    assert preds.shape == (128, 2)
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, epoch=12)
+    loaded = FeedForward.load(prefix, 12)
+    preds2 = loaded.predict(mx.io.NDArrayIter(X, batch_size=32))
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5)
+
+
+def test_model_zoo_get_model_names():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    names = vision.get_model_names()
+    assert "resnet50_v1" in names and "mobilenet1_0" in names \
+        and len(names) >= 25
