@@ -778,3 +778,98 @@ def dtype(d):  # noqa: A001
 
 from . import linalg  # noqa: E402,F401
 from . import random  # noqa: E402,F401
+
+
+# ----------------------------------------------------- np frontend tail ----
+# parity: the remaining multiarray.py functions over the npi tail ops
+
+def hanning(M, dtype=None, ctx=None):
+    return _invoke("_npi_hanning", [], {"M": int(M)}, wrap=ndarray)
+
+
+def hamming(M, dtype=None, ctx=None):
+    return _invoke("_npi_hamming", [], {"M": int(M)}, wrap=ndarray)
+
+
+def blackman(M, dtype=None, ctx=None):
+    return _invoke("_npi_blackman", [], {"M": int(M)}, wrap=ndarray)
+
+
+def polyval(p, x):
+    return _invoke("_npi_polyval", [_as_np(p), _as_np(x)], {}, wrap=ndarray)
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    kw = {}
+    if to_end is not None:
+        kw["to_end"] = float(to_end)
+    if to_begin is not None:
+        kw["to_begin"] = float(to_begin)
+    return _invoke("_npi_ediff1d", [_as_np(ary)], kw, wrap=ndarray)
+
+
+def delete(arr, obj, axis=None):
+    if isinstance(obj, slice):
+        return _invoke("_npi_delete", [_as_np(arr)],
+                       {"start": obj.start, "stop": obj.stop,
+                        "step": obj.step, "axis": axis}, wrap=ndarray)
+    if isinstance(obj, (int, _onp.integer)):
+        return _invoke("_npi_delete", [_as_np(arr)],
+                       {"obj": int(obj), "axis": axis}, wrap=ndarray)
+    return _invoke_fn(
+        lambda a, o: __import__("jax").numpy.asarray(
+            _onp.delete(_onp.asarray(a), _onp.asarray(o), axis=axis)),
+        "_npi_delete", [_as_np(arr), _as_np(obj)], {}, wrap=ndarray)
+
+
+def insert(arr, obj, values, axis=None):
+    if isinstance(obj, slice):
+        return _invoke("_npi_insert_slice", [_as_np(arr), _as_np(values)],
+                       {"start": obj.start, "stop": obj.stop,
+                        "step": obj.step, "axis": axis}, wrap=ndarray)
+    if isinstance(obj, (int, _onp.integer)):
+        return _invoke("_npi_insert_scalar", [_as_np(arr)],
+                       {"obj": int(obj), "val": values, "axis": axis},
+                       wrap=ndarray) if _onp.isscalar(values) else \
+            _invoke_fn(
+                lambda a, v: __import__("jax").numpy.asarray(
+                    _onp.insert(_onp.asarray(a), int(obj),
+                                _onp.asarray(v), axis=axis)),
+                "_npi_insert", [_as_np(arr), _as_np(values)], {},
+                wrap=ndarray)
+    return _invoke("_npi_insert_tensor",
+                   [_as_np(arr), _as_np(obj), _as_np(values)],
+                   {"axis": axis}, wrap=ndarray)
+
+
+def diag_indices_from(arr):
+    return _invoke("_npi_diag_indices_from", [_as_np(arr)], {},
+                   wrap=ndarray)
+
+
+def dsplit(ary, indices_or_sections):
+    return split(ary, indices_or_sections, axis=2)
+
+
+def deg2rad(x):
+    return _invoke("_npi_deg2rad", [_as_np(x)], {}, wrap=ndarray)
+
+
+def rad2deg(x):
+    return _invoke("_npi_rad2deg", [_as_np(x)], {}, wrap=ndarray)
+
+
+def bitwise_not(x):
+    return _invoke("_npi_bitwise_not", [_as_np(x)], {}, wrap=ndarray)
+
+
+def around(x, decimals=0):
+    if decimals:
+        scale = 10.0 ** decimals
+        return _invoke_fn(
+            lambda a: __import__("jax").numpy.round(a * scale) / scale,
+            "around", [_as_np(x)], {}, wrap=ndarray)
+    return _invoke("_npi_around", [_as_np(x)], {}, wrap=ndarray)
+
+
+round_ = around

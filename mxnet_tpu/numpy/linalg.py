@@ -100,3 +100,31 @@ def tensorsolve(a, b, axes=None):
     return _invoke_fn(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
                       "tensorsolve", [_as_np(a), _as_np(b)], {},
                       wrap=ndarray)
+
+
+def tensorinv(a, ind=2):
+    from ..ndarray.ndarray import _invoke
+
+    from . import _as_np, ndarray
+
+    return _invoke("_npi_tensorinv", [_as_np(a)], {"ind": int(ind)},
+                   wrap=ndarray)
+
+
+def tensorsolve(a, b, axes=None):
+    from ..ndarray.ndarray import _invoke
+
+    from . import _as_np, ndarray
+
+    return _invoke("_npi_tensorsolve", [_as_np(a), _as_np(b)],
+                   {"a_axes": tuple(axes) if axes else None}, wrap=ndarray)
+
+
+def pinv(a, rcond=1e-15, hermitian=False):
+    from ..ndarray.ndarray import _invoke
+
+    from . import _as_np, ndarray
+
+    return _invoke("_npi_pinv_scalar_rcond", [_as_np(a)],
+                   {"rcond": float(rcond), "hermitian": bool(hermitian)},
+                   wrap=ndarray)

@@ -11,7 +11,7 @@ from . import _as_np, ndarray  # noqa: F401
 
 __all__ = ["seed", "uniform", "normal", "randint", "rand", "randn",
            "choice", "shuffle", "permutation", "gamma", "exponential",
-           "beta", "poisson", "multinomial", "bernoulli"]
+           "beta", "poisson", "multinomial", "bernoulli", "pareto", "weibull", "rayleigh"]
 
 
 def seed(seed_value):
@@ -140,3 +140,22 @@ def multinomial(n, pvals, size=None):
             .astype(jnp.int32)
 
     return _invoke_fn(_mn, "multinomial", [_as_np(pvals)], {}, wrap=ndarray)
+
+
+def pareto(a=1.0, size=None):
+    return _invoke("_npi_pareto", [],
+                   {"a": float(a), "key": _framework_random.next_key(),
+                    "size": _size(size)}, wrap=ndarray)
+
+
+def weibull(a=1.0, size=None):
+    return _invoke("_npi_weibull", [],
+                   {"a": float(a), "key": _framework_random.next_key(),
+                    "size": _size(size)}, wrap=ndarray)
+
+
+def rayleigh(scale=1.0, size=None):
+    return _invoke("_npi_rayleigh", [],
+                   {"scale": float(scale),
+                    "key": _framework_random.next_key(),
+                    "size": _size(size)}, wrap=ndarray)

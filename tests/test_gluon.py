@@ -356,3 +356,43 @@ def test_grad_through_hybrid_params():
         grads.append([p.grad().asnumpy() for p in net.collect_params().values()])
     for ge, gh in zip(*grads):
         assert_almost_equal(ge, gh, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_threaded_inference():
+    """Thread-safe hybridized inference (parity capability:
+    example/multi_threaded_inference — the reference's thread-safe
+    CachedOp). Many host threads share one compiled executable; results
+    must match the single-threaded oracle exactly."""
+    import threading
+
+    import numpy as onp
+
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(32, activation="relu"))
+        net.add(mx.gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+
+    rs = onp.random.RandomState(0)
+    batches = [rs.rand(4, 16).astype("f") for _ in range(16)]
+    oracle = [net(mx.nd.array(b)).asnumpy() for b in batches]
+
+    results = [None] * len(batches)
+    errors = []
+
+    def worker(i):
+        try:
+            results[i] = net(mx.nd.array(batches[i])).asnumpy()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(batches))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, want in zip(results, oracle):
+        onp.testing.assert_allclose(got, want, rtol=1e-6)

@@ -345,3 +345,41 @@ def test_np_gradient():
 def test_result_type_no_transfer():
     a = np.ones((2, 2))
     assert np.result_type(a, "float64") == onp.float64
+
+
+def test_np_frontend_tail():
+    """windows/polyval/ediff1d/insert/delete/dsplit/angle-conv/around +
+    linalg tensor solvers + tail samplers (parity: numpy/multiarray.py
+    over the npi tail)."""
+    onp.testing.assert_allclose(np.hanning(5).asnumpy(), onp.hanning(5),
+                                atol=1e-6)
+    onp.testing.assert_allclose(np.hamming(4).asnumpy(), onp.hamming(4),
+                                atol=1e-6)
+    onp.testing.assert_allclose(
+        np.polyval(np.array([1., 2., 3.]), np.array([2.0])).asnumpy(),
+        [11.0])
+    assert np.delete(np.array([1., 2., 3.]), 1).asnumpy().tolist() \
+        == [1., 3.]
+    assert np.insert(np.array([1., 3.]), 1, 2.0).asnumpy().tolist() \
+        == [1., 2., 3.]
+    assert np.ediff1d(np.array([1., 4., 9.])).asnumpy().tolist() == [3., 5.]
+    assert np.dsplit(np.ones((2, 2, 4)), 2)[0].shape == (2, 2, 2)
+    onp.testing.assert_allclose(np.deg2rad(np.array([180.0])).asnumpy(),
+                                [onp.pi], rtol=1e-6)
+    onp.testing.assert_allclose(np.rad2deg(np.array([onp.pi])).asnumpy(),
+                                [180.0], rtol=1e-6)
+    onp.testing.assert_allclose(
+        np.around(np.array([1.256]), decimals=1).asnumpy(), [1.3],
+        rtol=1e-5)
+    a = onp.random.RandomState(0).rand(4, 4).astype("f") + \
+        onp.eye(4, dtype="f") * 3
+    onp.testing.assert_allclose(np.linalg.pinv(np.array(a)).asnumpy(),
+                                onp.linalg.pinv(a), atol=1e-4)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    assert np.random.pareto(2.0, size=(3,)).shape == (3,)
+    assert np.random.weibull(2.0, size=(3,)).shape == (3,)
+    assert np.random.rayleigh(1.0, size=(3,)).shape == (3,)
+    assert np.random.multinomial(
+        7, [0.0, 1.0, 0.0]).asnumpy().tolist() == [0, 7, 0]

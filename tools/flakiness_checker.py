@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Run a test many times to expose flakiness (parity:
+tools/flakiness_checker.py).
+
+    python tools/flakiness_checker.py tests/test_operator.py::test_pooling -n 20
+"""
+import argparse
+import subprocess
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="flaky-test hunter")
+    p.add_argument("test", help="pytest node id (file[::test])")
+    p.add_argument("-n", "--trials", type=int, default=10)
+    p.add_argument("-s", "--seed", type=int, default=None,
+                   help="base seed; trial i runs with seed+i (MXNET_TEST_SEED)")
+    args = p.parse_args(argv)
+    failures = 0
+    for i in range(args.trials):
+        env = None
+        if args.seed is not None:
+            import os
+
+            env = dict(os.environ)
+            env["MXNET_TEST_SEED"] = str(args.seed + i)
+        r = subprocess.run([sys.executable, "-m", "pytest", args.test,
+                            "-q", "-x"], capture_output=True, env=env)
+        ok = r.returncode == 0
+        failures += (not ok)
+        print(f"trial {i}: {'PASS' if ok else 'FAIL'}")
+    print(f"{failures}/{args.trials} failures")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
