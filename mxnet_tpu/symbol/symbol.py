@@ -917,3 +917,76 @@ def _mark_aux(nodes):
 def load(fname):
     with open(fname) as f:
         return load_json(f.read())
+
+
+# --------------------------------------------------------------------------
+# graph-pass registry (the subgraph-framework analogue)
+
+GRAPH_PASSES = {}
+
+
+def register_pass(name):
+    """Register a named graph pass ``fn(symbol, **kwargs) -> Symbol``
+    (parity role: the reference's subgraph-backend registry,
+    src/operator/subgraph/subgraph_property.h + MXSetSubgraphPropertyOpt —
+    external libraries loaded via mx.library.load can register passes the
+    same way lib_api custom passes do)."""
+
+    def deco(fn):
+        GRAPH_PASSES[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def list_passes():
+    return sorted(GRAPH_PASSES)
+
+
+def _symbol_optimize_for(self, backend, args=None, aux=None, ctx=None,
+                         **kwargs):
+    """parity: symbol.py optimize_for(:1449) — apply a registered backend
+    graph pass and return the rewritten Symbol. On TPU the 'default'
+    backend is the identity: operator fusion is XLA's job, so the passes
+    that carry semantic weight are precision/quantization rewrites (AMP,
+    INT8) and user-registered ones."""
+    key = (backend or "default").lower()
+    try:
+        pass_fn = GRAPH_PASSES[key]
+    except KeyError:
+        raise MXNetError(
+            f"unknown backend {backend!r}; registered: {list_passes()}"
+        ) from None
+    return pass_fn(self, args=args, aux=aux, **kwargs)
+
+
+Symbol.optimize_for = _symbol_optimize_for
+
+
+@register_pass("default")
+def _default_pass(sym, args=None, aux=None, **kwargs):
+    """Fusion/layout belong to XLA — the default backend is the graph
+    itself (the reference's default backend likewise returns the graph
+    when no property matches)."""
+    return sym
+
+
+@register_pass("amp")
+def _amp_pass(sym, args=None, aux=None, target_dtype="bfloat16", **kwargs):
+    from .. import amp as _amp
+
+    if args is not None or aux is not None:
+        out_sym, _, _ = _amp.convert_model(sym, args or {}, aux or {},
+                                           target_dtype=target_dtype)
+        return out_sym
+    return _amp.convert_symbol(sym, target_dtype=target_dtype) \
+        if hasattr(_amp, "convert_symbol") else sym
+
+
+@register_pass("int8")
+def _int8_pass(sym, args=None, aux=None, excluded_sym_names=(),
+               ranges=None, **kwargs):
+    from ..contrib.quantization import quantize_graph
+
+    return quantize_graph(sym, excluded_sym_names=excluded_sym_names,
+                          ranges=ranges)

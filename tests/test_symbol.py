@@ -236,3 +236,28 @@ def test_missing_input_error():
     y = x + mx.sym.var("y")
     with pytest.raises(mx.MXNetError):
         y.eval_with({"x": mx.nd.ones((2,))})
+
+
+def test_optimize_for_pass_registry():
+    """Symbol.optimize_for over the registered graph passes (subgraph
+    framework analogue; parity: symbol.py optimize_for:1449)."""
+    from mxnet_tpu.symbol import symbol as S
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4)
+    assert net.optimize_for("default") is net
+    for p in ("default", "amp", "int8"):
+        assert p in S.list_passes()
+
+    calls = []
+
+    @S.register_pass("test_identity_pass")
+    def _p(sym, args=None, aux=None, **kw):
+        calls.append(kw)
+        return sym
+
+    out = net.optimize_for("test_identity_pass", custom_opt=3)
+    assert out is net and calls[0]["custom_opt"] == 3
+    with pytest.raises(mx.MXNetError):
+        net.optimize_for("not_a_backend")
+    S.GRAPH_PASSES.pop("test_identity_pass")
