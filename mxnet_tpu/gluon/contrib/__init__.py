@@ -3,6 +3,6 @@ experimental blocks (`nn`), the Estimator training facade
 (`estimator`), and contrib data helpers."""
 from __future__ import annotations
 
-from . import estimator, nn
+from . import estimator, nn, rnn
 
-__all__ = ["nn", "estimator"]
+__all__ = ["nn", "estimator", "rnn"]

@@ -1,0 +1,298 @@
+"""gluon.contrib.rnn — convolutional RNN cells, variational dropout, LSTMP.
+
+Parity: ``python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py`` (Conv1-3D
+RNN/LSTM/GRU cells) and ``.../contrib/rnn/rnn_cell.py``
+(VariationalDropoutCell, LSTMPCell). Each cell is ordinary Gluon code over
+the registry ops, so `unroll` composes with `hybridize` like the core
+cells; gates lower to grouped `lax.conv_general_dilated` calls fused by
+XLA.
+"""
+from __future__ import annotations
+
+from ...ndarray import NDArray
+from ..rnn.rnn_cell import F, RecurrentCell, _ModifierCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "VariationalDropoutCell", "LSTMPCell"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvRNNCellBase(RecurrentCell):
+    """Shared conv-gate plumbing (parity: conv_rnn_cell.py _BaseConvRNNCell).
+
+    ``input_shape`` is (C, *spatial) — spatial dims must be preserved by
+    the chosen kernel/pad (the reference requires the same)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 ngates, dims, i2h_pad=0, strides=1, i2h_dilate=1,
+                 h2h_dilate=1, conv_layout="NCHW", activation="tanh",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._dims = dims
+        self._ngates = ngates
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, ("h2h kernel must be odd to preserve the "
+                                "state's spatial shape (conv_rnn_cell.py)")
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._strides = _tup(strides, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        in_c = self._input_shape[0]
+        ng = ngates * hidden_channels
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng, in_c) + self._i2h_kernel,
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(ng, hidden_channels) + self._h2h_kernel,
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng,), init="zeros",
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng,), init="zeros",
+                allow_deferred_init=True)
+
+    def _state_spatial(self):
+        spatial = self._input_shape[1:]
+        return tuple(
+            (s + 2 * p - d * (k - 1) - 1) // st + 1
+            for s, p, d, k, st in zip(spatial, self._i2h_pad,
+                                      self._i2h_dilate, self._i2h_kernel,
+                                      self._strides))
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial()
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[3 - self._dims:]}
+                for _ in range(self._num_states)]
+
+    def _materialize_params(self, inputs, states):
+        from ..parameter import DeferredInitializationError
+
+        try:
+            return {n: p.data() for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            return {n: p.data() for n, p in self._reg_params.items()}
+
+    def _conv_gates(self, F_, inputs, state_h, i2h_weight, h2h_weight,
+                    i2h_bias, h2h_bias):
+        ng = self._ngates * self._hidden_channels
+        i2h = F_.invoke("Convolution", inputs, i2h_weight, i2h_bias,
+                        kernel=self._i2h_kernel, stride=self._strides,
+                        pad=self._i2h_pad, dilate=self._i2h_dilate,
+                        num_filter=ng)
+        h2h = F_.invoke("Convolution", state_h, h2h_weight, h2h_bias,
+                        kernel=self._h2h_kernel, pad=self._h2h_pad,
+                        dilate=self._h2h_dilate, num_filter=ng)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_ConvRNNCellBase):
+    _num_states = 1
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_gates(F_, inputs, states[0], i2h_weight,
+                                    h2h_weight, i2h_bias, h2h_bias)
+        out = self._get_activation(F_, i2h + h2h, self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvRNNCellBase):
+    _num_states = 2
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_gates(F_, inputs, states[0], i2h_weight,
+                                    h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        slices = list(F_.invoke("SliceChannel", gates, num_outputs=4,
+                                axis=1))
+        i = slices[0].sigmoid()
+        f = slices[1].sigmoid()
+        g = self._get_activation(F_, slices[2], self._activation)
+        o = slices[3].sigmoid()
+        c = f * states[1] + i * g
+        h = o * self._get_activation(F_, c, self._activation)
+        return h, [h, c]
+
+
+class _ConvGRUCell(_ConvRNNCellBase):
+    _num_states = 1
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_gates(F_, inputs, states[0], i2h_weight,
+                                    h2h_weight, i2h_bias, h2h_bias)
+        i_r, i_z, i_n = list(F_.invoke("SliceChannel", i2h, num_outputs=3,
+                                       axis=1))
+        h_r, h_z, h_n = list(F_.invoke("SliceChannel", h2h, num_outputs=3,
+                                       axis=1))
+        r = (i_r + h_r).sigmoid()
+        z = (i_z + h_z).sigmoid()
+        n = self._get_activation(F_, i_n + r * h_n, self._activation)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _make_conv_cell(base, dims, ngates, name):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, strides=1, i2h_dilate=1,
+                     h2h_dilate=1, activation="tanh", prefix=None,
+                     params=None):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, ngates=ngates, dims=dims,
+                             i2h_pad=i2h_pad, strides=strides,
+                             i2h_dilate=i2h_dilate, h2h_dilate=h2h_dilate,
+                             activation=activation, prefix=prefix,
+                             params=params)
+
+    Cell.__name__ = Cell.__qualname__ = name
+    Cell.__doc__ = f"parity: gluon/contrib/rnn/conv_rnn_cell.py {name}"
+    return Cell
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCell, 1, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCell, 2, 1, "Conv2DRNNCell")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCell, 3, 1, "Conv3DRNNCell")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCell, 1, 4, "Conv1DLSTMCell")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCell, 2, 4, "Conv2DLSTMCell")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCell, 3, 4, "Conv3DLSTMCell")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCell, 1, 3, "Conv1DGRUCell")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCell, 2, 3, "Conv2DGRUCell")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCell, 3, 3, "Conv3DGRUCell")
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Same dropout mask reused at every time step (parity:
+    gluon/contrib/rnn/rnn_cell.py VariationalDropoutCell — Gal &
+    Ghahramani 2016)."""
+
+    def _alias(self):
+        return "vardrop"
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    @staticmethod
+    def _sample_mask(like, p):
+        from ... import autograd, random as frandom
+        import jax
+
+        if not (p and autograd.is_training()):
+            return None
+        key = frandom.next_key()
+        keep = jax.random.bernoulli(key, 1.0 - p, like._data.shape)
+        return NDArray(keep.astype(like._data.dtype) / (1.0 - p))
+
+    def __call__(self, inputs, states):
+        if self._drop_inputs and self._input_mask is None:
+            self._input_mask = self._sample_mask(inputs, self._drop_inputs)
+        if self._drop_states and self._state_masks is None:
+            self._state_masks = [
+                self._sample_mask(s, self._drop_states) for s in states]
+        if self._input_mask is not None:
+            inputs = inputs * self._input_mask
+        if self._state_masks is not None:
+            states = [s if m is None else s * m
+                      for s, m in zip(states, self._state_masks)]
+        out, states = self.base_cell(inputs, states)
+        if self._drop_outputs and self._output_mask is None:
+            self._output_mask = self._sample_mask(out, self._drop_outputs)
+        if self._output_mask is not None:
+            out = out * self._output_mask
+        return out, states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (parity:
+    gluon/contrib/rnn/rnn_cell.py LSTMPCell — LSTMP, Sak et al. 2014)."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        ng = 4 * hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ng, projection_size),
+                allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng,), init="zeros",
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng,), init="zeros",
+                allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _materialize_params(self, inputs, states):
+        from ..parameter import DeferredInitializationError
+
+        try:
+            return {n: p.data() for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.i2h_weight.shape = (self.i2h_weight.shape[0],
+                                     inputs.shape[-1])
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            return {n: p.data() for n, p in self._reg_params.items()}
+
+    def hybrid_forward(self, F_, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F_.invoke("FullyConnected", inputs, i2h_weight, i2h_bias,
+                        num_hidden=4 * self._hidden_size)
+        h2h = F_.invoke("FullyConnected", states[0], h2h_weight, h2h_bias,
+                        num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        sl = list(F_.invoke("SliceChannel", gates, num_outputs=4, axis=1))
+        i = sl[0].sigmoid()
+        f = sl[1].sigmoid()
+        g = sl[2].tanh()
+        o = sl[3].sigmoid()
+        c = f * states[1] + i * g
+        h = o * c.tanh()
+        r = F_.invoke("FullyConnected", h, h2r_weight,
+                      num_hidden=self._projection_size, no_bias=True)
+        return r, [r, c]

@@ -106,3 +106,66 @@ def test_estimator_max_batches():
     stopper = StoppingHandler(max_batch=3)
     est.fit(_toy_data(), batches=3, event_handlers=[stopper])
     assert stopper.current_batch == 3
+
+
+def test_conv_rnn_cells():
+    """Conv1/2/3D RNN/LSTM/GRU cells preserve state spatial shape across
+    unroll (parity: gluon/contrib/rnn/conv_rnn_cell.py)."""
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=5,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    seq = [mx.nd.random.uniform(shape=(2, 3, 8, 8)) for _ in range(4)]
+    outs, states = cell.unroll(4, seq)
+    assert outs[0].shape == (2, 5, 8, 8)
+    assert states[0].shape == (2, 5, 8, 8)
+    assert states[1].shape == (2, 5, 8, 8)
+
+    g = crnn.Conv1DGRUCell(input_shape=(2, 10), hidden_channels=4,
+                           i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    g.initialize(mx.init.Xavier())
+    outs, _ = g.unroll(
+        3, [mx.nd.random.uniform(shape=(2, 2, 10)) for _ in range(3)])
+    assert outs[0].shape == (2, 4, 10)
+
+    r3 = crnn.Conv3DRNNCell(input_shape=(1, 4, 4, 4), hidden_channels=2,
+                            i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    r3.initialize(mx.init.Xavier())
+    outs, _ = r3.unroll(
+        2, [mx.nd.random.uniform(shape=(1, 1, 4, 4, 4)) for _ in range(2)])
+    assert outs[0].shape == (1, 2, 4, 4, 4)
+
+
+def test_lstmp_cell():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    p = crnn.LSTMPCell(hidden_size=16, projection_size=6)
+    p.initialize(mx.init.Xavier())
+    outs, st = p.unroll(
+        3, [mx.nd.random.uniform(shape=(4, 10)) for _ in range(3)])
+    assert outs[0].shape == (4, 6)
+    assert st[0].shape == (4, 6) and st[1].shape == (4, 16)
+
+
+def test_variational_dropout_cell():
+    """Mask sampled once, reused across steps; no dropout at inference
+    (parity: gluon/contrib/rnn/rnn_cell.py VariationalDropoutCell)."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    base = mx.gluon.rnn.RNNCell(8)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize(mx.init.Xavier())
+    x = mx.nd.ones((2, 8))
+    with mx.autograd.record():
+        vd.reset()
+        _, s = vd(x, vd.begin_state(2))
+        m1 = vd._input_mask.asnumpy()
+        vd(x, s)
+        m2 = vd._input_mask.asnumpy()
+    onp.testing.assert_array_equal(m1, m2)
+    vd.reset()
+    vd(x, vd.begin_state(2))
+    assert vd._input_mask is None
