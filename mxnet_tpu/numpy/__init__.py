@@ -43,6 +43,56 @@ class ndarray(NDArray):
     def __str__(self):
         return str(self.asnumpy())
 
+    # ------------------------------------------- numpy dispatch protocol ---
+    # parity: python/mxnet/numpy_dispatch_protocol.py (+ the
+    # numpy_op_fallback.py escape hatch): numpy functions called on these
+    # arrays dispatch to the mx.np implementation when one exists, else
+    # fall back to real numpy and re-wrap, so the array type stays closed
+    # under the whole numpy API.
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs.get("out") is not None:
+            return self._numpy_fallback(getattr(ufunc, method), inputs,
+                                        kwargs)
+        import sys
+
+        fn = getattr(sys.modules[__name__], ufunc.__name__, None)
+        if fn is not None:
+            try:
+                return fn(*inputs, **kwargs)
+            except TypeError:
+                pass  # signature mismatch (e.g. numpy-only kwargs)
+        return self._numpy_fallback(ufunc, inputs, kwargs)
+
+    def __array_function__(self, func, types, args, kwargs):
+        import sys
+
+        fn = getattr(sys.modules[__name__], func.__name__, None)
+        if fn is not None and fn is not func:
+            try:
+                return fn(*args, **kwargs)
+            except TypeError:
+                pass
+        return self._numpy_fallback(func, args, kwargs)
+
+    @staticmethod
+    def _numpy_fallback(func, args, kwargs):
+        def unwrap(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, (list, tuple)):
+                return type(x)(unwrap(v) for v in x)
+            return x
+
+        out = func(*unwrap(tuple(args)), **{k: unwrap(v)
+                                            for k, v in kwargs.items()})
+        if isinstance(out, _onp.ndarray):
+            return array(out)
+        if isinstance(out, tuple):
+            return tuple(array(o) if isinstance(o, _onp.ndarray) else o
+                         for o in out)
+        return out
+
     # -------------------------------------------------------- operators ----
     def _bin(self, other, op, scalar_op=None, reverse=False):
         if isinstance(other, NDArray):

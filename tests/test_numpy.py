@@ -383,3 +383,26 @@ def test_np_frontend_tail():
     assert np.random.rayleigh(1.0, size=(3,)).shape == (3,)
     assert np.random.multinomial(
         7, [0.0, 1.0, 0.0]).asnumpy().tolist() == [0, 7, 0]
+
+
+def test_numpy_dispatch_protocol():
+    """__array_ufunc__/__array_function__ interop (parity:
+    numpy_dispatch_protocol.py + numpy_op_fallback.py): numpy functions on
+    mx.np arrays return mx.np arrays, via the mx implementation when one
+    exists and via wrapped-numpy fallback otherwise."""
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    m = onp.mean(a)
+    assert isinstance(m, type(a)) and float(m.asnumpy()) == 2.5
+    s = onp.add(a, 1)
+    assert isinstance(s, type(a))
+    onp.testing.assert_allclose(s.asnumpy(), a.asnumpy() + 1)
+    c = onp.concatenate([a, a])
+    assert isinstance(c, type(a)) and c.shape == (4, 2)
+    d = onp.dot(a, a)
+    assert isinstance(d, type(a))
+    onp.testing.assert_allclose(d.asnumpy(), a.asnumpy() @ a.asnumpy())
+    sq = onp.sqrt(a)
+    assert isinstance(sq, type(a))
+    onp.testing.assert_allclose(sq.asnumpy(), onp.sqrt(a.asnumpy()))
+    w = onp.where(a > 2, a, 0 * a)
+    assert isinstance(w, type(a))
