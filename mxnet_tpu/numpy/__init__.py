@@ -51,41 +51,89 @@ class ndarray(NDArray):
     # under the whole numpy API.
 
     def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
-        if method != "__call__" or kwargs.get("out") is not None:
-            return self._numpy_fallback(getattr(ufunc, method), inputs,
-                                        kwargs)
-        import sys
+        out = kwargs.pop("out", None)
+        if method == "at":
+            # in-place index update: run on a host COPY, write back through
+            # the functional rebind (never through the jax buffer's view)
+            target = inputs[0]
+            host = _onp.array(target.asnumpy())
+            ufunc.at(host, *self._unwrap(tuple(inputs[1:])))
+            target[:] = array(host, ctx=target.context)
+            return None
+        if out is not None and (kwargs or method != "__call__"):
+            # let numpy apply the full out-semantics (where= keeps the out
+            # array's prior values) on host copies, then rebind
+            host_outs = tuple(_onp.array(t.asnumpy())
+                              for t in (out if isinstance(out, tuple)
+                                        else (out,)))
+            kwargs["out"] = host_outs if len(host_outs) > 1 else host_outs[0]
+            self._numpy_fallback(getattr(ufunc, method), inputs, kwargs)
+            return self._fill_out(
+                host_outs if len(host_outs) > 1 else array(host_outs[0]),
+                out)
+        if method != "__call__":
+            result = self._numpy_fallback(getattr(ufunc, method), inputs,
+                                          kwargs)
+        elif not kwargs:
+            # mx implementation only for the plain call — numpy-only kwargs
+            # (where=, dtype=, casting=...) would be silently ignored by
+            # the thin wrappers, so anything fancier falls back wholesale
+            import sys
 
-        fn = getattr(sys.modules[__name__], ufunc.__name__, None)
-        if fn is not None:
-            try:
-                return fn(*inputs, **kwargs)
-            except TypeError:
-                pass  # signature mismatch (e.g. numpy-only kwargs)
-        return self._numpy_fallback(ufunc, inputs, kwargs)
+            fn = getattr(sys.modules[__name__], ufunc.__name__, None)
+            if fn is not None:
+                try:
+                    result = fn(*inputs)
+                except TypeError:
+                    result = self._numpy_fallback(ufunc, inputs, kwargs)
+            else:
+                result = self._numpy_fallback(ufunc, inputs, kwargs)
+        else:
+            result = self._numpy_fallback(ufunc, inputs, kwargs)
+        return self._fill_out(result, out)
 
     def __array_function__(self, func, types, args, kwargs):
-        import sys
+        out = kwargs.pop("out", None)
+        if out is None and kwargs.get("where") is None:
+            import sys
 
-        fn = getattr(sys.modules[__name__], func.__name__, None)
-        if fn is not None and fn is not func:
-            try:
-                return fn(*args, **kwargs)
-            except TypeError:
-                pass
-        return self._numpy_fallback(func, args, kwargs)
+            fn = getattr(sys.modules[__name__], func.__name__, None)
+            if fn is not None and fn is not func:
+                try:
+                    return fn(*args, **kwargs)
+                except TypeError:
+                    pass
+        return self._fill_out(self._numpy_fallback(func, args, kwargs), out)
 
     @staticmethod
-    def _numpy_fallback(func, args, kwargs):
+    def _fill_out(result, out):
+        """Honor the numpy out= contract: write the result INTO the given
+        array (functional rebind) and return it."""
+        if out is None:
+            return result
+        targets = out if isinstance(out, tuple) else (out,)
+        results = result if isinstance(result, tuple) else (result,)
+        for t, r in zip(targets, results):
+            t[:] = r if isinstance(r, NDArray) else array(r)
+        # ufuncs hand out= in as a 1-tuple; the call returns the bare array
+        return targets[0] if len(targets) == 1 else out
+
+    @staticmethod
+    def _unwrap(args):
         def unwrap(x):
             if isinstance(x, NDArray):
-                return x.asnumpy()
+                # copies, not views: numpy may write into its operands
+                return _onp.array(x.asnumpy())
             if isinstance(x, (list, tuple)):
                 return type(x)(unwrap(v) for v in x)
             return x
 
-        out = func(*unwrap(tuple(args)), **{k: unwrap(v)
-                                            for k, v in kwargs.items()})
+        return unwrap(tuple(args))
+
+    @staticmethod
+    def _numpy_fallback(func, args, kwargs):
+        out = func(*ndarray._unwrap(tuple(args)),
+                   **{k: ndarray._unwrap((v,))[0] for k, v in kwargs.items()})
         if isinstance(out, _onp.ndarray):
             return array(out)
         if isinstance(out, tuple):

@@ -406,3 +406,35 @@ def test_numpy_dispatch_protocol():
     onp.testing.assert_allclose(sq.asnumpy(), onp.sqrt(a.asnumpy()))
     w = onp.where(a > 2, a, 0 * a)
     assert isinstance(w, type(a))
+
+
+def test_numpy_dispatch_out_where_inplace():
+    """Review regressions: out= contract, where= semantics (untouched
+    positions keep out's prior values), in-place ufunc methods write back
+    through rebind rather than mutating the jax buffer view."""
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([[10.0, 10.0], [10.0, 10.0]])
+    c = np.zeros((2, 2))
+    r = onp.add(a, b, out=c)
+    assert r is c
+    onp.testing.assert_allclose(c.asnumpy(), a.asnumpy() + 10)
+
+    m = onp.add(a, b, where=onp.array([[True, False], [False, True]]),
+                out=np.zeros((2, 2)))
+    assert m.asnumpy().tolist() == [[11.0, 0.0], [0.0, 14.0]]
+
+    d = onp.multiply(a, b, dtype=onp.float64)
+    onp.testing.assert_allclose(d.asnumpy(), a.asnumpy() * 10)
+
+    e = np.array([1.0, 2.0, 3.0])
+    raw_before = e._data
+    onp.add.at(e, [0, 1], 5.0)
+    assert e.asnumpy().tolist() == [6.0, 7.0, 3.0]
+    assert raw_before is not e._data  # rebind, not view mutation
+
+    assert onp.add.reduce(a).asnumpy().tolist() == [4.0, 6.0]
+
+    co = np.zeros((4, 2))
+    r = onp.concatenate([a, a], out=co)
+    assert r is co
+    onp.testing.assert_allclose(co.asnumpy()[:2], a.asnumpy())
