@@ -21,3 +21,20 @@ import jax
 if os.environ.get("MXNET_TEST_DEVICE", "cpu").startswith("cpu"):
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
+
+
+import numpy as _onp
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _mxnet_test_seed():
+    """Deterministic reruns under MXNET_TEST_SEED (parity: the reference
+    test framework's with_seed decorator + tools/flakiness_checker)."""
+    seed = os.environ.get("MXNET_TEST_SEED")
+    if seed is not None:
+        import mxnet_tpu as mx
+
+        _onp.random.seed(int(seed))
+        mx.random.seed(int(seed))
+    yield
