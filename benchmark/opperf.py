@@ -80,7 +80,9 @@ def _inputs_for(op_name, n):
             break
         if p.default is inspect.Parameter.empty and p.name not in (
                 "key", "training"):
-            arrays.append(_rand(n, n))
+            # scalar-tensor hyper inputs (loss-scale etc.), not matrices
+            arrays.append(_rand(1) if p.name in ("rescale_grad",)
+                          else _rand(n, n))
         else:
             break
     if not arrays:
