@@ -27,8 +27,12 @@ imperative Trainer collapses into one executable launch.
 from __future__ import annotations
 
 from .mesh import DeviceMesh, current_mesh
+from .moe import moe_apply, stack_expert_params
+from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import attention, ring_attention, ring_attention_sharded
 from .sharded_trainer import ShardedTrainer, sharding_rules
 
 __all__ = ["DeviceMesh", "current_mesh", "ShardedTrainer", "sharding_rules",
-           "attention", "ring_attention", "ring_attention_sharded"]
+           "attention", "ring_attention", "ring_attention_sharded",
+           "pipeline_apply", "stack_stage_params", "moe_apply",
+           "stack_expert_params"]

@@ -78,10 +78,12 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
         return o_new, m_new, l_new, k_next, v_next
 
     # initial carries must carry the sp-varying type (shard_map type system)
-    o = jax.lax.pvary(jnp.zeros(q.shape, jnp.float32), (axis_name,))
-    m = jax.lax.pvary(jnp.full(q.shape[:-1], -jnp.inf, jnp.float32),
-                      (axis_name,))
-    l = jax.lax.pvary(jnp.zeros(q.shape[:-1], jnp.float32), (axis_name,))
+    o = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), axis_name,
+                      to="varying")
+    m = jax.lax.pcast(jnp.full(q.shape[:-1], -jnp.inf, jnp.float32),
+                      axis_name, to="varying")
+    l = jax.lax.pcast(jnp.zeros(q.shape[:-1], jnp.float32), axis_name,
+                      to="varying")
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o, m, l,
                                                    k.astype(jnp.float32),
                                                    v.astype(jnp.float32)))
@@ -95,7 +97,7 @@ def ring_attention_sharded(mesh, axis="sp", causal=False, scale=None):
     the sp axis size; inputs may be unsharded (they will be laid out).
     """
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     jmesh = mesh.jax_mesh
