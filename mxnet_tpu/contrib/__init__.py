@@ -10,14 +10,14 @@ from __future__ import annotations
 
 from .. import amp  # reference import path: mx.contrib.amp
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "svrg_optimization", "text"]
 
 
 def __getattr__(name):
-    if name == "quantization":
+    if name in ("quantization", "svrg_optimization", "text"):
         import importlib
 
-        mod = importlib.import_module(".quantization", __name__)
+        mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,20 @@
+"""SVRG optimization (parity: `python/mxnet/contrib/svrg_optimization/`).
+
+Stochastic Variance Reduced Gradient (Johnson & Zhang, NIPS'13) as a
+Module-API wrapper: periodically snapshot the weights w~ and the full
+dataset gradient mu = (1/N) sum_i grad f_i(w~); each minibatch step then
+descends along  g_i(w) - g_i(w~) + mu,  an unbiased, variance-reduced
+gradient estimate.
+
+TPU-first redesign: the reference routes full-gradient accumulation
+through a kvstore with a private `_SVRGOptimizer`/`_AssignmentOptimizer`
+pair (svrg_optimizer.py:25,50). Here the corrected gradient is computed
+directly with fused NDArray arithmetic on device and handed to the
+ordinary updater — no optimizer impersonation, and the aux (snapshot)
+module reuses the main module's compiled executor cache.
+"""
+from __future__ import annotations
+
+from .svrg_module import SVRGModule
+
+__all__ = ["SVRGModule"]

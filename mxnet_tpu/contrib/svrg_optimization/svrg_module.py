@@ -1,0 +1,220 @@
+"""SVRGModule: Module-API SVRG training.
+
+parity: `python/mxnet/contrib/svrg_optimization/svrg_module.py:30` — same
+public surface (`update_full_grads`, `update_svrg_gradients`, `update`,
+`fit` with `update_freq`), different machinery: instead of smuggling the
+full-gradient accumulation through a kvstore with a fake optimizer
+(svrg_optimizer.py:25), the snapshot module's per-batch gradient and the
+stored full gradient are combined with device-side NDArray arithmetic and
+the result is handed to the ordinary fused updater.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ...module.module import Module
+
+
+class SVRGModule(Module):
+    """SVRG-optimizing Module (parity: svrg_module.py:30).
+
+    Every `update_freq` epochs, `update_full_grads(train_data)` snapshots
+    the weights (w~) and accumulates the exact full-dataset gradient mu.
+    Each subsequent minibatch update descends along
+
+        g_i(w) - g_i(w~) + mu
+
+    computed by running the batch through BOTH the live module and an
+    internal auxiliary module holding the snapshot weights.
+
+    Parameters match `Module`, plus:
+
+    update_freq : int
+        Full-gradient refresh period, in epochs.
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, update_freq=None):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, work_load_list=work_load_list,
+                         fixed_param_names=fixed_param_names,
+                         state_names=state_names)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise TypeError("update_freq must be a positive integer, got "
+                            f"{update_freq!r}")
+        self.update_freq = update_freq
+        # snapshot module: same symbol/ctx, params = w~ (svrg_module.py:90)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context,
+                               fixed_param_names=fixed_param_names)
+        self._full_grads = None  # name -> NDArray mu accumulated over data
+
+    # ---------------------------------------------------------------- bind --
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None, grad_req)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        # simple_bind zero-fills fresh executors; carry the live weights
+        # across the rebind (parity: Module.reshape preserves contents)
+        saved = self.get_params() if self.params_initialized else None
+        super().bind(data_shapes, label_shapes, self.for_training,
+                     self._inputs_need_grad, force_rebind=True)
+        self._mod_aux.bind(data_shapes, label_shapes, self.for_training,
+                           self._inputs_need_grad, force_rebind=True)
+        if saved is not None:
+            arg_p, aux_p = saved
+            super().init_params(arg_params=arg_p, aux_params=aux_p,
+                                allow_missing=False, force_init=True)
+            self._mod_aux.init_params(arg_params=arg_p, aux_params=aux_p,
+                                      allow_missing=False, force_init=True)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        super().init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params,
+                            allow_missing=allow_missing,
+                            force_init=force_init, allow_extra=allow_extra)
+        # aux starts at the same point; real snapshot happens in
+        # update_full_grads
+        arg_p, aux_p = self.get_params()
+        self._mod_aux.init_params(arg_params=arg_p, aux_params=aux_p,
+                                  allow_missing=False, force_init=True)
+
+    # -------------------------------------------------------- SVRG pieces --
+    def update_full_grads(self, train_data):
+        """Snapshot w~ := w and compute mu = mean over all batches of
+        grad f(w~) (parity: svrg_module.py:292)."""
+        assert self.binded and self.params_initialized
+        arg_p, aux_p = self.get_params()
+        self._mod_aux.init_params(arg_params=arg_p, aux_params=aux_p,
+                                  allow_missing=False, force_init=True)
+        accum = {}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                if name in accum:
+                    accum[name] += g
+                else:
+                    accum[name] = g.copy()
+            nbatch += 1
+        assert nbatch > 0, "update_full_grads needs a non-empty iterator"
+        for name in accum:
+            accum[name] /= nbatch
+        self._full_grads = accum
+        train_data.reset()
+
+    def update_svrg_gradients(self):
+        """Rewrite the live gradients in place to the variance-reduced form
+        g(w) - g(w~) + mu (parity: svrg_module.py:382,360)."""
+        assert self._full_grads is not None, \
+            "call update_full_grads before the epoch's first update"
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            g_tilde = self._mod_aux._exec.grad_dict.get(name)
+            mu = self._full_grads.get(name)
+            if g_tilde is None or mu is None:
+                continue
+            g[:] = g - g_tilde + mu
+
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        if self._mod_aux.binded:
+            self._mod_aux.backward(out_grads)
+
+    def update(self):
+        """Apply the optimizer along the SVRG-corrected direction
+        (parity: svrg_module.py:274)."""
+        self.update_svrg_gradients()
+        super().update()
+
+    # ------------------------------------------------------------- fit -----
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """Module.fit with a full-gradient refresh every `update_freq`
+        epochs (parity: svrg_module.py:395)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ... import initializer as init_mod
+        from ... import metric as metric_mod
+        from ...module.base_module import BatchEndParam, _as_list
+
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params,
+                            force_init=force_init)
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        if monitor is not None:
+            self.install_monitor(monitor)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            # resume-safe: a begin_epoch off the refresh grid still needs an
+            # initial mu before the first update
+            if self._full_grads is None or epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for data_batch in train_data:
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch, nbatch, eval_metric, locals()))
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            for cb in _as_list(epoch_end_callback):
+                arg_p, aux_p = self.get_params()
+                cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)

@@ -1,0 +1,14 @@
+"""Text utilities (parity: `python/mxnet/contrib/text/__init__.py`).
+
+Vocabulary indexing, token-embedding loading (GloVe / fastText file
+formats, custom files, composites) and tokenization helpers. Embedding
+*matrices* come back as NDArrays ready to drop into
+`gluon.nn.Embedding(...).weight` — the TPU path is simply a device-side
+gather through that layer.
+"""
+from __future__ import annotations
+
+from . import embedding, utils, vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

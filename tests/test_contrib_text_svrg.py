@@ -1,0 +1,232 @@
+"""contrib.text + contrib.svrg_optimization tests (parity model:
+tests/python/unittest/test_contrib_text.py, test_contrib_svrg_module.py,
+test_contrib_svrg_optimizer.py)."""
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+# ------------------------------------------------------------------ text --
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str(" Life is great! \n life is good . \n")
+    assert c["is"] == 2 and c["Life"] == 1 and c["life"] == 1
+    c2 = text.utils.count_tokens_from_str("Life is\nlife is", to_lower=True)
+    assert c2["life"] == 2 and c2["is"] == 2
+    base = Counter({"is": 5})
+    c3 = text.utils.count_tokens_from_str("is it", counter_to_update=base)
+    assert c3["is"] == 6 and c3["it"] == 1
+    # regex metacharacters are literal delimiters
+    c4 = text.utils.count_tokens_from_str("a.b c.d", token_delim=".",
+                                          seq_delim=" ")
+    assert c4 == Counter({"a": 1, "b": 1, "c": 1, "d": 1})
+
+
+def test_vocabulary_indexing():
+    counter = Counter({"a": 5, "b": 3, "c": 3, "d": 1})
+    v = text.vocab.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                              unknown_token="<unk>",
+                              reserved_tokens=["<pad>"])
+    # order: unk, reserved, then by freq desc (ties alphabetical)
+    assert v.idx_to_token == ["<unk>", "<pad>", "a", "b", "c"]
+    assert len(v) == 5
+    assert v.to_indices("a") == 2
+    assert v.to_indices(["d", "b"]) == [0, 3]  # d filtered by min_freq
+    assert v.to_tokens([0, 4]) == ["<unk>", "c"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(counter, reserved_tokens=["<unk>"])
+    capped = text.vocab.Vocabulary(counter, most_freq_count=2)
+    assert len(capped) == 3  # unk + 2 most frequent
+
+
+def _write_vec_file(path, rows, header=None):
+    with open(path, "w") as f:
+        if header:
+            f.write(header + "\n")
+        for token, vec in rows:
+            f.write(token + " " + " ".join(str(x) for x in vec) + "\n")
+
+
+def test_custom_embedding_and_lookup(tmp_path):
+    p = str(tmp_path / "vecs.txt")
+    _write_vec_file(p, [("hello", [1.0, 2.0, 3.0]),
+                        ("world", [4.0, 5.0, 6.0]),
+                        ("hello", [9.0, 9.0, 9.0])])  # dup: first wins
+    emb = text.embedding.CustomEmbedding(p)
+    assert emb.vec_len == 3
+    assert len(emb) == 3  # unk + 2
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    out = emb.get_vecs_by_tokens(["world", "missing"])
+    np.testing.assert_allclose(out.asnumpy(),
+                               [[4, 5, 6], [0, 0, 0]])
+    # lower_case_backup
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["HELLO"],
+                               lower_case_backup=True).asnumpy(),
+        [[1, 2, 3]])
+    # update_token_vectors
+    emb.update_token_vectors("world", mx.nd.array([7.0, 7.0, 7.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [7, 7, 7])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", mx.nd.array([1.0, 1.0, 1.0]))
+    # fastText-style header line is skipped
+    p2 = str(tmp_path / "ft.vec")
+    _write_vec_file(p2, [("tok", [1.0, 1.0])], header="1 2")
+    emb2 = text.embedding.CustomEmbedding(p2)
+    assert emb2.vec_len == 2 and "tok" in emb2.token_to_idx
+    # a file-provided <unk> vector lands in row 0 and wins over the
+    # initializer (parity: embedding.py:300)
+    p3 = str(tmp_path / "unk.txt")
+    _write_vec_file(p3, [("<unk>", [8.0, 8.0]), ("w", [1.0, 2.0])])
+    emb3 = text.embedding.CustomEmbedding(
+        p3, init_unknown_vec=lambda shape: mx.nd.ones(shape))
+    np.testing.assert_allclose(
+        emb3.get_vecs_by_tokens("missing").asnumpy(), [8, 8])
+
+
+def test_embedding_with_vocabulary_and_composite(tmp_path):
+    p = str(tmp_path / "vecs.txt")
+    _write_vec_file(p, [("a", [1.0, 2.0]), ("b", [3.0, 4.0]),
+                        ("c", [5.0, 6.0])])
+    vocab = text.vocab.Vocabulary(Counter({"b": 2, "z": 2}))
+    emb = text.embedding.CustomEmbedding(p, vocabulary=vocab)
+    assert emb.idx_to_token == vocab.idx_to_token
+    assert emb.idx_to_vec.shape == (len(vocab), 2)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("b").asnumpy(), [3, 4])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("z").asnumpy(), [0, 0])  # not in file
+    # composite: concat two sources over one vocab
+    emb_a = text.embedding.CustomEmbedding(p)
+    comp = text.embedding.CompositeEmbedding(vocab, [emb_a, emb_a])
+    assert comp.vec_len == 4
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("b").asnumpy(), [3, 4, 3, 4])
+
+
+def test_embedding_registry():
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in names["glove"]
+    with pytest.raises(KeyError):
+        text.embedding.create("glove", pretrained_file_name="not-a-file")
+    with pytest.raises(FileNotFoundError):
+        # known name but absent from the (empty) local cache
+        text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt")
+
+
+# ------------------------------------------------------------------ svrg --
+def _linreg_sym():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(out, mx.sym.var("lin_reg_label"),
+                                         name="linreg")
+
+
+def _linreg_data(n=128, d=4, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, 1).astype(np.float32)
+    Y = (X @ w).reshape(n).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=batch,
+                             label_name="lin_reg_label")
+
+
+def test_svrg_update_freq_validation():
+    with pytest.raises(TypeError):
+        SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                   update_freq=0)
+    with pytest.raises(TypeError):
+        SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                   update_freq=None)
+
+
+def test_svrg_full_grads_are_dataset_mean():
+    """mu must equal the mean of per-batch gradients at the snapshot."""
+    it = _linreg_data()
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                     update_freq=2)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),))
+    mod.update_full_grads(it)
+    # manual accumulation through the plain Module path
+    expect = {}
+    nb = 0
+    it.reset()
+    for batch in it:
+        mod._mod_aux.forward(batch, is_train=True)
+        mod._mod_aux.backward()
+        for name, g in mod._mod_aux._exec.grad_dict.items():
+            expect[name] = expect.get(name, 0) + g.asnumpy()
+        nb += 1
+    for name, mu in mod._full_grads.items():
+        np.testing.assert_allclose(mu.asnumpy(), expect[name] / nb,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_svrg_gradient_at_snapshot_equals_full_grad():
+    """At w == w~ the corrected gradient collapses to mu exactly —
+    the defining SVRG identity."""
+    it = _linreg_data()
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                     update_freq=1)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.0),))
+    mod.update_full_grads(it)
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update_svrg_gradients()
+    for name, mu in mod._full_grads.items():
+        np.testing.assert_allclose(mod._exec.grad_dict[name].asnumpy(),
+                                   mu.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_svrg_reshape_preserves_params():
+    it = _linreg_data()
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                     update_freq=1)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    before, _ = mod.get_params()
+    mod.reshape([("data", (16, 4))], [("lin_reg_label", (16,))])
+    after, _ = mod.get_params()
+    for name in before:
+        np.testing.assert_allclose(after[name].asnumpy(),
+                                   before[name].asnumpy())
+
+
+def test_svrg_fit_resumes_off_refresh_grid():
+    """begin_epoch not a multiple of update_freq must still seed mu."""
+    it = _linreg_data()
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                     update_freq=2)
+    mod.fit(it, eval_metric="mse", num_epoch=3, begin_epoch=1, kvstore=None,
+            optimizer_params=(("learning_rate", 0.01),
+                              ("rescale_grad", 1.0 / 32)))
+
+
+def test_svrg_fit_converges():
+    it = _linreg_data(n=256, batch=32)
+    mod = SVRGModule(_linreg_sym(), label_names=("lin_reg_label",),
+                     update_freq=2)
+    mod.fit(it, eval_metric="mse", num_epoch=10, kvstore=None,
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),
+                              ("rescale_grad", 1.0 / 32)))
+    mse = dict(mod.score(it, "mse"))["mse"]
+    assert mse < 0.05, mse
