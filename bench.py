@@ -27,7 +27,18 @@ import numpy as np
 
 def main():
     import mxnet_tpu as mx
+    from mxnet_tpu.base import ensure_live_backend
     from mxnet_tpu.gluon.model_zoo import vision
+
+    # a downed TPU tunnel hangs the first backend touch forever; probe
+    # (subprocess, 90s deadline) unless the platform is already pinned.
+    # BENCH_SKIP_PROBE=1 skips the probe's extra backend spin-up.
+    if not os.environ.get("BENCH_SKIP_PROBE"):
+        if ensure_live_backend() == "cpu-fallback":
+            import sys
+
+            print("bench: default backend unreachable; falling back to "
+                  "CPU", file=sys.stderr, flush=True)
 
     batch = int(os.environ.get("BENCH_BATCH", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
@@ -63,6 +74,9 @@ def main():
         "value": round(throughput, 2),
         "unit": "img/s",
         "vs_baseline": round(throughput / baseline, 3),
+        # fallback runs must not masquerade as chip numbers in the
+        # metric series
+        "platform": ctx.device_type,
     }), flush=True)
 
     if not os.environ.get("BENCH_SKIP_TRAIN"):
@@ -113,6 +127,7 @@ def bench_train(ctx, batch, dtype, iters, model):
         "value": round(throughput, 2),
         "unit": "img/s",
         "vs_baseline": round(throughput / baseline, 3),
+        "platform": ctx.device_type,
     }
     if flops_per_img:  # only for models with a known FLOP count
         achieved = throughput * flops_per_img / 1e12

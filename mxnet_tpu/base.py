@@ -102,6 +102,59 @@ def apply_platform_env():
         pass  # backend already initialised — keep its platform
 
 
+def ensure_live_backend(timeout_s=90, retries=1):
+    """Probe the default JAX backend in a subprocess under a deadline,
+    pinning the CPU platform if (and only if) the probe HANGS.
+
+    A downed TPU tunnel makes the first ``jax.devices()`` call block
+    forever with no exception to catch, which would hang any entry point
+    (bench.py, examples, launch.py children). Returns the platform the
+    process will use: the value of an explicit ``MXTPU_PLATFORM`` pin,
+    ``"default"`` when the probe succeeds, or ``"cpu-fallback"`` after a
+    timeout-triggered fallback (distinct from a deliberate pin, so
+    callers can warn honestly). A probe that *crashes* (nonzero exit) is
+    retried and then raised as RuntimeError — that is evidence of a
+    different, possibly transient, problem (busy device lock, bad env),
+    and silently measuring the wrong platform would be worse than
+    failing loudly. Must run before anything touches the XLA backend in
+    this process; if the fallback cannot be applied because a backend is
+    already live, raises instead of claiming success."""
+    import os
+    import subprocess
+    import sys
+
+    pinned = os.environ.get("MXTPU_PLATFORM")
+    if pinned:
+        return pinned
+    last_err = None
+    for _ in range(retries + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True)
+            if proc.returncode == 0:
+                return "default"
+            last_err = proc.stderr.decode(errors="replace")[-500:]
+        except subprocess.TimeoutExpired:
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception as exc:
+                raise RuntimeError(
+                    "default JAX backend is unreachable (probe timed "
+                    "out) and the CPU fallback could not be applied — a "
+                    "backend is already initialised in this process; "
+                    "call ensure_live_backend before any backend touch"
+                ) from exc
+            # only after the fallback is actually in effect: make it
+            # visible to child processes too
+            os.environ["MXTPU_PLATFORM"] = "cpu"
+            return "cpu-fallback"
+    raise RuntimeError(
+        f"JAX backend probe failed (crash, not a hang):\n{last_err}")
+
+
 def maybe_init_distributed():
     """Join the multi-host rendezvous when launched by tools/launch.py
     (parity: KVStoreDist workers connecting to the dmlc tracker via
