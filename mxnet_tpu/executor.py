@@ -34,6 +34,27 @@ class Executor:
         from .ndarray import NDArray
 
         self._symbol = symbol
+        # context LIST -> data parallelism over the group, the TPU way:
+        # ONE SPMD executable over a dp mesh of those devices (inputs
+        # batch-sharded, params replicated, XLA inserts the gradient
+        # all-reduce) — GSPMD's answer to the reference's per-device
+        # executor group + decide_slices + allreduce
+        # (module/executor_group.py:144,282).
+        self._mesh = None
+        self._ctx_group = None
+        if isinstance(ctx, (list, tuple)):
+            if len(ctx) > 1:
+                from .parallel.mesh import DeviceMesh
+
+                devs = [c.jax_device() for c in ctx]
+                if len(set(devs)) != len(devs):
+                    raise MXNetError(
+                        f"context list resolves to duplicate devices "
+                        f"{devs}; the host exposes fewer devices than "
+                        "contexts requested")
+                self._mesh = DeviceMesh({"dp": len(devs)}, devices=devs)
+                self._ctx_group = list(ctx)
+            ctx = ctx[0]
         self._ctx = ctx
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -62,6 +83,7 @@ class Executor:
                     self._grad_dict[name] = self._grad_dict[name].astype(
                         src.dtype)
         self._run = symbol._build_eval()
+        self._warned_uneven = False
         self._jit = {}
         self.outputs = []
         self._last = None  # (args_raw, auxs_raw, key) from latest forward
@@ -123,6 +145,32 @@ class Executor:
         self._jit[key] = fn
         return fn
 
+    def _place(self, raw, batch_sharded):
+        """Lay an array out on the dp mesh: batch-sharded for fed data,
+        replicated otherwise. No-op (no transfer) when already laid out."""
+        import jax
+
+        n = self._mesh.size("dp")
+        if batch_sharded and not (raw.ndim > 0 and raw.shape[0] % n == 0):
+            if not self._warned_uneven:
+                # silent replication would quietly throw away the
+                # requested parallelism (reference decide_slices splits
+                # unevenly instead, executor_group.py:282)
+                import warnings
+
+                warnings.warn(
+                    f"batch dim {raw.shape[:1]} not divisible by the "
+                    f"{n}-device context group; replicating instead of "
+                    "sharding — each device computes the full batch",
+                    stacklevel=3)
+                self._warned_uneven = True
+            batch_sharded = False
+        sh = self._mesh.sharding("dp") if batch_sharded \
+            else self._mesh.replicated()
+        if getattr(raw, "sharding", None) == sh:
+            return raw
+        return jax.device_put(raw, sh)
+
     def _sig(self):
         return (tuple((n, tuple(a.shape), str(a.dtype))
                       for n, a in self._arg_dict.items()),
@@ -147,6 +195,21 @@ class Executor:
         args = {n: a._data for n, a in self._arg_dict.items()}
         auxs = {n: a._data for n, a in self._aux_dict.items()}
         rng = _random.next_key()
+        if self._mesh is not None:
+            # computation follows data: batch-shard what was fed this
+            # call, replicate everything else; XLA compiles ONE SPMD
+            # program and inserts the param-gradient all-reduce itself
+            args = {n: self._place(r, batch_sharded=n in kwargs)
+                    for n, r in args.items()}
+            auxs = {n: self._place(r, False) for n, r in auxs.items()}
+            rng = self._place(rng, False)
+            # keep the bound arrays mesh-resident too, so downstream
+            # eager work (optimizer update, metric pulls) sees matching
+            # placements instead of mixing primary-device and mesh arrays
+            for n, r in args.items():
+                self._arg_dict[n]._rebind(r)
+            for n, r in auxs.items():
+                self._aux_dict[n]._rebind(r)
         fwd = self._exe("fwd", self._sig(), bool(is_train))
         self._pull = None  # free previous residuals before the new forward
         if fwd.diff_names:
@@ -185,6 +248,8 @@ class Executor:
             if not isinstance(out_grads, (list, tuple)):
                 out_grads = [out_grads]
             cots = [_as_nd(g)._data for g in out_grads]
+        if self._mesh is not None:
+            cots = [self._place(c, batch_sharded=True) for c in cots]
         pull_exe = self._exe("pull", self._sig(), True)
         diff_names = tuple(sorted(
             n for n, r in self._grad_req.items() if r != "null"))
@@ -194,6 +259,10 @@ class Executor:
             g = grads[name]
             dst = self._grad_dict[name]
             if req == "add":
+                if self._mesh is not None:
+                    # first accumulation after bind: the zeros still live
+                    # on the primary device only
+                    dst._rebind(self._place(dst._data, False))
                 dst._rebind(dst._data + g.astype(dst._data.dtype))
             else:  # write
                 dst._rebind(g.astype(dst._data.dtype))
@@ -245,7 +314,7 @@ class Executor:
         shapes = {n: tuple(a.shape) for n, a in self._arg_dict.items()}
         shapes.update({k: tuple(v) for k, v in kwargs.items()})
         new = self._symbol.simple_bind(
-            self._ctx, grad_req=self._grad_req,
+            self._ctx_group or self._ctx, grad_req=self._grad_req,
             **{k: v for k, v in shapes.items()})
         for name, arr in self._arg_dict.items():
             if tuple(arr.shape) == tuple(new._arg_dict[name].shape):

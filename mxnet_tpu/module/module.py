@@ -36,8 +36,10 @@ class Module(BaseModule):
         self._symbol = symbol
         if context is None:
             context = cpu()
-        self._context = context[0] if isinstance(context, (list, tuple)) \
-            else context
+        # a list of contexts means data parallelism over the group; the
+        # Executor turns it into a dp mesh + ONE SPMD executable (GSPMD
+        # replacement for DataParallelExecutorGroup, executor_group.py:144)
+        self._context = context
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
@@ -142,6 +144,20 @@ class Module(BaseModule):
             optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        if kvstore and self._exec._mesh is not None:
+            # context-list (dp mesh) Modules: XLA already all-reduced the
+            # gradients inside the SPMD executable, so a single-process
+            # kvstore would only re-aggregate what is already global (the
+            # reference needs it for multi-GPU, executor_group + kvstore;
+            # GSPMD subsumes it). Cross-process stores still matter but
+            # hold primary-device copies incompatible with mesh arrays.
+            name = kvstore if isinstance(kvstore, str) else kvstore.type
+            if str(name).startswith("dist"):
+                raise MXNetError(
+                    "Module with a context list cannot use a dist kvstore;"
+                    " use parallel.ShardedTrainer (dp axis over all hosts)"
+                    " for multi-host data parallelism")
+            kvstore = None
         if kvstore:
             from .. import kvstore as kv_mod
 

@@ -482,6 +482,10 @@ class Symbol:
         import jax.numpy as jnp
 
         ctx = ctx or current_context()
+        # a context LIST means data-parallel over the group (reference:
+        # DataParallelExecutorGroup); arrays start on the primary device
+        # and the Executor replicates/shards them over its dp mesh
+        primary = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
         shape_hints = {k: v for k, v in kwargs.items()
                        if isinstance(v, (tuple, list))}
         shapes, dtypes = self._infer(
@@ -493,11 +497,12 @@ class Symbol:
             if key not in shapes:
                 raise MXNetError(f"simple_bind: shape of {name!r} unknown")
             arg_arrays[name] = NDArray(
-                jnp.zeros(shapes[key], dtypes[key]), ctx=ctx)
+                jnp.zeros(shapes[key], dtypes[key]), ctx=primary)
         aux_arrays = OrderedDict()
         for name in self.list_auxiliary_states():
             aux_arrays[name] = NDArray(
-                jnp.zeros(shapes["var", name], dtypes["var", name]), ctx=ctx)
+                jnp.zeros(shapes["var", name], dtypes["var", name]),
+                ctx=primary)
         return Executor(self, ctx, arg_arrays, aux_arrays, grad_req)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
