@@ -54,6 +54,9 @@ class Executor:
                         "contexts requested")
                 self._mesh = DeviceMesh({"dp": len(devs)}, devices=devs)
                 self._ctx_group = list(ctx)
+                # loop-invariant layouts, built once (hot path)
+                self._shard_dp = self._mesh.sharding("dp")
+                self._shard_rep = self._mesh.replicated()
             ctx = ctx[0]
         self._ctx = ctx
         self.arg_names = symbol.list_arguments()
@@ -84,6 +87,8 @@ class Executor:
                         src.dtype)
         self._run = symbol._build_eval()
         self._warned_uneven = False
+        self._warned_argdict = False
+        self._fed_names = set()  # args ever fed via forward kwargs (sticky)
         self._jit = {}
         self.outputs = []
         self._last = None  # (args_raw, auxs_raw, key) from latest forward
@@ -145,14 +150,17 @@ class Executor:
         self._jit[key] = fn
         return fn
 
-    def _place(self, raw, batch_sharded):
+    def _place(self, raw, batch_sharded, warn_uneven=True):
         """Lay an array out on the dp mesh: batch-sharded for fed data,
-        replicated otherwise. No-op (no transfer) when already laid out."""
+        replicated otherwise. No-op (no transfer) when already laid out.
+        `warn_uneven=False` for arrays where replication is expected
+        (scalar-output cotangents), so the one-shot warning is saved for
+        genuinely uneven data batches."""
         import jax
 
         n = self._mesh.size("dp")
         if batch_sharded and not (raw.ndim > 0 and raw.shape[0] % n == 0):
-            if not self._warned_uneven:
+            if warn_uneven and not self._warned_uneven:
                 # silent replication would quietly throw away the
                 # requested parallelism (reference decide_slices splits
                 # unevenly instead, executor_group.py:282)
@@ -165,8 +173,7 @@ class Executor:
                     stacklevel=3)
                 self._warned_uneven = True
             batch_sharded = False
-        sh = self._mesh.sharding("dp") if batch_sharded \
-            else self._mesh.replicated()
+        sh = self._shard_dp if batch_sharded else self._shard_rep
         if getattr(raw, "sharding", None) == sh:
             return raw
         return jax.device_put(raw, sh)
@@ -196,10 +203,23 @@ class Executor:
         auxs = {n: a._data for n, a in self._aux_dict.items()}
         rng = _random.next_key()
         if self._mesh is not None:
-            # computation follows data: batch-shard what was fed this
-            # call, replicate everything else; XLA compiles ONE SPMD
+            # computation follows data: batch-shard what has been fed via
+            # kwargs (sticky — later arg_dict writes of the same name stay
+            # sharded), replicate everything else; XLA compiles ONE SPMD
             # program and inserts the param-gradient all-reduce itself
-            args = {n: self._place(r, batch_sharded=n in kwargs)
+            if kwargs:
+                self._fed_names.update(kwargs)
+            elif not self._fed_names and not self._warned_argdict:
+                import warnings
+
+                warnings.warn(
+                    "multi-context executor: pass batches as "
+                    "forward(name=array) so they shard over the device "
+                    "group; arrays only written into arg_dict are "
+                    "replicated (every device computes the full batch)",
+                    stacklevel=2)
+                self._warned_argdict = True
+            args = {n: self._place(r, batch_sharded=n in self._fed_names)
                     for n, r in args.items()}
             auxs = {n: self._place(r, False) for n, r in auxs.items()}
             rng = self._place(rng, False)
@@ -249,7 +269,9 @@ class Executor:
                 out_grads = [out_grads]
             cots = [_as_nd(g)._data for g in out_grads]
         if self._mesh is not None:
-            cots = [self._place(c, batch_sharded=True) for c in cots]
+            # scalar/non-batch outputs legitimately replicate — no warning
+            cots = [self._place(c, batch_sharded=True, warn_uneven=False)
+                    for c in cots]
         pull_exe = self._exe("pull", self._sig(), True)
         diff_names = tuple(sorted(
             n for n, r in self._grad_req.items() if r != "null"))

@@ -297,3 +297,27 @@ def test_executor_reshape_keeps_context_group():
     msgs = [str(x.message) for x in w if "not divisible" in str(x.message)]
     assert len(msgs) == 1, msgs
     assert exe2.outputs[0].shape == (10, 3)
+
+
+def test_executor_argdict_feed_hint_and_scalar_cotangent():
+    """Writing batches into arg_dict on a mesh executor hints once about
+    kwargs feeding; scalar-output backward does not burn the uneven-batch
+    warning (executor.py _place warn_uneven)."""
+    import warnings
+
+    ctxs = [mx.cpu(i) for i in range(4)]
+    data = mx.sym.var("data")
+    loss = mx.sym.make_loss(mx.sym.sum(data * mx.sym.var("w")))
+    exe = loss.simple_bind(ctxs, grad_req={"w": "write"},
+                           data=(8, 4), w=(8, 4))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        exe.arg_dict["data"][:] = mx.nd.ones((8, 4))
+        exe.forward(is_train=True)
+        exe.backward()  # scalar output -> replicated cotangent, no warning
+    hints = [str(x.message) for x in w if "arg_dict" in str(x.message)]
+    uneven = [str(x.message) for x in w if "not divisible" in str(x.message)]
+    assert len(hints) == 1, hints
+    assert not uneven, uneven
+    np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
+                               np.ones((8, 4)), rtol=1e-5)
