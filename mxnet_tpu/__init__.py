@@ -51,7 +51,7 @@ def __getattr__(name):
                 "profiler", "recordio", "callback", "monitor", "model",
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
                 "contrib", "util", "runtime", "onnx", "operator", "library",
-                "log"):
+                "log", "name", "attribute"):
         import importlib
 
         try:
@@ -64,4 +64,9 @@ def __getattr__(name):
                 f"module {__name__!r} has no attribute {name!r} ({e})") from None
         globals()[name] = mod
         return mod
+    if name == "AttrScope":  # reference exposes it at top level too
+        from .attribute import AttrScope
+
+        globals()[name] = AttrScope
+        return AttrScope
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,86 @@
+"""Attribute scoping (parity: `python/mxnet/attribute.py` AttrScope).
+
+    with mx.AttrScope(ctx_group="stage1", __lr_mult__="0.1"):
+        w = mx.sym.var("w")
+    w.attr("ctx_group")  # -> "stage1"
+
+Scope attributes apply to every symbol created inside the scope; scopes
+nest (inner wins per key) and are thread-local.
+
+Storage note (divergence from the reference's separate C++ attr map):
+this framework keeps a symbol node's operator parameters and its
+user/scope attributes in one dict, so scope attributes are stored
+dunder-normalized (``ctx_group`` -> ``__ctx_group__``) to keep them out
+of the operator-parameter namespace. `Symbol.attr` transparently falls
+back to the dunder form, so reference-style lookups keep working.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "dunder", "is_dunder", "current"]
+
+
+def dunder(key):
+    """Canonical storage form of a scope-attribute key."""
+    if is_dunder(key):
+        return key
+    return f"__{key}__"
+
+
+def is_dunder(key):
+    """True when `key` is already in storage form (user/scope attribute,
+    not an operator parameter)."""
+    return key.startswith("__") and key.endswith("__")
+
+
+class AttrScope:
+    """Attribute manager for scoping (parity: attribute.py:26)."""
+
+    _tls = threading.local()
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be string")
+        self._attr = {dunder(k): v for k, v in kwargs.items()}
+        self._saved_attr = None
+
+    def get(self, attr=None):
+        """Merge this scope's attributes under `attr` — user-passed attrs
+        win, on the canonical (dunder) storage form (parity:
+        attribute.py:45)."""
+        user = {dunder(k): v for k, v in (attr or {}).items()}
+        if self._attr:
+            ret = dict(self._attr)
+            ret.update(user)
+            return ret
+        return user
+
+    def __enter__(self):
+        stack = getattr(AttrScope._tls, "stack", None)
+        if stack is None:
+            stack = AttrScope._tls.stack = []
+        # nested scopes accumulate (inner wins per key); restored on exit
+        # so a scope object can be reused without leaking parent attrs
+        self._saved_attr = self._attr
+        if stack:
+            merged = dict(stack[-1]._attr)
+            merged.update(self._attr)
+            self._attr = merged
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        AttrScope._tls.stack.pop()
+        self._attr = self._saved_attr
+        self._saved_attr = None
+
+
+_DEFAULT = AttrScope()
+
+
+def current():
+    """The innermost active scope (an empty one outside any scope)."""
+    stack = getattr(AttrScope._tls, "stack", None)
+    return stack[-1] if stack else _DEFAULT

@@ -42,9 +42,9 @@ class _BlockScope:
         current = getattr(_BlockScope._tls, "value", None)
         if current is None:
             if prefix is None:
-                from ..base import name_manager
+                from .. import name as _name_mod
 
-                prefix = name_manager.get(hint) + "_"
+                prefix = _name_mod.current().get(None, hint) + "_"
             if params is None:
                 params = ParameterDict(prefix)
             else:

@@ -1,0 +1,78 @@
+"""Automatic naming scopes (parity: `python/mxnet/name.py`).
+
+`NameManager` generates unique names for anonymously-created symbols;
+`Prefix` prepends a fixed prefix to every auto-generated name:
+
+    with mx.name.Prefix("mlp_"):
+        net = mx.sym.FullyConnected(data, num_hidden=10)
+    # net.name == "mlp_fullyconnected0"
+
+Scopes are thread-local and nest; the innermost manager wins. Each
+manager owns its counters, so entering a fresh ``NameManager()``
+restarts numbering — exporting the same network twice under fresh
+scopes yields identical node names (the reference contract).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import name_manager as _default_counters
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Auto-name generator (parity: name.py NameManager). `get(name,
+    hint)` returns `name` unchanged when the user supplied one, else a
+    unique `hint`-based name from this manager's own counters."""
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self._counters = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counters.get(hint, 0)
+        self._counters[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        stack = getattr(NameManager._tls, "stack", None)
+        if stack is None:
+            stack = NameManager._tls.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        NameManager._tls.stack.pop()
+
+
+class Prefix(NameManager):
+    """Prefixing name manager (parity: name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(None, hint)
+
+
+class _DefaultNameManager(NameManager):
+    """The ambient manager outside any scope: backed by the process-wide
+    (thread-local) counter table in `base`, so default auto-names stay
+    globally unique across the nd/sym/gluon entry points."""
+
+    def get(self, name, hint):
+        return name if name else _default_counters.get(hint)
+
+
+_DEFAULT = _DefaultNameManager()
+
+
+def current():
+    """The innermost active manager (the default one outside any scope)."""
+    stack = getattr(NameManager._tls, "stack", None)
+    return stack[-1] if stack else _DEFAULT

@@ -73,6 +73,15 @@ _OPTIONAL_ARRAY_PARAMS = frozenset(
 _RUNTIME_PARAMS = frozenset({"key", "training"})
 
 
+def _op_kwargs(node):
+    """Node attrs minus dunder-keyed user/scope attributes (AttrScope,
+    __shape__/__lr_mult__-style) — only real operator parameters may
+    reach the op callable."""
+    from ..attribute import is_dunder
+
+    return {k: v for k, v in node.attrs.items() if not is_dunder(k)}
+
+
 def _sig_params(op):
     try:
         return list(inspect.signature(op.fn).parameters.values())
@@ -192,8 +201,14 @@ class Symbol:
 
     # -------------------------------------------------------------- attrs --
     def attr(self, key):
+        from ..attribute import dunder, is_dunder
+
         if len(self._entries) == 1:
-            value = self._entries[0][0].attrs.get(key)
+            attrs = self._entries[0][0].attrs
+            value = attrs.get(key)
+            if value is None and not is_dunder(key):
+                # AttrScope attrs are stored dunder-normalized
+                value = attrs.get(dunder(key))
             return None if value is None else str(value)
         return None
 
@@ -371,7 +386,7 @@ class Symbol:
                 in_raws = [vals[id(c), oi] for c, oi in node.inputs]
                 if _amp_core.ACTIVE:
                     in_raws = _amp_core.cast_inputs(node.op, in_raws)
-                kwargs = dict(node.attrs)
+                kwargs = _op_kwargs(node)
                 sig_names = [p.name for p in _sig_params(op)]
                 is_train = training and not kwargs.get("use_global_stats",
                                                        False)
@@ -420,7 +435,7 @@ class Symbol:
                 continue
             op = _registry.get(node.op)
             in_nds = [vals[id(c), oi] for c, oi in node.inputs]
-            kwargs = dict(node.attrs)
+            kwargs = _op_kwargs(node)
             sig_names = [p.name for p in _sig_params(op)]
             is_train = training and not kwargs.get("use_global_stats", False)
             if "training" in sig_names and node.op != "Dropout":
@@ -645,7 +660,7 @@ def _eval_shape_node(node, in_structs):
     import jax.numpy as jnp
 
     op = _registry.get(node.op)
-    kwargs = dict(node.attrs)
+    kwargs = _op_kwargs(node)
     sig_names = [p.name for p in _sig_params(op)]
     if "training" in sig_names:
         kwargs["training"] = False
@@ -772,8 +787,10 @@ def _apply_op(op_name, args, kwargs):
               if not isinstance(v, Symbol) and k not in _RUNTIME_PARAMS}
 
     if name is None:
+        from .. import name as _name_mod
+
         hint = op_name.lower().lstrip("_")
-        name = name_manager.get(hint)
+        name = _name_mod.current().get(None, hint)
 
     layer_params = {p[0]: p for p in _LAYER_PARAMS.get(op.name, ())}
     inputs = []  # (sig_param_name, Symbol-or-None)
@@ -824,6 +841,11 @@ def _apply_op(op_name, args, kwargs):
         raise MXNetError(f"op {op_name!r}: unexpected symbol inputs "
                          f"{sorted(sym_kwargs)}")
 
+    from .. import attribute as _attribute
+
+    scope_attrs = _attribute.current().get()
+    if scope_attrs:  # AttrScope: dunder keys, never op parameters
+        static = dict(scope_attrs, **static)
     node = _Node(op.name, name, static,
                  [(s._entries[0][0], s._entries[0][1])
                   for _, s in inputs if s is not None],
@@ -837,7 +859,9 @@ def _apply_op(op_name, args, kwargs):
 def var(name, attr=None, shape=None, dtype=None, init=None, is_aux=False,
         **kwargs):
     """A named graph input (parity: symbol.py var/Variable)."""
-    attrs = dict(attr or {})
+    from .. import attribute as _attribute
+
+    attrs = _attribute.current().get(attr)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:

@@ -261,3 +261,79 @@ def test_optimize_for_pass_registry():
     with pytest.raises(mx.MXNetError):
         net.optimize_for("not_a_backend")
     S.GRAPH_PASSES.pop("test_identity_pass")
+
+
+def test_name_prefix_scope():
+    """mx.name.Prefix prefixes auto-generated names (parity: name.py)."""
+    import mxnet_tpu.name as mxname
+
+    data = mx.sym.var("data")
+    with mxname.Prefix("mlp_"):
+        net = mx.sym.FullyConnected(data, num_hidden=4)
+    assert net.name.startswith("mlp_fullyconnected")
+    plain = mx.sym.FullyConnected(data, num_hidden=4)
+    assert not plain.name.startswith("mlp_")
+
+
+def test_attr_scope():
+    """AttrScope attrs land on symbols created in scope, nest with inner
+    priority, never leak into op execution (parity: attribute.py)."""
+    data = mx.sym.var("data")
+    with mx.AttrScope(ctx_group="stage1", __lr_mult__="0.1"):
+        w = mx.sym.var("w")
+        net = mx.sym.FullyConnected(data, weight=w, num_hidden=3,
+                                    no_bias=True)
+        with mx.AttrScope(ctx_group="stage2"):
+            inner = mx.sym.var("b")
+    assert w.attr("ctx_group") == "stage1"
+    assert w.attr("__ctx_group__") == "stage1"  # storage form
+    assert w.attr("lr_mult") == "0.1"
+    assert net.attr("ctx_group") == "stage1"
+    assert inner.attr("ctx_group") == "stage2"
+    assert inner.attr("lr_mult") == "0.1"  # inherited from outer scope
+    outside = mx.sym.var("o")
+    assert outside.attr("ctx_group") is None
+    # scope attrs must not reach the op callable: bind + run the net
+    exe = net.simple_bind(mx.cpu(), data=(2, 5), w=(3, 5))
+    exe.forward(is_train=False, data=mx.nd.ones((2, 5)),
+                w=mx.nd.ones((3, 5)))
+    assert exe.outputs[0].shape == (2, 3)
+    # attrs survive a json round-trip
+    back = mx.sym.load_json(net.tojson())
+    assert back.attr("ctx_group") == "stage1"
+
+
+def test_attr_and_name_scope_edge_cases():
+    """User attrs override scope attrs on the canonical form; reused
+    scopes don't leak parent attrs; fresh NameManagers restart numbering;
+    gluon blocks honor name.Prefix."""
+    import mxnet_tpu.name as mxname
+    from mxnet_tpu import gluon
+
+    # user override wins on the storage form too
+    with mx.AttrScope(ctx_group="a"):
+        w = mx.sym.var("w", attr={"ctx_group": "b"})
+    assert w.attr("ctx_group") == "b"
+    assert w.attr("__ctx_group__") == "b"
+
+    # reusing a scope object after nesting must not leak parent attrs
+    s = mx.AttrScope(a="1")
+    with mx.AttrScope(b="2"):
+        with s:
+            pass
+    with s:
+        v = mx.sym.var("x2")
+    assert v.attr("b") is None and v.attr("a") == "1"
+
+    # fresh NameManager scopes restart numbering -> deterministic names
+    data = mx.sym.var("data")
+    with mxname.NameManager():
+        n1 = mx.sym.FullyConnected(data, num_hidden=2).name
+    with mxname.NameManager():
+        n2 = mx.sym.FullyConnected(data, num_hidden=2).name
+    assert n1 == n2 == "fullyconnected0"
+
+    # gluon auto-prefix flows through the name scope
+    with mxname.Prefix("pp_"):
+        d = gluon.nn.Dense(3)
+    assert d.prefix.startswith("pp_dense")
