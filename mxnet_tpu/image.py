@@ -22,7 +22,9 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "ImageIter",
            "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
            "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
-           "ColorNormalizeAug", "RandomGrayAug"]
+           "ColorNormalizeAug", "RandomGrayAug", "ImageDetIter",
+           "DetAugmenter", "DetHorizontalFlipAug", "DetBorderAug",
+           "CreateDetAugmenter"]
 
 
 def _to_np(x):
@@ -364,12 +366,22 @@ class ImageIter:
         with open(os.path.join(self.path_root, fname), "rb") as f:
             return label, _decode_np(f.read())
 
+    # hooks overridden by ImageDetIter (shared batch-assembly loop below)
+    def _empty_label_batch(self):
+        return _np.zeros((self.batch_size, self.label_width), _np.float32)
+
+    def _process_sample(self, arr, label):
+        """Augment one sample; returns (HWC image, per-sample label row)."""
+        for aug in self.auglist:
+            arr = aug(arr)
+        return arr, label
+
     def next(self):
         from .io import DataBatch
 
         c, h, w = self.data_shape
         batch_data = _np.zeros((self.batch_size, h, w, c), _np.float32)
-        batch_label = _np.zeros((self.batch_size, self.label_width), _np.float32)
+        batch_label = self._empty_label_batch()
         i = 0
         while i < self.batch_size:
             try:
@@ -378,8 +390,7 @@ class ImageIter:
                 if i == 0:
                     raise
                 break
-            for aug in self.auglist:
-                arr = aug(arr)
+            arr, label = self._process_sample(arr, label)
             arr = _to_np(arr)
             if arr.shape[:2] != (h, w):
                 arr = _resize_np(arr, w, h)
@@ -403,3 +414,211 @@ class ImageIter:
 
     def __next__(self):
         return self.next()
+
+
+# ---------------------------------------------------------------------------
+# object-detection iterator (parity: python/mxnet/image/detection.py)
+class DetAugmenter:
+    """Detection augmenter: transforms (image, boxes) jointly
+    (parity: detection.py:40 DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Random horizontal flip of image AND normalized boxes
+    (parity: detection.py:116)."""
+
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = _to_np(src)[:, ::-1]
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+class DetBorderAug(DetAugmenter):
+    """Pad to a square canvas with probability `p`, rescaling boxes
+    (parity: detection.py DetRandomPadAug, simplified geometry)."""
+
+    def __init__(self, fill=127, p=1.0):
+        self.fill = fill
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() >= self.p:
+            return src, label
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        s = max(h, w)
+        if h == w:
+            return src, label
+        out = _np.full((s, s, arr.shape[2]), self.fill, arr.dtype)
+        y0, x0 = (s - h) // 2, (s - w) // 2
+        out[y0:y0 + h, x0:x0 + w] = arr
+        label = label.copy()
+        valid = label[:, 0] >= 0
+        label[valid, 1] = (label[valid, 1] * w + x0) / s
+        label[valid, 3] = (label[valid, 3] * w + x0) / s
+        label[valid, 2] = (label[valid, 2] * h + y0) / s
+        label[valid, 4] = (label[valid, 4] * h + y0) / s
+        return out, label
+
+
+class _DetImageAug(DetAugmenter):
+    """Wrap an image-only Augmenter for detection pipelines (geometry-
+    preserving augmenters only: resize/cast/normalize)."""
+
+    def __init__(self, aug):
+        self.aug = aug
+
+    def __call__(self, src, label):
+        return self.aug(src), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
+                       std=None, fill=127, rand_pad=0, **kwargs):
+    """Detection augmenter pipeline (parity: detection.py:242
+    CreateDetAugmenter — the geometry-changing crop family is reduced to
+    pad+flip; photometric augs reuse the classification Augmenters).
+    Unsupported reference arguments raise instead of silently skipping
+    the requested augmentation."""
+    if kwargs:
+        raise ValueError(
+            f"unsupported CreateDetAugmenter arguments {sorted(kwargs)}; "
+            "supported: resize, rand_mirror, mean, std, fill, rand_pad")
+    auglist = []
+    if resize > 0:
+        auglist.append(_DetImageAug(ResizeAug(resize)))
+    if rand_pad > 0:
+        auglist.append(DetBorderAug(fill, p=rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(_DetImageAug(CastAug()))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = _np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = _np.array([58.395, 57.12, 57.375])
+        auglist.append(_DetImageAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: variable-object bbox labels padded to a fixed
+    (max_objects, label_width) tensor per image, -1 class id marking
+    filler rows (parity: detection.py:625 ImageDetIter).
+
+    Per-sample labels are either flat ``k*5`` floats
+    ``[cls, xmin, ymin, xmax, ymax] * k`` (normalized coords) or the
+    reference's packed format ``[header_width, object_width, ...,
+    objects...]`` (detection.py _parse_label). The fixed label shape
+    keeps XLA signatures constant across batches.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, data_name="data", label_name="label",
+                 label_shape=None, **kwargs):
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=aug_list if aug_list is not None
+                         else CreateDetAugmenter(data_shape),
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
+        from .io import DataDesc
+
+        if label_shape is None:
+            label_shape = self._discover_label_shape()
+        self.label_shape = tuple(label_shape)
+        self.provide_label = [DataDesc(
+            label_name, (batch_size,) + self.label_shape, _np.float32)]
+
+    @staticmethod
+    def _parse_label(raw):
+        """Flat floats -> (k, width) array (parity: detection.py:744)."""
+        raw = _np.asarray(raw, _np.float32).ravel()
+        if raw.size >= 2 and float(raw[0]).is_integer() and \
+                float(raw[1]).is_integer() and 2 <= raw[1] <= 32 and \
+                raw[0] >= 2 and (raw.size - raw[0]) % raw[1] == 0:
+            header, width = int(raw[0]), int(raw[1])
+            body = raw[header:]
+        elif raw.size % 5 == 0:
+            width, body = 5, raw
+        else:
+            raise ValueError(f"cannot parse detection label of size "
+                             f"{raw.size}")
+        return body.reshape(-1, width)
+
+    def _iter_raw_labels(self):
+        """All labels WITHOUT decoding any image (labels are in memory
+        for .lst sources and in the record headers for .rec)."""
+        if self.imglist is not None:
+            for label, _ in self.imglist.values():
+                yield label
+        else:
+            from . import recordio
+
+            for idx in self.seq:
+                header, _ = recordio.unpack(self.imgrec.read_idx(idx))
+                yield header.label
+
+    def _discover_label_shape(self):
+        max_obj, width = 1, 5
+        for label in self._iter_raw_labels():
+            parsed = self._parse_label(label)
+            max_obj = max(max_obj, parsed.shape[0])
+            width = max(width, parsed.shape[1])
+        return (max_obj, width)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """parity: detection.py reshape."""
+        from .io import DataDesc
+
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+            self.provide_data = [DataDesc(
+                self.provide_data[0].name,
+                (self.batch_size,) + self.data_shape, _np.float32)]
+        if label_shape is not None:
+            self.label_shape = tuple(label_shape)
+            self.provide_label = [DataDesc(
+                self.provide_label[0].name,
+                (self.batch_size,) + self.label_shape, _np.float32)]
+
+    def sync_label_shape(self, it, verbose=False):
+        """Grow both iterators' label shapes to the elementwise max
+        (parity: detection.py sync_label_shape)."""
+        assert isinstance(it, ImageDetIter)
+        train, val = self.label_shape, it.label_shape
+        shape = (max(train[0], val[0]), max(train[1], val[1]))
+        self.reshape(label_shape=shape)
+        it.reshape(label_shape=shape)
+        return it
+
+    # hooks consumed by the shared ImageIter.next batch-assembly loop
+    def _empty_label_batch(self):
+        return _np.full((self.batch_size,) + self.label_shape, -1.0,
+                        _np.float32)
+
+    def _process_sample(self, arr, label):
+        max_obj, width = self.label_shape
+        parsed = self._parse_label(label)
+        if parsed.shape[0] > max_obj or parsed.shape[1] > width:
+            raise ValueError(
+                f"sample label shape {parsed.shape} exceeds label_shape "
+                f"{self.label_shape}; pass a larger label_shape (or use "
+                "sync_label_shape) — boxes are never silently dropped")
+        full = _np.full((max_obj, width), -1.0, _np.float32)
+        full[:parsed.shape[0], :parsed.shape[1]] = parsed
+        for aug in self.auglist:
+            arr, full = aug(arr, full)
+        return arr, full

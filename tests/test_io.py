@@ -343,3 +343,101 @@ def test_csr_is_lazy():
     assert csr._dense_cache is None
     dense = csr.tostype("default")
     assert float(dense.asnumpy()[1, 2]) == 2.0
+
+
+def test_image_det_iter(tmp_path):
+    """ImageDetIter: packed + flat label parsing, fixed (max_obj, width)
+    label tensor with -1 filler, flip augmenter moves boxes
+    (parity model: test_image.py TestImageDetIter)."""
+    import os
+
+    from mxnet_tpu import image as img_mod
+
+    root = str(tmp_path)
+    rng = np.random.RandomState(0)
+    lines = []
+    labels = [
+        # flat k*5: one object
+        [1.0, 0.1, 0.2, 0.5, 0.6],
+        # packed: header=4, width=5, two extra header floats, 2 objects
+        [4.0, 5.0, 0.0, 0.0,
+         0.0, 0.0, 0.0, 0.4, 0.4, 2.0, 0.5, 0.5, 0.9, 0.8],
+    ]
+    for i, lab in enumerate(labels):
+        arr = (rng.rand(10, 8, 3) * 255).astype(np.uint8)
+        import mxnet_tpu.recordio as recordio
+
+        body = recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), arr,
+                                 img_fmt=".png")
+        _, img_bytes = recordio.unpack(body)
+        fname = f"img{i}.png"
+        with open(os.path.join(root, fname), "wb") as f:
+            f.write(img_bytes)
+        cols = "\t".join(str(x) for x in lab)
+        lines.append(f"{i}\t{cols}\t{fname}")
+    with open(os.path.join(root, "list.lst"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+    it = img_mod.ImageDetIter(batch_size=2, data_shape=(3, 8, 8),
+                              path_imglist=os.path.join(root, "list.lst"),
+                              path_root=root,
+                              aug_list=[])  # deterministic
+    assert it.label_shape == (2, 5)  # max 2 objects, width 5
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 8, 8)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (2, 2, 5)
+    np.testing.assert_allclose(lab[0, 0], [1.0, 0.1, 0.2, 0.5, 0.6],
+                               rtol=1e-6)
+    assert lab[0, 1, 0] == -1.0  # filler row
+    np.testing.assert_allclose(lab[1, 1], [2.0, 0.5, 0.5, 0.9, 0.8],
+                               rtol=1e-6)
+
+    # flip moves normalized x coords; filler rows untouched
+    flip = img_mod.DetHorizontalFlipAug(p=1.1)  # always fires
+    src = np.zeros((4, 4, 3), np.uint8)
+    label = np.array([[0.0, 0.1, 0.2, 0.4, 0.6],
+                      [-1.0, 0, 0, 0, 0]], np.float32)
+    _, out = flip(src, label)
+    np.testing.assert_allclose(out[0], [0.0, 0.6, 0.2, 0.9, 0.6],
+                               rtol=1e-5)
+    assert out[1, 0] == -1.0
+
+    # sync_label_shape grows both iterators to the elementwise max
+    it2 = img_mod.ImageDetIter(batch_size=2, data_shape=(3, 8, 8),
+                               path_imglist=os.path.join(root, "list.lst"),
+                               path_root=root, label_shape=(5, 6),
+                               aug_list=[])
+    it.sync_label_shape(it2)
+    assert it.label_shape == (5, 6) and it2.label_shape == (5, 6)
+
+
+def test_image_det_iter_validation(tmp_path):
+    """Oversized labels raise instead of silently truncating; unsupported
+    CreateDetAugmenter args raise."""
+    import os
+
+    import pytest
+
+    import mxnet_tpu.recordio as recordio
+    from mxnet_tpu import image as img_mod
+
+    root = str(tmp_path)
+    arr = (np.random.RandomState(1).rand(8, 8, 3) * 255).astype(np.uint8)
+    body = recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), arr,
+                             img_fmt=".png")
+    _, img_bytes = recordio.unpack(body)
+    with open(os.path.join(root, "a.png"), "wb") as f:
+        f.write(img_bytes)
+    with open(os.path.join(root, "l.lst"), "w") as f:
+        f.write("0\t" + "\t".join(
+            str(x) for x in [1.0, 0.1, 0.1, 0.2, 0.2,
+                             2.0, 0.3, 0.3, 0.4, 0.4]) + "\ta.png\n")
+    it = img_mod.ImageDetIter(batch_size=1, data_shape=(3, 8, 8),
+                              path_imglist=os.path.join(root, "l.lst"),
+                              path_root=root, label_shape=(1, 5),
+                              aug_list=[])
+    with pytest.raises(ValueError, match="exceeds label_shape"):
+        it.next()
+    with pytest.raises(ValueError, match="unsupported"):
+        img_mod.CreateDetAugmenter((3, 8, 8), rand_crop=0.5)
