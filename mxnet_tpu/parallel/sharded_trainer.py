@@ -61,11 +61,33 @@ class ShardedTrainer:
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh: Optional[DeviceMesh] = None, rules=None, donate=True):
+                 mesh: Optional[DeviceMesh] = None, rules=None, donate=True,
+                 zero=False, remat=False, accum_steps=1):
+        """Extra memory levers (all off by default, numerics unchanged):
+
+        zero : ZeRO-1 — optimizer state lives dp-sharded (state memory
+            divided by the dp size) and the update math runs sharded;
+            only the parameter delta is all-gathered. Expressed as GSPMD
+            sharding constraints, not manual collectives.
+        remat : `jax.checkpoint` around the forward — backward
+            recomputes activations instead of storing them (long-context
+            / deep-model memory for FLOPs trade).
+        accum_steps : gradient accumulation — the global batch is split
+            into this many microbatches scanned inside the ONE compiled
+            step (activation memory of one microbatch, numerics of the
+            full batch for deterministic nets; stochastic layers like
+            Dropout draw one rng key per microbatch, so their sample
+            stream differs from the accum=1 run).
+        """
         self._net = net
         self._loss_fn = loss_fn
         self._mesh = mesh or DeviceMesh()
         self._donate = donate
+        self._zero = bool(zero)
+        self._remat = bool(remat)
+        self._accum = int(accum_steps)
+        if self._accum < 1:
+            raise ValueError("accum_steps must be >= 1")
         opt_params = dict(optimizer_params or {})
         self._lr = float(opt_params.pop("learning_rate", 0.01))
         self._momentum = float(opt_params.pop("momentum", 0.0))
@@ -107,6 +129,22 @@ class ShardedTrainer:
     def _spec_for(self, name):
         return self._mesh.sharding(*self._rules.get(name, ()))
 
+    def _state_spec_for(self, name, shape):
+        """Optimizer-state layout: the parameter's own spec, or — under
+        ZeRO — additionally dp-sharded on the first divisible unsharded
+        dim, dividing state memory by the dp size (ZeRO-1)."""
+        spec = tuple(self._rules.get(name, ()))
+        if not self._zero:
+            return self._mesh.sharding(*spec)
+        dp = self._mesh.size("dp")
+        full = spec + (None,) * (len(shape) - len(spec))
+        if dp > 1 and "dp" not in full:
+            for i, (s, d) in enumerate(zip(full, shape)):
+                if s is None and d % dp == 0:
+                    full = full[:i] + ("dp",) + full[i + 1:]
+                    break
+        return self._mesh.sharding(*full)
+
     def _place_params(self):
         """Lay parameters out on the mesh per the rules (replicate or
         tp-shard) — the device_put that replaces per-GPU weight copies."""
@@ -117,7 +155,8 @@ class ShardedTrainer:
         for name, h in zip(self._aux_names, self._aux_handles):
             h._rebind(jax.device_put(h._data, self._mesh.replicated()))
         self._opt_raws = tuple(
-            tuple(jax.device_put(s, self._spec_for(name)) for s in per)
+            tuple(jax.device_put(s, self._state_spec_for(name, s.shape))
+                  for s in per)
             for name, per in zip(self._param_names, self._opt_raws))
 
     def _init_opt_state(self):
@@ -171,13 +210,69 @@ class ShardedTrainer:
                 for h, orig in saved:
                     h._data = orig
 
+        if self._remat:
+            # trade FLOPs for memory: backward re-derives activations
+            run_net = jax.checkpoint(run_net)
+        accum = self._accum
+        zero = self._zero
+        # ZeRO-1: the state layout each param's update math is pinned to
+        state_sh = [self._state_spec_for(n, h._data.shape)
+                    for n, h in zip(self._param_names, train_handles)]
+
+        def grads_of(praws, araws, x, y, rng):
+            """(loss, new_aux), grads for the FULL batch — directly, or
+            accumulated over `accum` scanned microbatches (activation
+            memory of one microbatch, numerics of the whole batch)."""
+            if accum == 1:
+                return jax.value_and_grad(run_net, has_aux=True)(
+                    praws, araws, x, y, rng)
+            b = x.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum}")
+            dp = self._mesh.size("dp")
+            if (b // accum) % dp:
+                import warnings
+
+                warnings.warn(
+                    f"microbatch size {b // accum} not divisible by the "
+                    f"dp size {dp}: some devices idle every scan step — "
+                    "accumulation should trade memory for time, not "
+                    "parallelism", stacklevel=3)
+            xs = x.reshape((accum, b // accum) + x.shape[1:])
+            ys = y.reshape((accum, b // accum) + y.shape[1:])
+            # keep each microbatch dp-sharded after the fold
+            xs = jax.lax.with_sharding_constraint(
+                xs, self._mesh.sharding(
+                    *((None, "dp") + (None,) * (len(x.shape) - 1))))
+            rngs = jax.random.split(rng, accum)
+
+            def micro(carry, inp):
+                g_acc, loss_acc, araws_c = carry
+                xm, ym, rm = inp
+                (l, new_aux), g = jax.value_and_grad(
+                    run_net, has_aux=True)(praws, araws_c, xm, ym, rm)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, loss_acc + l, new_aux), None
+
+            init = (jax.tree_util.tree_map(jnp.zeros_like, praws),
+                    jnp.zeros((), jnp.float32), araws)
+            (g_sum, loss_sum, new_aux), _ = jax.lax.scan(
+                micro, init, (xs, ys, rngs))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
+            return (loss_sum / accum, new_aux), grads
+
         def step_fn(praws, opt_raws, araws, x, y, rng, t):
-            (loss, new_aux), grads = jax.value_and_grad(
-                run_net, has_aux=True)(praws, araws, x, y, rng)
+            (loss, new_aux), grads = grads_of(praws, araws, x, y, rng)
             new_p, new_opt = [], []
             for i, (w, g, st) in enumerate(zip(praws, grads, opt_raws)):
                 pwd = wd * wd_mult[i]
                 g = g.astype(w.dtype)  # keep update arithmetic in param dtype
+                if zero:
+                    # pin gradient (and hence m/v and the delta math) to
+                    # the dp-sharded state layout; XLA all-gathers only
+                    # the final parameter delta (ZeRO-1)
+                    g = jax.lax.with_sharding_constraint(g, state_sh[i])
                 if opt_name == "sgd":
                     if momentum:
                         mom = momentum * st[0] - lr * (g + pwd * w)
@@ -195,10 +290,11 @@ class ShardedTrainer:
                     new_opt.append((m, v))
             return tuple(new_p), tuple(new_opt), new_aux, loss
 
-        # shardings: batch over dp; params/opt per rules; aux replicated
+        # shardings: batch over dp; params per rules; opt state reuses the
+        # per-param state layout the update math is pinned to; aux replicated
         p_sh = tuple(self._spec_for(n) for n in self._param_names)
-        opt_sh = tuple(tuple(self._spec_for(n) for _ in per)
-                       for n, per in zip(self._param_names, self._opt_raws))
+        opt_sh = tuple(tuple(state_sh[i] for _ in per)
+                       for i, per in enumerate(self._opt_raws))
         aux_sh = (self._mesh.replicated(),) * n_aux
         data_spec = ("dp",) + (None,) * (len(x_raw.shape) - 1)
         x_sh = self._mesh.sharding(*data_spec)
