@@ -379,6 +379,112 @@ class ShardedTrainer:
             tuple(h._data for h in self._aux_handles), x_raw)
         return NDArray(out)
 
+    # ------------------------------------------------------- checkpoint ---
+    def _ckpt_keys(self):
+        """Expected entry keys, POSITIONAL (collect_params order) so a
+        fresh process with fresh gluon auto-prefixes can resume."""
+        keys = ["__t__", "__rng_seed__", "__rng_key__", "__names__"]
+        keys += [f"p{i}" for i in range(len(self._param_names))]
+        keys += [f"a{i}" for i in range(len(self._aux_names))]
+        for i, per in enumerate(self._opt_raws):
+            keys += [f"s{i}_{j}" for j in range(len(per))]
+        return keys
+
+    def save_states(self, fname):
+        """Checkpoint params + optimizer state + step counter + the
+        global RNG stream to one file in the `mx.nd.save` container
+        (bf16 handled there as uint16 bits). Entries are positional,
+        keyed by `collect_params()` order, so resuming into a freshly
+        built identical architecture works even though gluon
+        auto-prefixes differ between processes. parity role:
+        Trainer.save_states + model checkpoints (SURVEY §5.4)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import random as _rand
+        from ..ndarray import utils as nd_utils
+
+        _rand._ensure()
+        names_blob = "\n".join(self._param_names + self._aux_names)
+        payload = {
+            "__t__": NDArray(jnp.asarray(self._t, jnp.int32)),
+            "__rng_seed__": NDArray(
+                jnp.asarray(_rand.current_seed(), jnp.int32)),
+            "__rng_key__": NDArray(jnp.asarray(
+                jax.device_get(_rand._state.key))),
+            "__names__": NDArray(jnp.asarray(_np.frombuffer(
+                names_blob.encode(), _np.uint8))),
+        }
+        for i, h in enumerate(self._train_handles):
+            payload[f"p{i}"] = NDArray(jax.device_get(h._data))
+        for i, h in enumerate(self._aux_handles):
+            payload[f"a{i}"] = NDArray(jax.device_get(h._data))
+        for i, per in enumerate(self._opt_raws):
+            for j, s in enumerate(per):
+                payload[f"s{i}_{j}"] = NDArray(jax.device_get(s))
+        nd_utils.save(fname, payload)
+
+    def load_states(self, fname):
+        """Restore a `save_states` checkpoint, re-laying every tensor out
+        on this trainer's mesh (mesh/rules/ZeRO layout may differ from
+        the saving run — resharding is just a fresh device_put). Also
+        restores the global RNG stream, so a resumed run reproduces the
+        uninterrupted run's sample stream exactly. The key set AND every
+        tensor shape are validated before anything is mutated — a failed
+        load never leaves the trainer half-restored."""
+        import jax
+
+        from .. import random as _rand
+        from ..ndarray import utils as nd_utils
+
+        arrays = nd_utils.load(fname)
+        expected = set(self._ckpt_keys())
+        got = set(arrays)
+        if expected != got:
+            raise ValueError(
+                "checkpoint does not match this trainer: missing "
+                f"{sorted(expected - got)[:5]}, unexpected "
+                f"{sorted(got - expected)[:5]} (param count or optimizer "
+                "differs)")
+        shape_of = {}
+        for i, h in enumerate(self._train_handles):
+            shape_of[f"p{i}"] = tuple(h._data.shape)
+        for i, h in enumerate(self._aux_handles):
+            shape_of[f"a{i}"] = tuple(h._data.shape)
+        for i, per in enumerate(self._opt_raws):
+            for j, s in enumerate(per):
+                shape_of[f"s{i}_{j}"] = tuple(s.shape)
+        bad = [(k, tuple(arrays[k].shape), want)
+               for k, want in shape_of.items()
+               if tuple(arrays[k].shape) != want]
+        if bad:
+            k, got_s, want_s = bad[0]
+            raise ValueError(
+                f"checkpoint does not match this trainer: entry {k!r} "
+                f"has shape {got_s}, trainer expects {want_s} "
+                f"(saved param order: "
+                f"{bytes(_np.asarray(arrays['__names__']._data)).decode()})")
+
+        def take(key, want_dtype, spec):
+            return jax.device_put(
+                arrays[key]._data.astype(want_dtype), spec)
+
+        self._t = int(arrays["__t__"].asscalar())
+        _rand._ensure()
+        _rand._state.seed = int(arrays["__rng_seed__"].asscalar())
+        _rand._state.key = arrays["__rng_key__"]._data
+        for i, (name, h) in enumerate(zip(self._param_names,
+                                          self._train_handles)):
+            h._rebind(take(f"p{i}", h._data.dtype, self._spec_for(name)))
+        for i, h in enumerate(self._aux_handles):
+            h._rebind(take(f"a{i}", h._data.dtype, self._mesh.replicated()))
+        self._opt_raws = tuple(
+            tuple(take(f"s{i}_{j}", s.dtype,
+                       self._state_spec_for(name, s.shape))
+                  for j, s in enumerate(per))
+            for i, (name, per) in enumerate(zip(self._param_names,
+                                                self._opt_raws)))
+
     def unshard(self, ctx=None):
         """Gather parameters back to one device for eager/export use."""
         import jax
