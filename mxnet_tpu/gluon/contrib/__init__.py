@@ -3,6 +3,6 @@ experimental blocks (`nn`), the Estimator training facade
 (`estimator`), and contrib data helpers."""
 from __future__ import annotations
 
-from . import estimator, nn, rnn
+from . import data, estimator, nn, rnn
 
-__all__ = ["nn", "estimator", "rnn"]
+__all__ = ["nn", "estimator", "rnn", "data"]

@@ -1,0 +1,9 @@
+"""Experimental gluon data tools
+(parity: `python/mxnet/gluon/contrib/data/__init__.py`)."""
+from __future__ import annotations
+
+from . import text
+from .sampler import IntervalSampler
+from .text import WikiText2, WikiText103
+
+__all__ = ["IntervalSampler", "text", "WikiText2", "WikiText103"]

@@ -1,0 +1,30 @@
+"""Interval sampler (parity: `python/mxnet/gluon/contrib/data/sampler.py:25`)."""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Sample [0, length) at fixed `interval` strides; with `rollover`
+    restart from each skipped offset until every index is visited:
+
+        IntervalSampler(13, interval=3)  ->  0 3 6 9 12 1 4 7 10 2 5 8 11
+        IntervalSampler(13, interval=3, rollover=False)  ->  0 3 6 9 12
+    """
+
+    def __init__(self, length, interval, rollover=True):
+        if not 1 <= interval <= length:
+            raise ValueError(
+                f"interval {interval} must be in [1, length={length}]")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for start in range(self._interval if self._rollover else 1):
+            yield from range(start, self._length, self._interval)
+
+    def __len__(self):
+        return self._length

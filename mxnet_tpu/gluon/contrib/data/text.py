@@ -1,0 +1,103 @@
+"""Language-model datasets
+(parity: `python/mxnet/gluon/contrib/data/text.py:57` _WikiText family).
+
+The reference downloads the WikiText archives from the gluon dataset
+repo; this environment has no egress, so the datasets read the standard
+extracted token files (``wiki.train.tokens`` etc.) from `root` and raise
+a clear error telling the user where to place them. Tokenization, vocab
+construction (EOS-reserved, frequency-ordered), the next-token label
+shift, and the seq_len folding match the reference exactly, so sample
+streams are comparable.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ....contrib import text as _text
+from ...data.dataset import Dataset
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+class _WikiText(Dataset):
+    """Token-file-backed LM dataset: token stream -> (data, label) pairs
+    of shape (seq_len,) with label the 1-shifted stream."""
+
+    _segments = ("train", "validation", "test")
+    _file_pattern = None  # e.g. "wiki.{}.tokens"
+    _name = None
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        if segment not in self._segments:
+            raise ValueError(f"segment must be one of {self._segments}")
+        root = os.path.expanduser(
+            root or os.path.join(
+                os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")),
+                "datasets", self._name))
+        seg_file = {"train": "train", "validation": "valid",
+                    "test": "test"}[segment]
+        path = os.path.join(root, self._file_pattern.format(seg_file))
+        if not os.path.isfile(path):
+            raise FileNotFoundError(
+                f"{type(self).__name__} token file not found: {path}; "
+                "this environment has no network egress — place the "
+                "extracted WikiText token files there (the reference "
+                "would download them from the gluon dataset repo)")
+        self._vocab = vocab
+        self._counter = None
+        self._seq_len = seq_len
+        self._load(path)
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _load(self, path):
+        with open(path, "r", encoding="utf8") as f:
+            content = f.read()
+        if self._counter is None:
+            self._counter = _text.utils.count_tokens_from_str(content)
+        if self._vocab is None:
+            self._vocab = _text.vocab.Vocabulary(
+                counter=self._counter, reserved_tokens=[EOS_TOKEN])
+        lines = [x.strip().split() for x in content.splitlines()]
+        stream = []
+        for line in lines:
+            if line:
+                stream.extend(line)
+                stream.append(EOS_TOKEN)
+        ids = np.asarray(self._vocab.to_indices(stream), np.int32)
+        data, label = ids[:-1], ids[1:]
+        n = len(data) // self._seq_len * self._seq_len
+        self._data = data[:n].reshape(-1, self._seq_len)
+        self._label = label[:n].reshape(-1, self._seq_len)
+
+    def __getitem__(self, idx):
+        from .... import ndarray as nd
+
+        return nd.array(self._data[idx]), nd.array(self._label[idx])
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (parity: gluon/contrib/data/text.py:107)."""
+
+    _file_pattern = "wiki.{}.tokens"
+    _name = "wikitext-2"
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (parity: gluon/contrib/data/text.py:145)."""
+
+    _file_pattern = "wiki.{}.tokens"
+    _name = "wikitext-103"

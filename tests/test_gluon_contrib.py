@@ -169,3 +169,48 @@ def test_variational_dropout_cell():
     vd.reset()
     vd(x, vd.begin_state(2))
     assert vd._input_mask is None
+
+
+def test_interval_sampler():
+    """Reference doctest behavior (gluon/contrib/data/sampler.py:25)."""
+    import pytest
+
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    assert list(IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(IntervalSampler(13, interval=3, rollover=False)) == \
+        [0, 3, 6, 9, 12]
+    assert len(IntervalSampler(13, interval=3)) == 13
+    with pytest.raises(ValueError):
+        IntervalSampler(3, interval=5)
+    with pytest.raises(ValueError):
+        IntervalSampler(3, interval=0)
+
+
+def test_wikitext_local_file(tmp_path):
+    """WikiText2 over a local token file: vocab (EOS-reserved), 1-shifted
+    labels, seq_len folding (gluon/contrib/data/text.py)."""
+    import os
+
+    import pytest
+
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    root = str(tmp_path)
+    txt = " the cat sat \n\n the cat ran \n"
+    with open(os.path.join(root, "wiki.train.tokens"), "w") as f:
+        f.write(txt)
+    ds = WikiText2(root=root, segment="train", seq_len=4)
+    # stream: the cat sat <eos> the cat ran <eos> -> 7 usable pairs -> 1 row
+    assert len(ds) == 1
+    data, label = ds[0]
+    v = ds.vocabulary
+    assert v.to_tokens(int(data[0].asscalar())) == "the"
+    onp.testing.assert_array_equal(label.asnumpy()[:3],
+                                   data.asnumpy()[1:])
+    assert "<eos>" in v.reserved_tokens
+    with pytest.raises(FileNotFoundError, match="token file not found"):
+        WikiText2(root=root, segment="test")
+    with pytest.raises(ValueError):
+        WikiText2(root=root, segment="bogus")
