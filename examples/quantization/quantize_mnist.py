@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Post-training int8 quantization demo.
+
+Parity target: `example/quantization/imagenet_gen_qsym_onedal.py` /
+`quantize_model` flow — train fp32, calibrate on a few batches, quantize
+to int8, compare accuracy and report the gap. Runs on synthetic
+MNIST-like data so it works anywhere; pass --mnist-dir with the idx
+files for the real thing.
+
+    python examples/quantization/quantize_mnist.py --ctx tpu
+"""
+import argparse
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root
+sys.path.insert(0, os.path.join(os.path.dirname(_here),
+                                "image_classification"))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from common import data as common_data  # shared MNIST-or-synthetic iters
+from mxnet_tpu.contrib import quantization
+
+
+def build_sym():
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mnist-dir", default=None, dest="data_dir")
+    ap.add_argument("--num-examples", type=int, default=2048)
+    ap.add_argument("--num-val-examples", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--calib-batches", type=int, default=5)
+    ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    args = ap.parse_args()
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+
+    args.data_dir = args.data_dir or ""
+    train_it, eval_it = common_data.get_mnist_iter(args, None)
+    mod = mx.mod.Module(build_sym(), context=ctx)
+    mod.fit(train_it, num_epoch=args.num_epochs,
+            initializer=mx.init.Xavier(),
+            optimizer_params=(("learning_rate", 0.1),
+                              ("rescale_grad", 1.0 / args.batch_size)))
+    fp32_acc = dict(mod.score(eval_it, "acc"))["accuracy"]
+    print(f"fp32 accuracy: {fp32_acc:.4f}")
+
+    arg_params, aux_params = mod.get_params()
+    qsym, qarg, qaux = quantization.quantize_model(
+        build_sym(), arg_params, aux_params,
+        calib_data=train_it,
+        num_calib_examples=args.calib_batches * args.batch_size,
+        calib_mode="naive")
+    qmod = mx.mod.Module(qsym, context=ctx)
+    qmod.bind(eval_it.provide_data, eval_it.provide_label,
+              for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux, allow_missing=False)
+    int8_acc = dict(qmod.score(eval_it, "acc"))["accuracy"]
+    print(f"int8 accuracy: {int8_acc:.4f} "
+          f"(gap {fp32_acc - int8_acc:+.4f})")
+    assert int8_acc > fp32_acc - 0.05, "int8 accuracy dropped > 5%"
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

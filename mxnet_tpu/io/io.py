@@ -416,9 +416,12 @@ class MNISTIter(NDArrayIter):
             images = images.reshape(len(images), -1)
         else:
             images = images[:, None, :, :]  # NCHW
+        # reference default: C iterators surface their label as
+        # 'softmax_label' (python/mxnet/io/io.py:834 MXDataIter), which is
+        # what Module/fit binds against with real MNIST files
         super().__init__(images, labels, batch_size=batch_size, shuffle=shuffle,
                          last_batch_handle="discard",
-                         data_name="data", label_name="label",
+                         data_name="data", label_name="softmax_label",
                          rng=_np.random.RandomState(seed))
 
 
