@@ -60,6 +60,14 @@ _LAYER_PARAMS = {
     "RNN": [("params", False, None)],
     "LeakyReLU": [("gamma", False,
                    lambda a: a.get("act_type", "leaky") != "prelu")],
+    # loss heads auto-create their label input as '<name>_label' when not
+    # supplied (reference: mx.sym.SoftmaxOutput(net, name='softmax') then
+    # list_arguments() contains 'softmax_label')
+    "SoftmaxOutput": [("label", False, None)],
+    "SVMOutput": [("label", False, None)],
+    "LinearRegressionOutput": [("label", False, None)],
+    "LogisticRegressionOutput": [("label", False, None)],
+    "MAERegressionOutput": [("label", False, None)],
 }
 
 # signature params that are array inputs even though they default to None
@@ -727,6 +735,15 @@ def _param_shape_rules(node, data_struct):
         put(1, (attrs["input_dim"], attrs["output_dim"]))
     elif op == "RNN":
         put(1, (_rnn_param_size(dshape, attrs),))
+    elif op in ("SoftmaxOutput", "SVMOutput"):
+        # class-index labels: data shape minus the class dim (reference
+        # backward shape inference, softmax_output.cc)
+        if len(node.inputs) > 1:
+            put(1, dshape[:-1])
+    elif op in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                "MAERegressionOutput"):
+        if len(node.inputs) > 1:
+            put(1, dshape)  # regression labels match the prediction shape
     return rules
 
 

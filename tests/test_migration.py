@@ -1,0 +1,208 @@
+"""Migration smoke tests: idiomatic MXNet-1.x user code, unchanged.
+
+Each test is the body of a typical reference user script (the patterns
+from the reference's crash course / tutorials — NDArray basics, gluon
+training, Module workflow, hybridize+export, autograd, KVStore) run
+against this framework with only the import swapped. This is the
+product contract from README: "an MXNet user can switch with a context
+swap to mx.tpu()".
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def test_crash_course_ndarray():
+    """NDArray manipulation exactly as the crash course teaches."""
+    x = nd.ones((3, 4))
+    y = nd.random.uniform(-1, 1, (3, 4))
+    z = x * y + 2
+    assert z.shape == (3, 4)
+    assert z.size == 12
+    assert z.dtype == np.float32
+    n = z.asnumpy()
+    assert isinstance(n, np.ndarray)
+    w = nd.array(n)
+    np.testing.assert_allclose(w.asnumpy(), n)
+    # indexing/slicing idioms
+    assert y[1, 2].shape == ()
+    assert y[:, 1:3].shape == (3, 2)
+    y[:, 1:3] = 2
+    assert float(y[0, 1].asscalar()) == 2
+    y[1:2, 0:2] = 4
+    assert float(y[1, 0].asscalar()) == 4
+    # reshape/transpose/dot chain
+    a = nd.arange(12).reshape((3, 4))
+    b = nd.dot(a, a.T)
+    assert b.shape == (3, 3)
+    assert float(nd.sum(a).asscalar()) == 66
+
+
+def test_crash_course_gluon_train_loop():
+    """The canonical gluon loop: net/loss/Trainer/record/backward/step."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    X = np.random.randn(64, 8).astype(np.float32)
+    Yv = (X.sum(axis=1) > 0).astype(np.float32)
+    first = last = None
+    for _ in range(30):
+        data, label = nd.array(X), nd.array(Yv)
+        with autograd.record():
+            out = net(data)
+            loss = loss_fn(out, label)
+        loss.backward()
+        trainer.step(batch_size=64)
+        cur = float(loss.mean().asscalar())
+        first = first if first is not None else cur
+        last = cur
+    assert last < first * 0.7, (first, last)
+    acc = ((net(nd.array(X)).argmax(axis=1).asnumpy() == Yv).mean())
+    assert acc > 0.9
+
+
+def test_hybridize_export_symbolblock_roundtrip(tmp_path):
+    """hybridize -> export -> SymbolBlock.imports, the deployment path."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 5))
+    net.hybridize()
+    ref = net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=0)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0000.params")
+    back = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                     prefix + "-0000.params")
+    np.testing.assert_allclose(back(x).asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+def test_module_workflow_checkpoints(tmp_path):
+    """Symbolic Module: bind/fit/score/save/load, the 1.x classic."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 10).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32,
+                           label_name="softmax_label")
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=6, initializer=mx.init.Xavier(),
+            optimizer_params=(("learning_rate", 0.3),
+                              ("rescale_grad", 1.0 / 32)))
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 6)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 6)
+    mod2 = mx.mod.Module(sym)
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    mod2.set_params(arg, aux)
+    assert dict(mod2.score(it, "acc"))["accuracy"] == acc
+
+
+def test_autograd_head_gradient_and_pause():
+    """attach_grad/record/backward with a head gradient + pause."""
+    x = nd.array([[1.0, 2], [3, 4]])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * x
+    head = nd.array([[10.0, 1], [0.1, 0.01]])
+    z.backward(head)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               (4 * x.asnumpy()) * head.asnumpy(),
+                               rtol=1e-6)
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            frozen = y * 3  # not recorded
+        out = (y + frozen.detach()).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones((2, 2)),
+                               rtol=1e-6)
+
+
+def test_kvstore_push_pull_aggregation():
+    """The kvstore tutorial: init/push/pull with aggregation."""
+    kv = mx.kv.create("local")
+    shape = (2, 3)
+    kv.init(3, nd.ones(shape))
+    out = nd.zeros(shape)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(shape))
+    kv.push(3, [nd.ones(shape)] * 4)  # 4-worker aggregate
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4 * np.ones(shape))
+
+
+def test_lr_scheduler_and_optimizer_surface():
+    """Optimizer + scheduler wiring exactly as 1.x docs show."""
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                            base_lr=1.0)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched,
+                           momentum=0.9, wd=1e-4)
+    trainer = gluon.Trainer({}, opt)
+    assert trainer.learning_rate == 1.0
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    x = nd.ones((4, 3))
+    loss_fn = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), opt)
+    for i in range(5):
+        with autograd.record():
+            loss = loss_fn(net(x), nd.zeros((4, 2)))
+        loss.backward()
+        tr.step(4)
+    assert opt.learning_rate < 1.0  # scheduler decayed
+
+
+def test_np_interop_and_context():
+    """mx.np + context handling as the 'NumPy users' guide teaches."""
+    with mx.Context("cpu"):
+        a = mx.np.ones((2, 3))
+        assert a.shape == (2, 3)
+    b = mx.np.arange(6).reshape(2, 3)
+    c = np.asarray(b.asnumpy())  # explicit host copy
+    np.testing.assert_allclose((a + b).asnumpy(), c + 1)
+    # __array_function__ dispatch: numpy functions on mx.np arrays
+    s = np.sum(b)
+    assert float(s) == 15
+
+
+def test_loss_head_label_auto_creation_and_inference():
+    """Loss heads auto-create '<name>_label' and infer its shape from
+    data alone — the standard inference idiom binds without label shapes
+    (reference backward shape inference)."""
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    for head, expect in [
+            (mx.sym.SoftmaxOutput(fc, name="softmax"), (8,)),
+            (mx.sym.SVMOutput(fc, name="svm"), (8,)),
+            (mx.sym.LinearRegressionOutput(fc, name="lin"), (8, 4))]:
+        label_name = [n for n in head.list_arguments()
+                      if n.endswith("_label")]
+        assert len(label_name) == 1, head.list_arguments()
+        arg_shapes, out_shapes, _ = head.infer_shape(data=(8, 10))
+        shapes = dict(zip(head.list_arguments(), arg_shapes))
+        assert shapes[label_name[0]] == expect
+    # inference-only bind with no label shapes (mod.bind(provide_data))
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind([("data", (8, 10))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.forward(mx.io.DataBatch(data=[nd.ones((8, 10))], label=None))
+    assert mod.get_outputs()[0].shape == (8, 4)
