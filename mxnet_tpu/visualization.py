@@ -60,13 +60,20 @@ def print_summary(symbol, shape=None, line_length=120,
         pre_layers = []
         for child, _ in node.inputs:
             if child.is_var:
-                if child.name.startswith(name) and shape_dict.get(child.name):
-                    n = 1
-                    for d in shape_dict[child.name]:
-                        n *= d
-                    cur_params += n
-                elif child.name in input_names and \
-                        not child.name.startswith(name):
+                # declared inputs (user shape dict) and label vars are
+                # DATA, not parameters, even when they prefix-match the
+                # layer name (auto-created '<name>_label' does)
+                is_data = child.name in (shape or {}) or \
+                    child.name.endswith("_label")
+                if not is_data and child.name.startswith(name):
+                    # the layer's own parameters: counted, never listed
+                    # as previous layers
+                    if shape_dict.get(child.name):
+                        n = 1
+                        for d in shape_dict[child.name]:
+                            n *= d
+                        cur_params += n
+                elif child.name in input_names:
                     pre_layers.append(child.name)
             else:
                 pre_layers.append(child.name)
