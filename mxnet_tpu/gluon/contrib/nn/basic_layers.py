@@ -10,7 +10,7 @@ from ...nn.basic_layers import BatchNorm, HybridSequential, Sequential
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
            "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
-           "PixelShuffle3D"]
+           "PixelShuffle3D", "MultiHeadAttention", "TransformerEncoderCell"]
 
 
 class Concurrent(Sequential):
@@ -149,3 +149,88 @@ class PixelShuffle3D(_PixelShuffle):
         x = nd.reshape(x, shape=(n, co, f1, f2, f3, d, h, w))
         x = nd.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
         return nd.reshape(x, shape=(n, co, d * f1, h * f2, w * f3))
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head self/cross attention over the flash kernel.
+
+    Beyond the reference's op-level pieces (`_contrib_interleaved_matmul_
+    selfatt_*`, contrib/transformer.cc): a gluon block wired to the
+    Pallas flash-attention kernel (`_contrib_flash_attention`) so the
+    (S, S) score matrix never materializes in HBM — the building block
+    for long-context transformer models. Inputs/outputs are
+    (batch, seq, units).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 block_q=128, block_k=128, interpret=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError(f"units {units} not divisible by "
+                             f"num_heads {num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        # kernel knobs pass straight through to _contrib_flash_attention
+        # (interpret=True runs the Pallas kernel in interpreter mode, so
+        # the kernel path is testable on CPU CI)
+        self._flash_kwargs = {"block_q": block_q, "block_k": block_k,
+                              "interpret": interpret}
+        with self.name_scope():
+            from ...nn import Dense, Dropout
+
+            self.query = Dense(units, flatten=False, use_bias=True)
+            self.key = Dense(units, flatten=False, use_bias=True)
+            self.value = Dense(units, flatten=False, use_bias=True)
+            self.proj = Dense(units, flatten=False, use_bias=True)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mem=None):
+        """`mem=None` -> self attention; else cross attention with keys/
+        values from `mem` (B, S_kv, U). Uses F + shape special values
+        throughout, so the block traces to Symbol (export) unchanged."""
+        if mem is not None and self._causal:
+            raise ValueError(
+                "causal masking has no valid interpretation for cross "
+                "attention (query and memory positions are different "
+                "sequences); build the block with causal=False")
+        kv = x if mem is None else mem
+
+        def split(t):  # (B, S, U) -> (B, H, S, D)
+            t = F.reshape(t, shape=(0, 0, self._heads, -1))
+            return F.transpose(t, axes=(0, 2, 1, 3))
+
+        q = split(self.query(x))
+        k = split(self.key(kv))
+        v = split(self.value(kv))
+        out = F.contrib.flash_attention(q, k, v, causal=self._causal,
+                                        **self._flash_kwargs)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        shape=(0, 0, -1))
+        return self.drop(self.proj(out))
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-LN transformer encoder layer: LN -> MHA -> residual, LN ->
+    FFN(GELU) -> residual. (B, S, U) in and out; stack under
+    `parallel.pipeline_apply` for pipeline parallelism or feed q/k/v
+    through `parallel.ring_attention` for sequence parallelism."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 causal=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            from ...nn import Dense, Dropout, LayerNorm
+
+            self.ln1 = LayerNorm()
+            self.attn = MultiHeadAttention(units, num_heads,
+                                           dropout=dropout, causal=causal)
+            self.ln2 = LayerNorm()
+            self.ffn1 = Dense(hidden_size, flatten=False)
+            self.ffn2 = Dense(units, flatten=False)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        h = F.LeakyReLU(self.ffn1(self.ln2(x)), act_type="gelu")
+        return x + self.drop(self.ffn2(h))
