@@ -263,6 +263,11 @@ def test_multi_head_attention_matches_oracle():
     # cross attention: different kv length
     mem = mx.nd.random.uniform(-1, 1, (B, 8, U))
     assert attn(x, mem).shape == (B, S, U)
+    # causal masking is rejected for cross attention
+    import pytest
+
+    with pytest.raises(ValueError, match="cross"):
+        cattn(x, mem)
 
 
 def test_transformer_encoder_cell_trains():
