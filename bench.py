@@ -27,18 +27,13 @@ import numpy as np
 
 def main():
     import mxnet_tpu as mx
-    from mxnet_tpu.base import ensure_live_backend
+    from mxnet_tpu.base import probe_backend_or_fallback
     from mxnet_tpu.gluon.model_zoo import vision
 
     # a downed TPU tunnel hangs the first backend touch forever; probe
     # (subprocess, 90s deadline) unless the platform is already pinned.
     # BENCH_SKIP_PROBE=1 skips the probe's extra backend spin-up.
-    if not os.environ.get("BENCH_SKIP_PROBE"):
-        if ensure_live_backend() == "cpu-fallback":
-            import sys
-
-            print("bench: default backend unreachable; falling back to "
-                  "CPU", file=sys.stderr, flush=True)
+    probe_backend_or_fallback(skip_env="BENCH_SKIP_PROBE")
 
     batch = int(os.environ.get("BENCH_BATCH", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")

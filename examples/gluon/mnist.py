@@ -48,6 +48,11 @@ def main(argv=None):
     p.add_argument("--hybridize", action="store_true")
     args = p.parse_args(argv)
 
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
+
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
 

@@ -40,15 +40,13 @@ def main():
     ap.add_argument("--accum-steps", type=int, default=1)
     args = ap.parse_args()
 
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
+
     import mxnet_tpu as mx  # applies the MXTPU_PLATFORM pin
     import numpy as np
-    from mxnet_tpu.base import ensure_live_backend
-
-    # a downed accelerator tunnel would otherwise hang the first backend
-    # touch forever; fall back to CPU loudly instead
-    if ensure_live_backend() == "cpu-fallback":
-        print("default backend unreachable; running on CPU",
-              file=sys.stderr, flush=True)
 
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon import nn

@@ -21,6 +21,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root
 import numpy as np
 
 import mxnet_tpu as mx
+
+
 from mxnet_tpu import gluon
 from mxnet_tpu.contrib import text
 from mxnet_tpu.gluon import nn, rnn
@@ -110,6 +112,11 @@ def main():
     ap.add_argument("--tied", action="store_true")
     ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
     args = ap.parse_args()
+
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
 
     ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
     mx.random.seed(1)

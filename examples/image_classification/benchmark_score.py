@@ -42,7 +42,13 @@ def main(argv=None):
     p.add_argument("--dtype", type=str, default="float32")
     args = p.parse_args(argv)
 
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
+
     import mxnet_tpu as mx
+
     from mxnet_tpu.gluon.model_zoo import vision
 
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()

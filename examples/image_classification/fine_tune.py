@@ -75,6 +75,11 @@ def main(argv=None):
     p.add_argument("--epochs", type=int, default=4)
     args = p.parse_args(argv)
 
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
+
     import mxnet_tpu as mx
 
     # phase 1: pretrain on task A (3 classes), save checkpoint

@@ -17,6 +17,8 @@ sys.path.insert(0, _here)
 sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root
 
 import mxnet_tpu as mx
+
+
 from common import data, fit
 
 
@@ -47,6 +49,11 @@ def main():
                         lr_step_epochs="50,100", batch_size=128,
                         num_examples=4096)
     args = parser.parse_args()
+
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
 
     net = get_network(args.network)
     fit.fit(args, net, data.get_cifar10_iter)

@@ -16,6 +16,8 @@ sys.path.insert(0, _here)
 sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root
 
 import mxnet_tpu as mx
+
+
 from common import data, fit
 
 
@@ -63,6 +65,11 @@ def main():
                         lr_step_epochs="10", batch_size=64,
                         num_examples=4096)
     args = parser.parse_args()
+
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
 
     net = get_mlp() if args.network == "mlp" else get_lenet()
     fit.fit(args, net, data.get_mnist_iter)

@@ -21,6 +21,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(_here),
 import numpy as np
 
 import mxnet_tpu as mx
+
+
 from common import data as common_data  # shared MNIST-or-synthetic iters
 from mxnet_tpu.contrib import quantization
 
@@ -48,6 +50,11 @@ def main():
     ap.add_argument("--calib-batches", type=int, default=5)
     ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
     args = ap.parse_args()
+
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
     ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
 
     args.data_dir = args.data_dir or ""

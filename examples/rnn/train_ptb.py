@@ -140,6 +140,11 @@ def main():
     parser.add_argument("--ctx", type=str, default="tpu")
     args = parser.parse_args()
 
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
+
     import logging
 
     logging.basicConfig(level=logging.INFO)

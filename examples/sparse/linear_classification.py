@@ -46,7 +46,13 @@ def main(argv=None):
     p.add_argument("--kvstore", type=str, default="local")
     args = p.parse_args(argv)
 
+    # downed-tunnel guard (skippable via MXTPU_SKIP_PROBE)
+    from mxnet_tpu.base import probe_backend_or_fallback
+
+    probe_backend_or_fallback()
+
     import mxnet_tpu as mx
+
     from mxnet_tpu.ndarray.sparse import row_sparse_array
 
     rows, vals, labels = synthetic_sparse_data(
