@@ -321,3 +321,25 @@ def test_executor_argdict_feed_hint_and_scalar_cotangent():
     assert not uneven, uneven
     np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
                                np.ones((8, 4)), rtol=1e-5)
+
+
+def test_module_multi_context_batchnorm_aux():
+    """BN running stats update correctly when Module runs over a ctx
+    group (mesh-resident aux writeback in executor.py forward)."""
+    X, Y = _toy_problem(n=128)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, label_name="softmax_label")
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(4)])
+    mod.fit(it, num_epoch=4,
+            optimizer_params=(("learning_rate", 0.2),
+                              ("rescale_grad", 1.0 / 32)))
+    _, aux = mod.get_params()
+    mean = aux["bn1_moving_mean"].asnumpy()
+    assert np.abs(mean).max() > 1e-3, "BN stats never updated under mesh"
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
