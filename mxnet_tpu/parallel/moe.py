@@ -22,6 +22,7 @@ from __future__ import annotations
 
 __all__ = ["moe_apply", "stack_expert_params"]
 
+from .pipeline import _check_stacked_leading_dim
 from .pipeline import stack_stage_params as stack_expert_params
 
 
@@ -71,12 +72,13 @@ def moe_apply(expert_fn, mesh, axis="ep"):
 
     @jax.jit
     def run(stacked_params, router_w, x):
-        lead = {p.shape[0] for p in jax.tree_util.tree_leaves(stacked_params)}
-        assert lead == {num_experts}, (
-            f"stacked_params leading dims {lead} != ep axis size {num_experts}")
-        assert router_w.shape[-1] == num_experts, (
-            f"router_w has {router_w.shape[-1]} expert columns but the ep "
-            f"axis has {num_experts} devices")
+        _check_stacked_leading_dim(stacked_params, num_experts, "ep")
+        if router_w.shape[-1] != num_experts:
+            # silently-dropped experts otherwise: tokens routed past
+            # column E match no device and psum to zero rows
+            raise ValueError(
+                f"router_w has {router_w.shape[-1]} expert columns but "
+                f"the ep axis has {num_experts} devices")
         y, aux = sharded(stacked_params, router_w, x)
         return y, jnp.reshape(aux, ())
 

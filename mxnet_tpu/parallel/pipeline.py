@@ -25,6 +25,22 @@ from __future__ import annotations
 __all__ = ["pipeline_apply", "stack_stage_params"]
 
 
+def _check_stacked_leading_dim(stacked_params, n, what):
+    """Trace-time validation: every leaf's leading dim must equal the
+    mesh-axis size (a 2n-stage stack would silently use every other
+    slice via p[0]). Raises (not assert — `-O` must not strip it)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if not leaves:
+        raise ValueError(f"stacked {what} params are empty")
+    lead = {p.shape[0] for p in leaves}
+    if lead != {n}:
+        raise ValueError(
+            f"stacked {what} params have leading dims {sorted(lead)}; "
+            f"the {what} axis has {n} devices")
+
+
 def stack_stage_params(param_trees):
     """Stack S identical-structure parameter pytrees along a new leading
     axis (the pp-sharded dimension)."""
@@ -100,11 +116,11 @@ def pipeline_apply(stage_fn, mesh, num_microbatches, axis="pp"):
 
     @jax.jit
     def run(stacked_params, x):
-        lead = {p.shape[0] for p in jax.tree_util.tree_leaves(stacked_params)}
-        assert lead == {num_stages}, (
-            f"stacked_params leading dims {lead} != pp axis size {num_stages}")
+        _check_stacked_leading_dim(stacked_params, num_stages, "pp")
         b = x.shape[0]
-        assert b % m == 0, f"batch {b} not divisible by microbatches {m}"
+        if b % m:
+            raise ValueError(
+                f"batch {b} not divisible by microbatches {m}")
         xs = x.reshape((m, b // m) + x.shape[1:])
         out = sharded(stacked_params, xs)
         return out.reshape((b,) + out.shape[2:])
