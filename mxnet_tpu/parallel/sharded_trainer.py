@@ -82,6 +82,7 @@ class ShardedTrainer:
         self._net = net
         self._loss_fn = loss_fn
         self._mesh = mesh or DeviceMesh()
+        self._multiprocess = self._compute_multiprocess()
         self._donate = donate
         self._zero = bool(zero)
         self._remat = bool(remat)
@@ -126,6 +127,40 @@ class ShardedTrainer:
         self._place_params()
 
     # ------------------------------------------------------------ set-up ---
+    def _compute_multiprocess(self):
+        """True when the mesh spans devices of OTHER processes (multi-host
+        SPMD under jax.distributed): host-local arrays must then become
+        global arrays instead of plain device_puts. Immutable after
+        construction — computed once."""
+        import jax
+
+        me = jax.process_index()
+        return any(d.process_index != me for d in self._mesh.devices)
+
+    def _global_put(self, host_arr, sh):
+        """Lay a host-resident full array out under `sh`. Multi-host:
+        every process holds the same full copy and each contributes its
+        addressable shards (make_array_from_callback)."""
+        import jax
+
+        if not self._multiprocess:
+            return jax.device_put(host_arr, sh)
+        host_np = _np.asarray(jax.device_get(host_arr))
+        return jax.make_array_from_callback(
+            host_np.shape, sh, lambda idx: host_np[idx])
+
+    def _put_batch(self, raw, sh):
+        """Lay a data batch out under `sh`. Multi-host: the caller passes
+        its PROCESS-LOCAL portion of the global batch (the standard SPMD
+        data-loading contract — each worker loads its own slice); the
+        global batch is the concatenation over processes."""
+        import jax
+
+        if not self._multiprocess:
+            return jax.device_put(raw, sh)
+        return jax.make_array_from_process_local_data(
+            sh, _np.asarray(jax.device_get(raw)))
+
     def _spec_for(self, name):
         return self._mesh.sharding(*self._rules.get(name, ()))
 
@@ -147,15 +182,15 @@ class ShardedTrainer:
 
     def _place_params(self):
         """Lay parameters out on the mesh per the rules (replicate or
-        tp-shard) — the device_put that replaces per-GPU weight copies."""
-        import jax
-
+        tp-shard) — the device_put that replaces per-GPU weight copies.
+        Multi-host meshes go through _global_put (each process
+        contributes its addressable shards of the same full copy)."""
         for name, h in zip(self._param_names, self._train_handles):
-            h._rebind(jax.device_put(h._data, self._spec_for(name)))
+            h._rebind(self._global_put(h._data, self._spec_for(name)))
         for name, h in zip(self._aux_names, self._aux_handles):
-            h._rebind(jax.device_put(h._data, self._mesh.replicated()))
+            h._rebind(self._global_put(h._data, self._mesh.replicated()))
         self._opt_raws = tuple(
-            tuple(jax.device_put(s, self._state_spec_for(name, s.shape))
+            tuple(self._global_put(s, self._state_spec_for(name, s.shape))
                   for s in per)
             for name, per in zip(self._param_names, self._opt_raws))
 
@@ -317,10 +352,10 @@ class ShardedTrainer:
 
         x_raw = x._data if isinstance(x, NDArray) else x
         y_raw = y._data if isinstance(y, NDArray) else y
-        x_raw = jax.device_put(
+        x_raw = self._put_batch(
             x_raw, self._mesh.sharding(
                 *(("dp",) + (None,) * (len(x_raw.shape) - 1))))
-        y_raw = jax.device_put(y_raw, self._mesh.sharding("dp"))
+        y_raw = self._put_batch(y_raw, self._mesh.sharding("dp"))
         if self._step_fn is None:
             self._step_fn = self._build(x_raw, y_raw)
         self._t += 1
@@ -345,7 +380,7 @@ class ShardedTrainer:
         import jax
 
         x_raw = x._data if isinstance(x, NDArray) else x
-        x_raw = jax.device_put(
+        x_raw = self._put_batch(
             x_raw, self._mesh.sharding(
                 *(("dp",) + (None,) * (len(x_raw.shape) - 1))))
         if getattr(self, "_predict_fn", None) is None:
@@ -380,6 +415,19 @@ class ShardedTrainer:
         return NDArray(out)
 
     # ------------------------------------------------------- checkpoint ---
+    def _host_copy(self, arr):
+        """Full host copy of a (possibly multi-host-sharded) array.
+        Non-addressable shards (ZeRO state on other hosts) are gathered
+        with a cross-process allgather."""
+        import jax
+
+        if getattr(arr, "is_fully_addressable", True) or \
+                getattr(arr, "is_fully_replicated", False):
+            return jax.device_get(arr)
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(arr, tiled=True)
+
     def _ckpt_keys(self):
         """Expected entry keys, POSITIONAL (collect_params order) so a
         fresh process with fresh gluon auto-prefixes can resume."""
@@ -416,13 +464,16 @@ class ShardedTrainer:
                 names_blob.encode(), _np.uint8))),
         }
         for i, h in enumerate(self._train_handles):
-            payload[f"p{i}"] = NDArray(jax.device_get(h._data))
+            payload[f"p{i}"] = NDArray(self._host_copy(h._data))
         for i, h in enumerate(self._aux_handles):
-            payload[f"a{i}"] = NDArray(jax.device_get(h._data))
+            payload[f"a{i}"] = NDArray(self._host_copy(h._data))
         for i, per in enumerate(self._opt_raws):
             for j, s in enumerate(per):
-                payload[f"s{i}_{j}"] = NDArray(jax.device_get(s))
-        nd_utils.save(fname, payload)
+                payload[f"s{i}_{j}"] = NDArray(self._host_copy(s))
+        # _host_copy's allgather is collective (every process runs it),
+        # but only one process may write the shared path
+        if jax.process_index() == 0:
+            nd_utils.save(fname, payload)
 
     def load_states(self, fname):
         """Restore a `save_states` checkpoint, re-laying every tensor out
@@ -466,7 +517,9 @@ class ShardedTrainer:
                 f"{bytes(_np.asarray(arrays['__names__']._data)).decode()})")
 
         def take(key, want_dtype, spec):
-            return jax.device_put(
+            # _global_put handles multi-host meshes (plain device_put
+            # cannot target non-addressable devices)
+            return self._global_put(
                 arrays[key]._data.astype(want_dtype), spec)
 
         self._t = int(arrays["__t__"].asscalar())
@@ -493,7 +546,7 @@ class ShardedTrainer:
 
         dev = (ctx or current_context()).jax_device()
         for h in self._train_handles + self._aux_handles:
-            h._rebind(jax.device_put(jax.device_get(h._data), dev))
+            h._rebind(jax.device_put(self._host_copy(h._data), dev))
 
     @property
     def mesh(self):

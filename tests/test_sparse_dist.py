@@ -131,6 +131,40 @@ def test_gradient_compression_rejects_unknown():
         kv.set_gradient_compression({"type": "1bit"})
 
 
+def _run_two_process(tmp_path, child_src, ok_token, timeout=240):
+    """Launch the 2-process localhost jax.distributed harness: write the
+    child script, run both ranks, skip when the distributed runtime is
+    unavailable/hung, assert both ranks print `ok_token`."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "dist_child.py"
+    script.write_text(child_src)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), port, str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.getcwd()) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed runtime hung in this environment")
+    if any(p.returncode != 0 for p in procs):
+        joined = "\n".join(outs)
+        if "DISTRIBUTED" in joined.upper() or "initialize" in joined:
+            pytest.skip(f"jax.distributed unavailable: {joined[-300:]}")
+        raise AssertionError(joined[-1500:])
+    assert all(ok_token in o for o in outs), outs
+
+
 _DIST_CHILD = textwrap.dedent("""
     import sys
     import jax
@@ -157,34 +191,7 @@ _DIST_CHILD = textwrap.dedent("""
 def test_two_process_dist_sync_exact_aggregate(tmp_path):
     """2-process localhost jax.distributed: dist_sync push/pull must
     produce the exact cross-worker sum on both ranks."""
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = str(s.getsockname()[1])
-    script = tmp_path / "dist_child.py"
-    script.write_text(_DIST_CHILD)
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), port, str(pid)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env, cwd=os.getcwd()) for pid in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed runtime hung in this environment")
-    if any(p.returncode != 0 for p in procs):
-        joined = "\n".join(outs)
-        if "DISTRIBUTED" in joined.upper() or "initialize" in joined:
-            pytest.skip(f"jax.distributed unavailable: {joined[-300:]}")
-        raise AssertionError(joined[-1500:])
-    assert all("DIST_OK" in o for o in outs), outs
+    _run_two_process(tmp_path, _DIST_CHILD, "DIST_OK", timeout=180)
 
 
 _ASYNC_CHILD = textwrap.dedent("""
@@ -220,31 +227,87 @@ def test_two_process_dist_async_per_push_updates(tmp_path):
     """dist_async applies every worker's push as its own optimizer step
     (kvstore_dist_server.h async ApplyUpdates parity), observable via a
     gradient-nonlinear updater."""
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = str(s.getsockname()[1])
-    script = tmp_path / "async_child.py"
-    script.write_text(_ASYNC_CHILD)
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), port, str(pid)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env, cwd=os.getcwd()) for pid in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed runtime hung in this environment")
-    if any(p.returncode != 0 for p in procs):
-        joined = "\n".join(outs)
-        if "DISTRIBUTED" in joined.upper() or "initialize" in joined:
-            pytest.skip(f"jax.distributed unavailable: {joined[-300:]}")
-        raise AssertionError(joined[-1500:])
-    assert all("ASYNC_OK" in o for o in outs), outs
+    _run_two_process(tmp_path, _ASYNC_CHILD, "ASYNC_OK", timeout=180)
+
+
+_TRAINER_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=2, process_id=pid)
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    assert len(jax.devices()) == 4  # 2 procs x 2 local cpu devices
+
+    def make_net():
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+                gluon.nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)   # GLOBAL batch
+    Y = rng.randn(16, 4).astype(np.float32)
+
+    # multi-host trainer: dp over all 4 devices; this process feeds its
+    # HALF of the global batch
+    net = make_net()
+    tr = ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                        {"learning_rate": 0.05},
+                        mesh=DeviceMesh({"dp": 4}))
+    lo, hi = (0, 8) if pid == 0 else (8, 16)
+    losses = []
+    for _ in range(3):
+        loss = tr.step(mx.nd.array(X[lo:hi]), mx.nd.array(Y[lo:hi]))
+        losses.append(float(loss.asscalar()))
+
+    # reference: LOCAL-only trainer over this process's 2 devices with
+    # the full global batch — identical numerics expected
+    ref_net = make_net()
+    ref = ShardedTrainer(ref_net, gluon.loss.L2Loss(), "sgd",
+                         {"learning_rate": 0.05},
+                         mesh=DeviceMesh({"dp": 2},
+                                         devices=jax.local_devices()))
+    ref_losses = [float(ref.step(mx.nd.array(X),
+                                 mx.nd.array(Y)).asscalar())
+                  for _ in range(3)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+    # multi-host checkpoint round-trip: rank 0 writes, everyone loads
+    import tempfile, os
+    from jax.experimental import multihost_utils
+    ckpt = os.path.join(tempfile.gettempdir(), "st_ckpt_" + port + ".npz")
+    tr.save_states(ckpt)
+    multihost_utils.sync_global_devices("ckpt_written")
+    cont = float(tr.step(mx.nd.array(X[lo:hi]),
+                         mx.nd.array(Y[lo:hi])).asscalar())
+    net2 = make_net()
+    tr2 = ShardedTrainer(net2, gluon.loss.L2Loss(), "sgd",
+                         {"learning_rate": 0.05},
+                         mesh=DeviceMesh({"dp": 4}))
+    tr2.load_states(ckpt)
+    resumed = float(tr2.step(mx.nd.array(X[lo:hi]),
+                             mx.nd.array(Y[lo:hi])).asscalar())
+    np.testing.assert_allclose(resumed, cont, rtol=1e-5)
+    multihost_utils.sync_global_devices("done")
+    if pid == 0:
+        os.remove(ckpt)
+    print("TRAINER_OK", pid, losses[-1])
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="distributed tests disabled")
+def test_two_process_sharded_trainer(tmp_path):
+    """Multi-host ShardedTrainer: 2 processes x 2 devices, each feeding
+    its half of the global batch — losses must equal a single-process
+    run over the full batch (sharded_trainer.py _put_batch/_global_put)."""
+    _run_two_process(tmp_path, _TRAINER_CHILD, "TRAINER_OK", timeout=240)
