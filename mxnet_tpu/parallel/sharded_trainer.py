@@ -158,6 +158,14 @@ class ShardedTrainer:
 
         if not self._multiprocess:
             return jax.device_put(raw, sh)
+        if sh.is_fully_replicated:
+            # per-rank slices would become INCONSISTENT replicas of one
+            # "global" array and silently drift the hosts apart
+            raise ValueError(
+                "multi-host batch placement needs a process-spanning "
+                "batch ('dp') axis in the mesh; this mesh replicates "
+                "the batch — add a dp axis, or feed every process the "
+                "identical batch via jax.device_put yourself")
         return jax.make_array_from_process_local_data(
             sh, _np.asarray(jax.device_get(raw)))
 
@@ -471,8 +479,9 @@ class ShardedTrainer:
             for j, s in enumerate(per):
                 payload[f"s{i}_{j}"] = NDArray(self._host_copy(s))
         # _host_copy's allgather is collective (every process runs it),
-        # but only one process may write the shared path
-        if jax.process_index() == 0:
+        # but only one process may write a SHARED path; host-local
+        # trainers write regardless of rank
+        if not self._multiprocess or jax.process_index() == 0:
             nd_utils.save(fname, payload)
 
     def load_states(self, fname):

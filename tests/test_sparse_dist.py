@@ -154,8 +154,24 @@ def _run_two_process(tmp_path, child_src, ok_token, timeout=240):
             out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
+        # one rank dying at an assert leaves the other blocked at a
+        # collective; surface the dead rank's traceback instead of
+        # skipping the regression as an environment problem
+        dead = [(i, p) for i, p in enumerate(procs)
+                if p.poll() not in (None, 0)]
         for p in procs:
             p.kill()
+        if dead:
+            msgs = []
+            for i, p in dead:
+                try:
+                    msgs.append(f"rank {i}:\n" +
+                                (p.communicate(timeout=10)[0] or "")[-1200:])
+                except Exception:
+                    pass
+            raise AssertionError(
+                "rank(s) failed while peers waited at a collective:\n" +
+                "\n".join(msgs))
         pytest.skip("distributed runtime hung in this environment")
     if any(p.returncode != 0 for p in procs):
         joined = "\n".join(outs)
