@@ -80,6 +80,36 @@ class DeviceMesh:
         return jax.sharding.NamedSharding(self._jax_mesh,
                                           jax.sharding.PartitionSpec())
 
+    @property
+    def is_multiprocess(self) -> bool:
+        """True when this mesh spans devices of other processes
+        (multi-host SPMD under jax.distributed)."""
+        import jax
+
+        me = jax.process_index()
+        return any(d.process_index != me for d in self.devices)
+
+    def global_put(self, host_arr, *spec, sharding=None):
+        """Lay a host-resident FULL array out over this mesh as a global
+        array, multi-host included: on a process-spanning mesh every
+        process holds the same full copy and contributes its addressable
+        shards (`make_array_from_callback`). This is how stacked
+        pipeline/expert params and replicated weights reach a multi-host
+        mesh — a plain device_put cannot target non-addressable
+        devices. Pass either a PartitionSpec tuple (*spec) or a prebuilt
+        NamedSharding (sharding=)."""
+        import jax
+
+        sh = sharding if sharding is not None else (
+            self.sharding(*spec) if spec else self.replicated())
+        if not self.is_multiprocess:
+            return jax.device_put(host_arr, sh)
+        import numpy as np
+
+        host_np = np.asarray(jax.device_get(host_arr))
+        return jax.make_array_from_callback(
+            host_np.shape, sh, lambda idx: host_np[idx])
+
     def __enter__(self):
         if not hasattr(_tls, "stack"):
             _tls.stack = []

@@ -82,7 +82,7 @@ class ShardedTrainer:
         self._net = net
         self._loss_fn = loss_fn
         self._mesh = mesh or DeviceMesh()
-        self._multiprocess = self._compute_multiprocess()
+        self._multiprocess = self._mesh.is_multiprocess
         self._donate = donate
         self._zero = bool(zero)
         self._remat = bool(remat)
@@ -127,27 +127,10 @@ class ShardedTrainer:
         self._place_params()
 
     # ------------------------------------------------------------ set-up ---
-    def _compute_multiprocess(self):
-        """True when the mesh spans devices of OTHER processes (multi-host
-        SPMD under jax.distributed): host-local arrays must then become
-        global arrays instead of plain device_puts. Immutable after
-        construction — computed once."""
-        import jax
-
-        me = jax.process_index()
-        return any(d.process_index != me for d in self._mesh.devices)
 
     def _global_put(self, host_arr, sh):
-        """Lay a host-resident full array out under `sh`. Multi-host:
-        every process holds the same full copy and each contributes its
-        addressable shards (make_array_from_callback)."""
-        import jax
-
-        if not self._multiprocess:
-            return jax.device_put(host_arr, sh)
-        host_np = _np.asarray(jax.device_get(host_arr))
-        return jax.make_array_from_callback(
-            host_np.shape, sh, lambda idx: host_np[idx])
+        """Multi-host-safe placement under a prebuilt NamedSharding."""
+        return self._mesh.global_put(host_arr, sharding=sh)
 
     def _put_batch(self, raw, sh):
         """Lay a data batch out under `sh`. Multi-host: the caller passes

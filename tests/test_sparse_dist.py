@@ -327,3 +327,48 @@ def test_two_process_sharded_trainer(tmp_path):
     its half of the global batch — losses must equal a single-process
     run over the full batch (sharded_trainer.py _put_batch/_global_put)."""
     _run_two_process(tmp_path, _TRAINER_CHILD, "TRAINER_OK", timeout=240)
+
+
+_PIPELINE_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=2, process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.parallel import (DeviceMesh, pipeline_apply,
+                                    stack_stage_params)
+
+    S = 4  # stages over 2 processes x 2 devices: activations cross hosts
+    mesh = DeviceMesh({"pp": S})
+    assert mesh.is_multiprocess
+    rs = np.random.RandomState(0)
+    d = 8
+    stages = [{"w": jnp.asarray(rs.randn(d, d) * 0.3, jnp.float32)}
+              for _ in range(S)]
+    stage_fn = lambda p, a: jnp.tanh(a @ p["w"])
+    stacked_host = stack_stage_params(stages)
+    stacked = jax.tree_util.tree_map(
+        lambda p: mesh.global_put(p, "pp"), stacked_host)
+    x = mesh.global_put(jnp.asarray(rs.randn(8, d), jnp.float32))
+    fn = pipeline_apply(stage_fn, mesh, num_microbatches=4)
+    out = np.asarray(fn(stacked, x))
+    h = jnp.asarray(np.asarray(jax.device_get(x)), jnp.float32)
+    for p in stages:
+        h = stage_fn(p, h)
+    err = float(np.abs(out - np.asarray(h)).max())
+    assert err < 1e-4, err
+    print("PIPE_OK", pid, err)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="distributed tests disabled")
+def test_two_process_pipeline_parallel(tmp_path):
+    """GPipe pipeline over a mesh spanning 2 processes: stage-to-stage
+    ppermutes cross host boundaries; output exact vs the sequential
+    stack (parallel/pipeline.py + mesh.global_put)."""
+    _run_two_process(tmp_path, _PIPELINE_CHILD, "PIPE_OK")
