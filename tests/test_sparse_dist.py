@@ -372,3 +372,45 @@ def test_two_process_pipeline_parallel(tmp_path):
     ppermutes cross host boundaries; output exact vs the sequential
     stack (parallel/pipeline.py + mesh.global_put)."""
     _run_two_process(tmp_path, _PIPELINE_CHILD, "PIPE_OK")
+
+
+_RING_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=2, process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.parallel import (DeviceMesh, attention,
+                                    ring_attention_sharded)
+
+    mesh = DeviceMesh({"sp": 4})  # sequence sharded over 2 hosts x 2 dev
+    assert mesh.is_multiprocess
+    rs = np.random.RandomState(0)
+    B, H, S, D = 1, 2, 32, 8
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+               for _ in range(3))
+    gq = mesh.global_put(q, None, None, "sp", None)
+    gk = mesh.global_put(k, None, None, "sp", None)
+    gv = mesh.global_put(v, None, None, "sp", None)
+    fn = ring_attention_sharded(mesh, causal=True)
+    out = fn(gq, gk, gv)
+    from jax.experimental import multihost_utils
+    out_np = multihost_utils.process_allgather(out, tiled=True)
+    ref = np.asarray(attention(q, k, v, causal=True))
+    err = float(np.abs(out_np - ref).max())
+    assert err < 1e-4, err
+    print("RING_OK", pid, err)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="distributed tests disabled")
+def test_two_process_ring_attention(tmp_path):
+    """Long-context SP across hosts: the k/v ring ppermutes cross the
+    process boundary every step; output exact vs dense attention
+    (parallel/ring_attention.py over a 2-process mesh)."""
+    _run_two_process(tmp_path, _RING_CHILD, "RING_OK")
