@@ -13,7 +13,6 @@ so it runs anywhere.
 import argparse
 import os
 import sys
-from collections import Counter
 
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root

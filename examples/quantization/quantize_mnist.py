@@ -18,8 +18,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root
 sys.path.insert(0, os.path.join(os.path.dirname(_here),
                                 "image_classification"))
 
-import numpy as np
-
 import mxnet_tpu as mx
 
 

@@ -9,7 +9,7 @@ reference them.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional
 
 __all__ = ["DeviceMesh", "current_mesh"]
 

@@ -16,7 +16,7 @@ is in-place at the XLA level (no 2x parameter memory).
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as _np
 
