@@ -414,3 +414,52 @@ def test_two_process_ring_attention(tmp_path):
     process boundary every step; output exact vs dense attention
     (parallel/ring_attention.py over a 2-process mesh)."""
     _run_two_process(tmp_path, _RING_CHILD, "RING_OK")
+
+
+_MOE_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    port, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address="localhost:" + port,
+                               num_processes=2, process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.parallel import (DeviceMesh, moe_apply,
+                                    stack_expert_params)
+
+    E, N, D = 4, 16, 6  # experts split over 2 hosts x 2 devices
+    mesh = DeviceMesh({"ep": E})
+    assert mesh.is_multiprocess
+    rs = np.random.RandomState(0)
+    experts = [{"w": jnp.asarray(rs.randn(D, D) * 0.5, jnp.float32)}
+               for _ in range(E)]
+    router_w = jnp.asarray(rs.randn(D, E), jnp.float32)
+    x = jnp.asarray(rs.randn(N, D), jnp.float32)
+    fn = moe_apply(lambda p, t: jnp.tanh(t @ p["w"]), mesh)
+    y, aux = fn(jax.tree_util.tree_map(
+                    lambda p: mesh.global_put(p, "ep"),
+                    stack_expert_params(experts)),
+                mesh.global_put(router_w), mesh.global_put(x))
+    probs = np.asarray(jax.nn.softmax(x @ router_w, axis=-1))
+    assign = probs.argmax(-1)
+    ref = np.stack([probs[i, assign[i]] *
+                    np.tanh(np.asarray(x[i]) @
+                            np.asarray(experts[assign[i]]["w"]))
+                    for i in range(N)])
+    from jax.experimental import multihost_utils
+    y_np = multihost_utils.process_allgather(y, tiled=True)
+    err = float(np.abs(y_np - ref).max())
+    assert err < 1e-4, err
+    print("MOE_OK", pid, err)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="distributed tests disabled")
+def test_two_process_expert_parallel(tmp_path):
+    """Switch MoE with experts split across 2 processes: the dense-
+    dispatch psum crosses the host boundary; output exact vs the dense
+    oracle (parallel/moe.py over a multi-host mesh)."""
+    _run_two_process(tmp_path, _MOE_CHILD, "MOE_OK")
