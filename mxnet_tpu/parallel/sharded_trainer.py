@@ -90,7 +90,14 @@ class ShardedTrainer:
         if self._accum < 1:
             raise ValueError("accum_steps must be >= 1")
         opt_params = dict(optimizer_params or {})
+        # lr_scheduler makes the learning rate a TRACED scalar argument
+        # of the compiled step (one executable, lr varies per call)
+        self._lr_scheduler = opt_params.pop("lr_scheduler", None)
         self._lr = float(opt_params.pop("learning_rate", 0.01))
+        if self._lr_scheduler is not None:
+            # same contract as Optimizer: learning_rate seeds the
+            # scheduler's base_lr (optimizer/optimizer.py:41)
+            self._lr_scheduler.base_lr = self._lr
         self._momentum = float(opt_params.pop("momentum", 0.0))
         self._wd = float(opt_params.pop("wd", 0.0))
         self._beta1 = float(opt_params.pop("beta1", 0.9))
@@ -211,7 +218,7 @@ class ShardedTrainer:
         loss_fn = self._loss_fn
         train_handles = self._train_handles
         aux_handles = self._aux_handles
-        lr, momentum, wd = self._lr, self._momentum, self._wd
+        momentum, wd = self._momentum, self._wd
         beta1, beta2, eps = self._beta1, self._beta2, self._epsilon
         wd_mult = self._wd_mult
         opt_name = self._opt_name
@@ -288,12 +295,14 @@ class ShardedTrainer:
             grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
             return (loss_sum / accum, new_aux), grads
 
-        def step_fn(praws, opt_raws, araws, x, y, rng, t):
+        def step_fn(praws, opt_raws, araws, x, y, rng, t, lr):
             (loss, new_aux), grads = grads_of(praws, araws, x, y, rng)
             new_p, new_opt = [], []
             for i, (w, g, st) in enumerate(zip(praws, grads, opt_raws)):
                 pwd = wd * wd_mult[i]
                 g = g.astype(w.dtype)  # keep update arithmetic in param dtype
+                # the traced lr scalar must not promote bf16 params
+                lr_w = lr.astype(w.dtype)
                 if zero:
                     # pin gradient (and hence m/v and the delta math) to
                     # the dp-sharded state layout; XLA all-gathers only
@@ -301,17 +310,18 @@ class ShardedTrainer:
                     g = jax.lax.with_sharding_constraint(g, state_sh[i])
                 if opt_name == "sgd":
                     if momentum:
-                        mom = momentum * st[0] - lr * (g + pwd * w)
+                        mom = momentum * st[0] - lr_w * (g + pwd * w)
                         new_p.append(w + mom)
                         new_opt.append((mom,))
                     else:
-                        new_p.append(w - lr * (g + pwd * w))
+                        new_p.append(w - lr_w * (g + pwd * w))
                         new_opt.append(())
                 else:  # adam (bias-corrected via lr scaling, ref parity)
                     m = beta1 * st[0] + (1 - beta1) * (g + pwd * w)
                     v = beta2 * st[1] + (1 - beta2) * jnp.square(g + pwd * w)
                     tt = t.astype(jnp.float32)
-                    alpha = lr * jnp.sqrt(1 - beta2 ** tt) / (1 - beta1 ** tt)
+                    alpha = lr_w * (jnp.sqrt(1 - beta2 ** tt) /
+                                    (1 - beta1 ** tt)).astype(w.dtype)
                     new_p.append(w - alpha * m / (jnp.sqrt(v) + eps))
                     new_opt.append((m, v))
             return tuple(new_p), tuple(new_opt), new_aux, loss
@@ -330,7 +340,8 @@ class ShardedTrainer:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(
             step_fn,
-            in_shardings=(p_sh, opt_sh, aux_sh, x_sh, y_sh, rep, rep),
+            in_shardings=(p_sh, opt_sh, aux_sh, x_sh, y_sh, rep, rep,
+                          rep),
             out_shardings=(p_sh, opt_sh, aux_sh, rep),
             donate_argnums=donate)
 
@@ -352,12 +363,15 @@ class ShardedTrainer:
         self._t += 1
         import jax.numpy as jnp
 
+        lr = self._lr if self._lr_scheduler is None \
+            else float(self._lr_scheduler(self._t))
         new_p, new_opt, new_aux, loss = self._step_fn(
             tuple(h._data for h in self._train_handles),
             self._opt_raws,
             tuple(h._data for h in self._aux_handles),
             x_raw, y_raw, _rand.next_key(),
-            jnp.asarray(self._t, jnp.int32))
+            jnp.asarray(self._t, jnp.int32),
+            jnp.asarray(lr, jnp.float32))
         with autograd.pause():
             for h, raw in zip(self._train_handles, new_p):
                 h._data = raw  # donated buffers: rebind directly
@@ -423,6 +437,8 @@ class ShardedTrainer:
         """Expected entry keys, POSITIONAL (collect_params order) so a
         fresh process with fresh gluon auto-prefixes can resume."""
         keys = ["__t__", "__rng_seed__", "__rng_key__", "__names__"]
+        if self._lr_scheduler is not None:
+            keys.append("__sched__")
         keys += [f"p{i}" for i in range(len(self._param_names))]
         keys += [f"a{i}" for i in range(len(self._aux_names))]
         for i, per in enumerate(self._opt_raws):
@@ -454,6 +470,12 @@ class ShardedTrainer:
             "__names__": NDArray(jnp.asarray(_np.frombuffer(
                 names_blob.encode(), _np.uint8))),
         }
+        if self._lr_scheduler is not None:
+            # schedulers decay IN PLACE; resume must rewind their state
+            import pickle
+
+            payload["__sched__"] = NDArray(jnp.asarray(_np.frombuffer(
+                pickle.dumps(self._lr_scheduler), _np.uint8)))
         for i, h in enumerate(self._train_handles):
             payload[f"p{i}"] = NDArray(self._host_copy(h._data))
         for i, h in enumerate(self._aux_handles):
@@ -515,6 +537,11 @@ class ShardedTrainer:
                 arrays[key]._data.astype(want_dtype), spec)
 
         self._t = int(arrays["__t__"].asscalar())
+        if self._lr_scheduler is not None:
+            import pickle
+
+            self._lr_scheduler = pickle.loads(
+                bytes(_np.asarray(arrays["__sched__"]._data)))
         _rand._ensure()
         _rand._state.seed = int(arrays["__rng_seed__"].asscalar())
         _rand._state.key = arrays["__rng_key__"]._data

@@ -451,3 +451,109 @@ def test_ring_attention_backward_matches_dense():
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_dense):
         assert float(jnp.abs(a - b).max()) < 1e-5
+
+
+def test_sharded_trainer_lr_scheduler():
+    """lr_scheduler in optimizer_params drives a per-step traced lr (no
+    recompilation): the schedule's decayed steps must match manual SGD
+    with the decayed rates exactly."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    # FactorScheduler is STATEFUL (base_lr decays in place): the
+    # trainer and the manual reference each need their own instance
+    def make_sched():
+        return mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                               base_lr=0.2)
+
+    x = mx.nd.array(np.random.RandomState(1).randn(16, 12)
+                    .astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(2).randn(16, 4)
+                    .astype(np.float32))
+
+    def make():
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4, in_units=12))
+        net.initialize(mx.init.Xavier())
+        net(x)
+        return net
+
+    net = make()
+    tr = ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                        {"learning_rate": 0.2,
+                         "lr_scheduler": make_sched()},
+                        mesh=DeviceMesh({"dp": 8}))
+    for _ in range(4):
+        tr.step(x, y)
+    tr.unshard()
+    got = [p.data().asnumpy() for p in net.collect_params().values()]
+
+    # manual: same per-step decayed rates through separate trainers
+    net2 = make()
+    raws = [p.data()._data for p in net2.collect_params().values()]
+    ref_sched = make_sched()
+    lrs = [float(ref_sched(t)) for t in range(1, 5)]  # _t pre-increments
+
+    def loss_fn(ws, x_, y_):
+        import jax.numpy as jnp
+
+        pred = x_ @ ws[0].T + ws[1]
+        return jnp.mean(jnp.square(pred - y_)) / 2.0
+
+    import jax.numpy as jnp
+
+    xs, ys = jnp.asarray(x.asnumpy()), jnp.asarray(y.asnumpy())
+    ws = [jnp.asarray(r) for r in raws]
+    for lr in lrs:
+        grads = jax.grad(loss_fn)(ws, xs, ys)
+        # trainer wd defaults to 0; weight has wd_mult 1 but wd=0
+        ws = [w - lr * g for w, g in zip(ws, grads)]
+    for a, b in zip(got, ws):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_trainer_scheduler_checkpoint_rewind():
+    """Schedulers decay in place; load_states must rewind their state so
+    a resumed run reproduces the uninterrupted schedule exactly."""
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    x = mx.nd.array(np.random.RandomState(1).randn(16, 12)
+                    .astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(2).randn(16, 4)
+                    .astype(np.float32))
+
+    def make():
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4, in_units=12))
+        net.initialize(mx.init.Xavier())
+        net(x)
+        return ShardedTrainer(
+            net, gluon.loss.L2Loss(), "sgd",
+            {"learning_rate": 0.2,
+             "lr_scheduler": mx.lr_scheduler.FactorScheduler(
+                 step=2, factor=0.5)},
+            mesh=DeviceMesh({"dp": 8}))
+
+    tr = make()
+    # learning_rate must seed the scheduler's base_lr (Optimizer parity)
+    assert tr._lr_scheduler.base_lr == 0.2
+    for _ in range(4):
+        tr.step(x, y)
+    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+        tr.save_states(f.name)
+        ref = [float(tr.step(x, y).asscalar()) for _ in range(4)]
+        tr2 = make()
+        for _ in range(10):  # decay tr2's scheduler well past step 4
+            tr2.step(x, y)
+        tr2.load_states(f.name)
+        got = [float(tr2.step(x, y).asscalar()) for _ in range(4)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
