@@ -159,6 +159,28 @@ class ShardedTrainer:
         return jax.make_array_from_process_local_data(
             sh, _np.asarray(jax.device_get(raw)))
 
+    @property
+    def learning_rate(self):
+        """Current (scheduled) lr — parity: optimizer.py learning_rate
+        property, which consults the scheduler at the current step."""
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler(self._t))
+        return self._lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_learning_rate(self, lr):
+        """Change the lr mid-training (gluon Trainer parity, including
+        the UserWarning raised when a scheduler already drives the lr —
+        optimizer.py set_learning_rate). The lr is a traced argument of
+        the compiled step, so no recompilation."""
+        if self._lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already "
+                              "been defined.")
+        self._lr = float(lr)
+
     def _spec_for(self, name):
         return self._mesh.sharding(*self._rules.get(name, ()))
 

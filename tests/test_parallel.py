@@ -557,3 +557,37 @@ def test_sharded_trainer_scheduler_checkpoint_rewind():
         tr2.load_states(f.name)
         got = [float(tr2.step(x, y).asscalar()) for _ in range(4)]
     np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_sharded_trainer_set_learning_rate():
+    """set_learning_rate changes the traced lr without recompilation;
+    raises UserWarning while a scheduler drives it and the property
+    consults the scheduler (gluon Trainer / Optimizer contract)."""
+    x = mx.nd.ones((8, 12))
+    y = mx.nd.zeros((8, 4))
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=12))
+    net.initialize(mx.init.Xavier())
+    net(x)
+    tr = ShardedTrainer(net, gloss.L2Loss(), "sgd",
+                        {"learning_rate": 0.1}, mesh=DeviceMesh({"dp": 8}))
+    tr.step(x, y)
+    compiled = tr._step_fn
+    w_before = [p.data().asnumpy().copy()
+                for p in net.collect_params().values()]
+    tr.learning_rate = 0.0  # freeze (gluon property-setter idiom)
+    tr.step(x, y)
+    assert tr._step_fn is compiled  # no recompilation
+    tr.unshard()
+    for b, p in zip(w_before, net.collect_params().values()):
+        np.testing.assert_allclose(p.data().asnumpy(), b, rtol=1e-6)
+    tr2 = ShardedTrainer(net, gloss.L2Loss(), "sgd",
+                         {"learning_rate": 0.1,
+                          "lr_scheduler":
+                          mx.lr_scheduler.FactorScheduler(step=5)},
+                         mesh=DeviceMesh({"dp": 8}))
+    with pytest.raises(UserWarning, match="LRScheduler"):
+        tr2.set_learning_rate(0.5)
+    assert tr.learning_rate == 0.0
+    assert tr2.learning_rate == 0.1  # property consults the scheduler
