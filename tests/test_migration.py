@@ -206,3 +206,83 @@ def test_loss_head_label_auto_creation_and_inference():
     mod.init_params(mx.init.Uniform(0.1))
     mod.forward(mx.io.DataBatch(data=[nd.ones((8, 10))], label=None))
     assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_gluon_data_pipeline_training_flow():
+    """The crash-course data chapter: Dataset -> transform -> DataLoader
+    -> training loop, unchanged."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(64, 8, 8, 3) * 255).astype(np.uint8)
+    labels = (imgs.reshape(64, -1).mean(axis=1) > 127).astype(np.float32)
+    ds = ArrayDataset(imgs, labels)
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.5, 0.25)])
+    ds = ds.transform_first(
+        lambda im: tf(nd.array(im, dtype=np.uint8)))
+    loader = DataLoader(ds, batch_size=16, shuffle=True)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(8):
+        for xb, yb in loader:
+            with autograd.record():
+                loss = loss_fn(net(xb.reshape((xb.shape[0], -1))), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+    preds = []
+    for xb, yb in DataLoader(ds, batch_size=16):
+        preds.append(net(xb.reshape((xb.shape[0], -1)))
+                     .argmax(axis=1).asnumpy())
+    acc = (np.concatenate(preds) == labels).mean()
+    assert acc > 0.85, acc
+
+
+def test_bucketing_module_over_context_group():
+    """BucketingModule inherits the ctx-list dp mesh through its bucket
+    Modules (module/bucketing_module.py passing context through)."""
+    rng = np.random.RandomState(0)
+    ctxs = [mx.cpu(i) for i in range(4)]
+
+    def sym_gen(seq_len):
+        # params must be bucket-shape-independent (the bucketing regime):
+        # embedding + time-pool + FC works for any seq_len
+        data = mx.sym.var("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=8,
+                               name="emb")
+        pooled = mx.sym.sum(emb, axis=1)
+        net = mx.sym.FullyConnected(pooled, num_hidden=8, name="fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=ctxs)
+    mod.bind([("data", (8, 16))], [("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer_params=(("learning_rate", 0.1),))
+    X = rng.randint(0, 20, (8, 16)).astype(np.float32)
+    Y = (X[:, 0] > 10).astype(np.float32)
+    batch = mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(Y)],
+                            bucket_key=16,
+                            provide_data=[("data", (8, 16))],
+                            provide_label=[("softmax_label", (8,))])
+    for _ in range(3):
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    assert mod._curr_module._exec._mesh is not None  # dp mesh active
+    assert mod.get_outputs()[0].shape == (8, 8)
+    # switch to a NEW bucket: _gen_module + shared-params bind must
+    # inherit the ctx-group mesh too, and share parameter handles
+    batch8 = mx.io.DataBatch(data=[nd.array(X[:, :8])],
+                             label=[nd.array(Y)], bucket_key=8,
+                             provide_data=[("data", (8, 8))],
+                             provide_label=[("softmax_label", (8,))])
+    mod.forward(batch8)
+    assert mod._curr_module._exec._mesh is not None
+    assert mod.get_outputs()[0].shape == (8, 8)
