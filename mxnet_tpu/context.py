@@ -127,10 +127,25 @@ def gpu(device_id: int = 0) -> Context:
 
 
 def num_tpus() -> int:
+    """Count attached accelerator devices. Counts any non-CPU platform
+    (real "tpu" as well as tunnel-attached platforms like "axon") so
+    device selection matches `Context.jax_device`'s resolution — a bench
+    host whose chip shows up under an experimental platform name must
+    not silently fall back to CPU (parity: python/mxnet/context.py:246
+    num_gpus)."""
     import jax
 
     try:
-        return len(jax.devices("tpu"))
+        n = len([d for d in jax.local_devices()
+                 if d.platform not in ("cpu",)])
+    except RuntimeError:
+        return 0
+    if n:
+        return n
+    # default backend is CPU (e.g. JAX_PLATFORMS="cpu,tpu" priority):
+    # an explicit tpu backend may still exist alongside it
+    try:
+        return len(jax.local_devices(backend="tpu"))
     except RuntimeError:
         return 0
 
