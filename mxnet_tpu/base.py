@@ -126,6 +126,10 @@ def ensure_live_backend(timeout_s=90, retries=1):
     pinned = os.environ.get("MXTPU_PLATFORM")
     if pinned:
         return pinned
+    if os.environ.get("MXTPU_PROBE_OK"):
+        # a probe already succeeded in this process tree; the backend
+        # spin-up is expensive, don't pay for it twice
+        return "default"
     last_err = None
     for _ in range(retries + 1):
         try:
@@ -133,6 +137,7 @@ def ensure_live_backend(timeout_s=90, retries=1):
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 timeout=timeout_s, capture_output=True)
             if proc.returncode == 0:
+                os.environ["MXTPU_PROBE_OK"] = "1"
                 return "default"
             last_err = proc.stderr.decode(errors="replace")[-500:]
         except subprocess.TimeoutExpired:

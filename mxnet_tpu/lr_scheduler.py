@@ -1,8 +1,18 @@
-"""Learning-rate schedulers.
+"""Learning-rate schedules.
 
-Parity target: `python/mxnet/lr_scheduler.py` (281 LoC) — LRScheduler base
-with warmup (linear/constant), FactorScheduler, MultiFactorScheduler,
-PolyScheduler, CosineScheduler.
+Role parity: the reference's ``mxnet.lr_scheduler`` surface (LRScheduler
+base with linear/constant warmup, Factor/MultiFactor/Poly/Cosine
+schedulers, ``python/mxnet/lr_scheduler.py``) — re-derived here as
+STATELESS maps ``num_update -> lr``.
+
+Design departure from the reference (which walks a mutable ``count`` /
+``base_lr`` forward on every call): each scheduler computes its value
+directly from ``num_update``, so calls are pure — safe to replay, to
+evaluate out of order, and to pickle/restore for checkpoint-resume
+(ShardedTrainer.save_states round-trips schedulers by value; a resumed
+run sees exactly the schedule the uninterrupted run would have).
+``base_lr`` stays a plain attribute that optimizers may overwrite after
+construction (Optimizer seeds it with ``learning_rate``).
 """
 from __future__ import annotations
 
@@ -13,135 +23,145 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
-    """Base class (parity: lr_scheduler.py:25)."""
+    """Map an update count to a learning rate.
+
+    Subclasses implement ``_decay(num_update)`` over the ABSOLUTE update
+    count (milestones/windows are absolute, matching the reference's
+    schedule timing); the base class owns the warmup ramp.
+    """
 
     def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
                  warmup_mode="linear"):
+        if warmup_steps < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got {warmup_steps}")
+        if warmup_mode not in ("linear", "constant"):
+            raise ValueError(
+                f"warmup_mode must be 'linear' or 'constant', "
+                f"got {warmup_mode!r}")
+        if warmup_begin_lr > base_lr:
+            raise ValueError(
+                f"warmup_begin_lr ({warmup_begin_lr}) must not exceed "
+                f"base_lr ({base_lr})")
         self.base_lr = base_lr
-        assert warmup_steps >= 0
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
-        self.warmup_final_lr = base_lr
-        assert self.warmup_begin_lr <= self.warmup_final_lr
-        if warmup_mode not in ("linear", "constant"):
-            raise ValueError("Supports only linear and constant warmup modes")
         self.warmup_mode = warmup_mode
 
+    @property
+    def warmup_final_lr(self):
+        # tracks base_lr so a post-construction overwrite (Optimizer
+        # seeds base_lr with learning_rate) keeps the ramp continuous
+        return self.base_lr
+
     def get_warmup_lr(self, num_update):
-        assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = (self.warmup_final_lr - self.warmup_begin_lr) \
-                * float(num_update) / float(self.warmup_steps)
-            return self.warmup_begin_lr + increase
-        return self.warmup_begin_lr
+        """lr on the warmup ramp (``num_update < warmup_steps``)."""
+        if self.warmup_mode == "constant":
+            return self.warmup_begin_lr
+        frac = num_update / self.warmup_steps
+        return self.warmup_begin_lr + \
+            frac * (self.warmup_final_lr - self.warmup_begin_lr)
+
+    def _decay(self, num_update):
+        raise NotImplementedError
 
     def __call__(self, num_update):
-        raise NotImplementedError
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._decay(num_update)
+
+
+def _check_factor(factor):
+    if factor > 1.0:
+        raise ValueError(
+            f"a decay factor > 1 would grow the lr, got {factor}")
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (parity: lr_scheduler.py:90)."""
+    """Multiply the lr by ``factor`` once every ``step`` updates, with a
+    floor at ``stop_factor_lr``."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
-        if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError(f"step must be >= 1, got {step}")
+        _check_factor(factor)
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-        return self.base_lr
+    def _decay(self, num_update):
+        # number of whole `step` windows strictly completed before now
+        k = max(0, (num_update - 1) // self.step) if num_update > 0 else 0
+        return max(self.base_lr * self.factor ** k, self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each listed step (parity: lr_scheduler.py:149)."""
+    """Multiply the lr by ``factor`` at each milestone in ``step`` (a
+    strictly increasing list of update counts)."""
 
     def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
-        if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError(f"milestones must be >= 1, got {step}")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError(f"milestones must strictly increase, got {step}")
+        _check_factor(factor)
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _decay(self, num_update):
+        k = sum(1 for s in self.step if num_update > s)
+        return self.base_lr * self.factor ** k
 
 
-class PolyScheduler(LRScheduler):
-    """Polynomial decay to final_lr over max_update (parity:
-    lr_scheduler.py:200)."""
+class _SpanScheduler(LRScheduler):
+    """Shared shape for schedules that anneal base_lr -> final_lr over
+    the ``max_update - warmup_steps`` span and then hold final_lr."""
+
+    def __init__(self, max_update, base_lr=0.01, final_lr=0,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError(
+                f"max_update must be a positive int, got {max_update!r}")
+        if warmup_steps >= max_update:
+            raise ValueError(
+                f"warmup_steps ({warmup_steps}) must be < max_update "
+                f"({max_update}): the anneal span would be empty")
+        self.max_update = max_update
+        self.final_lr = final_lr
+        self.max_steps = max_update - warmup_steps
+
+    def _shape(self, frac):
+        """Annealing profile: 1 -> 0 as frac goes 0 -> 1."""
+        raise NotImplementedError
+
+    def _decay(self, num_update):
+        t = num_update - self.warmup_steps
+        frac = min(t, self.max_steps) / self.max_steps
+        return self.final_lr + \
+            (self.base_lr - self.final_lr) * self._shape(frac)
+
+
+class PolyScheduler(_SpanScheduler):
+    """Polynomial annealing: ``(1 - frac) ** pwr`` of the lr span."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
         self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
-                * pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                      self.power)
-        return self.base_lr
+    def _shape(self, frac):
+        return (1.0 - frac) ** self.power
 
 
-class CosineScheduler(LRScheduler):
-    """Cosine decay (parity: lr_scheduler.py:243)."""
+class CosineScheduler(_SpanScheduler):
+    """Half-cosine annealing of the lr span."""
 
-    def __init__(self, max_update, base_lr=0.01, final_lr=0, warmup_steps=0,
-                 warmup_begin_lr=0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
-
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
-                * (1 + math.cos(math.pi * (num_update - self.warmup_steps)
-                                / self.max_steps)) / 2
-        return self.base_lr
+    def _shape(self, frac):
+        return 0.5 * (1.0 + math.cos(math.pi * frac))

@@ -264,3 +264,42 @@ def test_lbsgd_lars_strategy():
     # lars = sqrt(25 / 0.25) = 10 -> effective lr 1.0
     np.testing.assert_allclose(w.asnumpy(), [3.0 - 0.3, 4.0 - 0.4],
                                rtol=1e-5)
+
+
+def test_factor_milestones_absolute_under_warmup():
+    """Decay windows/milestones are ABSOLUTE update counts — warmup must
+    not shift the schedule (reference timing)."""
+    s = lr_scheduler.MultiFactorScheduler(step=[100, 200], factor=0.1,
+                                          base_lr=1.0, warmup_steps=50)
+    assert abs(s(101) - 0.1) < 1e-12  # drops just after update 100
+    assert abs(s(150) - 0.1) < 1e-12  # NOT shifted to 150
+    f = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0,
+                                     warmup_steps=5)
+    assert f(10) == 1.0
+    assert f(11) == 0.5
+
+
+def test_warmup_tracks_reseeded_base_lr():
+    """Optimizer seeds scheduler.base_lr post-construction; the warmup
+    ramp must end exactly at the new base lr (no discontinuity)."""
+    s = lr_scheduler.FactorScheduler(step=1000, base_lr=0.01,
+                                     warmup_steps=10, warmup_begin_lr=0.0)
+    s.base_lr = 1.0
+    assert abs(s(5) - 0.5) < 1e-12
+    assert s(10) == 1.0
+
+
+def test_span_scheduler_rejects_empty_anneal():
+    with pytest.raises(ValueError, match="warmup_steps"):
+        lr_scheduler.CosineScheduler(max_update=10, warmup_steps=10)
+    with pytest.raises(ValueError, match="warmup_steps"):
+        lr_scheduler.PolyScheduler(max_update=10, warmup_steps=15)
+
+
+def test_scheduler_stateless_replay():
+    """Calls are pure: out-of-order and repeated evaluation agree (the
+    reference's stateful walk could not rewind — checkpoint-resume
+    relies on this)."""
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    seq = [s(t) for t in (21, 1, 11, 21, 1)]
+    assert seq == [0.25, 1.0, 0.5, 0.25, 1.0]
