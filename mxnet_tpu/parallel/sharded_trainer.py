@@ -55,7 +55,12 @@ class ShardedTrainer:
     net : HybridBlock with materialized parameters.
     loss_fn : callable (pred NDArray, label NDArray) -> loss NDArray
         (e.g. a gluon loss block).
-    optimizer : 'sgd' | 'adam'
+    optimizer : any registered optimizer name (the full 17-entry zoo:
+        sgd/nag/signum/lars/lbsgd/sgld/dcasgd/adam/ftml/lamb/adagrad/
+        rmsprop/adadelta/ftrl/adamax/nadam/test) or an Optimizer
+        instance; the update math runs INSIDE the compiled step via
+        opt_rules.py, reusing the ops/optimizer_op.py kernels.
+        multi_precision=True keeps fp32 master weights for bf16 params.
     mesh : DeviceMesh (default: all devices on dp)
     rules : optional {param_name: PartitionSpec tuple} overriding defaults.
     """
@@ -98,14 +103,46 @@ class ShardedTrainer:
             # same contract as Optimizer: learning_rate seeds the
             # scheduler's base_lr (optimizer/optimizer.py:41)
             self._lr_scheduler.base_lr = self._lr
-        self._momentum = float(opt_params.pop("momentum", 0.0))
-        self._wd = float(opt_params.pop("wd", 0.0))
-        self._beta1 = float(opt_params.pop("beta1", 0.9))
-        self._beta2 = float(opt_params.pop("beta2", 0.999))
-        self._epsilon = float(opt_params.pop("epsilon", 1e-8))
-        self._opt_name = optimizer
-        if opt_params:
-            raise ValueError(f"unsupported optimizer params: {opt_params}")
+        # the eager optimizer instance validates hyper-params and is the
+        # static hyper source for the compiled update rule (opt_rules.py)
+        from .. import optimizer as _opt_mod
+        from .opt_rules import RULES
+
+        if isinstance(optimizer, _opt_mod.Optimizer):
+            self._opt = optimizer
+            # honour the instance's own lr/scheduler unless explicitly
+            # overridden through optimizer_params
+            if "learning_rate" not in (optimizer_params or {}):
+                self._lr = float(self._opt.lr)
+            if self._lr_scheduler is None and \
+                    self._opt.lr_scheduler is not None:
+                self._lr_scheduler = self._opt.lr_scheduler
+                self._lr_scheduler.base_lr = self._lr
+        else:
+            try:
+                self._opt = _opt_mod.create(
+                    optimizer, learning_rate=self._lr, **opt_params)
+            except TypeError as e:
+                raise ValueError(
+                    f"unsupported optimizer params for {optimizer!r}: "
+                    f"{e}") from None
+        self._opt_name = type(self._opt).__name__.lower()
+        if self._opt_name not in RULES:
+            raise ValueError(
+                f"no compiled update rule for optimizer "
+                f"{self._opt_name!r}; available: {sorted(RULES)}")
+        self._rule = RULES[self._opt_name]
+        if self._opt_name == "lbsgd" and self._opt.batch_scale > 1 \
+                and self._accum == 1:
+            import warnings
+
+            warnings.warn(
+                "LBSGD batch_scale>1: the compiled step applies the "
+                "large-batch lr warmup every step but does NOT "
+                "accumulate gradients — pass accum_steps (or feed the "
+                "full macro-batch) for the accumulation half",
+                stacklevel=2)
+        self._wd = float(self._opt.wd)
 
         params = net.collect_params()
         self._param_names = []
@@ -188,7 +225,9 @@ class ShardedTrainer:
         """Optimizer-state layout: the parameter's own spec, or — under
         ZeRO — additionally dp-sharded on the first divisible unsharded
         dim, dividing state memory by the dp size (ZeRO-1)."""
-        spec = tuple(self._rules.get(name, ()))
+        # trim to the state's own rank: scalar states (e.g. Nadam's
+        # momentum schedule) of a tp-sharded weight stay replicated
+        spec = tuple(self._rules.get(name, ()))[:len(shape)]
         if not self._zero:
             return self._mesh.sharding(*spec)
         dp = self._mesh.size("dp")
@@ -214,21 +253,25 @@ class ShardedTrainer:
                   for s in per)
             for name, per in zip(self._param_names, self._opt_raws))
 
+    def _is_lowp(self, raw):
+        return str(raw.dtype) in ("bfloat16", "float16")
+
     def _init_opt_state(self):
+        """Per-parameter state from the rule's factory. Under
+        multi-precision an fp32 master copy is PREPENDED to each low-
+        precision parameter's state and the rule's own state is built in
+        fp32 (parity: create_state_multi_precision)."""
         import jax.numpy as jnp
 
+        mp = getattr(self._opt, "multi_precision", False)
         out = []
         for h in self._train_handles:
-            def z():
-                # distinct buffers per state slot — donation forbids aliases
-                return jnp.zeros(h._data.shape, h._data.dtype)
-
-            if self._opt_name == "sgd":
-                out.append((z(),) if self._momentum else ())
-            elif self._opt_name == "adam":
-                out.append((z(), z()))
+            w = h._data
+            if mp and self._is_lowp(w):
+                w32 = jnp.asarray(w, jnp.float32)
+                out.append((w32,) + self._rule.init(self._opt, w32))
             else:
-                raise ValueError(f"unsupported optimizer {self._opt_name!r}")
+                out.append(self._rule.init(self._opt, w))
         return tuple(out)
 
     # ------------------------------------------------------------- build ---
@@ -240,10 +283,12 @@ class ShardedTrainer:
         loss_fn = self._loss_fn
         train_handles = self._train_handles
         aux_handles = self._aux_handles
-        momentum, wd = self._momentum, self._wd
-        beta1, beta2, eps = self._beta1, self._beta2, self._epsilon
+        wd = self._wd
         wd_mult = self._wd_mult
-        opt_name = self._opt_name
+        opt = self._opt
+        rule = self._rule
+        multi_precision = getattr(opt, "multi_precision", False)
+        is_lowp = self._is_lowp
         n_aux = len(aux_handles)
 
         def run_net(praws, araws, x, y, rng):
@@ -319,40 +364,41 @@ class ShardedTrainer:
 
         def step_fn(praws, opt_raws, araws, x, y, rng, t, lr):
             (loss, new_aux), grads = grads_of(praws, araws, x, y, rng)
+            tt = t.astype(jnp.float32)
             new_p, new_opt = [], []
             for i, (w, g, st) in enumerate(zip(praws, grads, opt_raws)):
                 pwd = wd * wd_mult[i]
-                g = g.astype(w.dtype)  # keep update arithmetic in param dtype
-                # the traced lr scalar must not promote bf16 params
-                lr_w = lr.astype(w.dtype)
                 if zero:
-                    # pin gradient (and hence m/v and the delta math) to
+                    # pin gradient (and hence the state and delta math) to
                     # the dp-sharded state layout; XLA all-gathers only
                     # the final parameter delta (ZeRO-1)
                     g = jax.lax.with_sharding_constraint(g, state_sh[i])
-                if opt_name == "sgd":
-                    if momentum:
-                        mom = momentum * st[0] - lr_w * (g + pwd * w)
-                        new_p.append(w + mom)
-                        new_opt.append((mom,))
-                    else:
-                        new_p.append(w - lr_w * (g + pwd * w))
-                        new_opt.append(())
-                else:  # adam (bias-corrected via lr scaling, ref parity)
-                    m = beta1 * st[0] + (1 - beta1) * (g + pwd * w)
-                    v = beta2 * st[1] + (1 - beta2) * jnp.square(g + pwd * w)
-                    tt = t.astype(jnp.float32)
-                    alpha = lr_w * (jnp.sqrt(1 - beta2 ** tt) /
-                                    (1 - beta1 ** tt)).astype(w.dtype)
-                    new_p.append(w - alpha * m / (jnp.sqrt(v) + eps))
-                    new_opt.append((m, v))
+                rng_i = jax.random.fold_in(rng, i + 1)  # stochastic rules
+                if multi_precision and is_lowp(w):
+                    # fp32 master copy leads the state tuple; the rule
+                    # runs entirely in fp32, params get the cast result
+                    w32, inner = st[0], st[1:]
+                    w32n, innern = rule.update(
+                        opt, w32, g.astype(jnp.float32), inner, lr, pwd,
+                        tt, rng_i)
+                    new_p.append(w32n.astype(w.dtype))
+                    new_opt.append((w32n,) + tuple(innern))
+                else:
+                    # keep update arithmetic in the param dtype
+                    wn, stn = rule.update(
+                        opt, w, g.astype(w.dtype), st, lr, pwd, tt, rng_i)
+                    new_p.append(wn)
+                    new_opt.append(tuple(stn))
             return tuple(new_p), tuple(new_opt), new_aux, loss
 
         # shardings: batch over dp; params per rules; opt state reuses the
         # per-param state layout the update math is pinned to; aux replicated
         p_sh = tuple(self._spec_for(n) for n in self._param_names)
-        opt_sh = tuple(tuple(state_sh[i] for _ in per)
-                       for i, per in enumerate(self._opt_raws))
+        # per-SLOT shardings: state slots can differ in rank from the
+        # parameter (e.g. Nadam's scalar momentum schedule)
+        opt_sh = tuple(
+            tuple(self._state_spec_for(n, s.shape) for s in per)
+            for n, per in zip(self._param_names, self._opt_raws))
         aux_sh = (self._mesh.replicated(),) * n_aux
         data_spec = ("dp",) + (None,) * (len(x_raw.shape) - 1)
         x_sh = self._mesh.sharding(*data_spec)

@@ -591,3 +591,197 @@ def test_sharded_trainer_set_learning_rate():
         tr2.set_learning_rate(0.5)
     assert tr.learning_rate == 0.0
     assert tr2.learning_rate == 0.1  # property consults the scheduler
+
+
+# --------------------------------------------------------------------------
+# full optimizer zoo inside the compiled step (VERDICT r4 item 4):
+# ShardedTrainer numerics must equal the eager gluon Trainer driving the
+# same optimizer (which itself is tested against reference numerics in
+# test_optimizer.py)
+
+_ZOO = [
+    ("sgd", {"momentum": 0.9, "wd": 1e-3}),
+    ("nag", {"momentum": 0.9}),
+    ("signum", {"momentum": 0.9, "wd_lh": 1e-3}),
+    ("lars", {"momentum": 0.9, "eta": 0.01}),
+    ("lbsgd", {"momentum": 0.9, "warmup_strategy": "linear",
+               "warmup_epochs": 1, "updates_per_epoch": 4}),
+    ("dcasgd", {"momentum": 0.9, "lamda": 0.04}),
+    ("adam", {}),
+    ("ftml", {}),
+    ("lamb", {}),
+    ("adagrad", {}),
+    ("rmsprop", {}),
+    ("rmsprop", {"centered": True}),
+    ("adadelta", {}),
+    ("ftrl", {}),
+    ("adamax", {}),
+    ("nadam", {}),
+    ("adamax", {"wd": 1e-3, "clip_gradient": 0.01}),
+    ("nadam", {"wd": 1e-3, "clip_gradient": 0.01}),
+    ("test", {}),
+]
+
+
+def _zoo_data():
+    rs = np.random.RandomState(7)
+    x = mx.nd.array(rs.randn(16, 12).astype(np.float32))
+    y = mx.nd.array(rs.randn(16, 4).astype(np.float32))
+    return x, y
+
+
+def _zoo_net(x):
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    # weight-only: gluon Trainer applies wd to every Parameter
+    # (wd_mult=1.0 default) while the sharded step zeroes bias wd —
+    # keep the comparison on the shared semantics
+    net.add(nn.Dense(6, in_units=12, use_bias=False),
+            nn.Dense(4, in_units=6, use_bias=False))
+    net.initialize(mx.init.Xavier())
+    net(x)
+    return net
+
+
+@pytest.mark.parametrize("name,params", _ZOO,
+                         ids=[f"{n}-{i}" for i, (n, _) in enumerate(_ZOO)])
+def test_sharded_trainer_matches_eager_optimizer(name, params):
+    from mxnet_tpu import autograd, gluon
+
+    x, y = _zoo_data()
+    steps = 3
+
+    # nadam's momentum-schedule state is per-parameter in the compiled
+    # rule; the eager reference shares one schedule across params
+    # (order-dependent), so compare on a single-parameter net
+    def build():
+        if name == "nadam":
+            mx.random.seed(3)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(4, in_units=12, use_bias=False))
+            net.initialize(mx.init.Xavier())
+            net(x)
+            return net
+        return _zoo_net(x)
+
+    net_s = build()
+    tr = ShardedTrainer(net_s, gloss.L2Loss(), name,
+                        {"learning_rate": 0.05, **params},
+                        mesh=DeviceMesh({"dp": 8}))
+    for _ in range(steps):
+        tr.step(x, y)
+    tr.unshard()
+    got = [p.data().asnumpy() for p in net_s.collect_params().values()]
+
+    net_e = build()
+    eager = gluon.Trainer(net_e.collect_params(), name,
+                          {"learning_rate": 0.05, **params})
+    for _ in range(steps):
+        with autograd.record():
+            loss = gloss.L2Loss()(net_e(x), y).mean()
+        loss.backward()
+        eager.step(1)
+    want = [p.data().asnumpy() for p in net_e.collect_params().values()]
+
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-6)
+
+
+def test_sharded_trainer_sgld_runs():
+    """SGLD is stochastic (different rng streams eager vs compiled):
+    check the compiled step trains and stays finite."""
+    x, y = _zoo_data()
+    net = _zoo_net(x)
+    tr = ShardedTrainer(net, gloss.L2Loss(), "sgld",
+                        {"learning_rate": 0.01},
+                        mesh=DeviceMesh({"dp": 8}))
+    before = [p.data().asnumpy().copy()
+              for p in net.collect_params().values()]
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    tr.unshard()
+    after = [p.data().asnumpy() for p in net.collect_params().values()]
+    assert all(np.isfinite(a).all() for a in after)
+    assert any(np.abs(a - b).max() > 0 for a, b in zip(after, before))
+
+
+def test_sharded_trainer_multi_precision_master_weights():
+    """bf16 params + multi_precision=True: fp32 master copy leads each
+    state tuple and the trajectory tracks the fp32 run far better than
+    a pure-bf16 run after many steps."""
+    x, y = _zoo_data()
+
+    def build(dtype):
+        net = _zoo_net(x)
+        if dtype != "float32":
+            net.cast(dtype)
+            net(x.astype(dtype))
+        return net
+
+    def run(dtype, mp):
+        net = build(dtype)
+        tr = ShardedTrainer(
+            net, gloss.L2Loss(),
+            mx.optimizer.SGD(learning_rate=0.05, momentum=0.9,
+                             multi_precision=mp),
+            mesh=DeviceMesh({"dp": 8}))
+        xx = x.astype(dtype) if dtype != "float32" else x
+        for _ in range(20):
+            tr.step(xx, y)
+        if mp:
+            assert all(str(per[0].dtype) == "float32"
+                       for per in tr._opt_raws)
+        tr.unshard()
+        return [p.data().asnumpy().astype(np.float32)
+                for p in net.collect_params().values()]
+
+    ref = run("float32", False)
+    got_mp = run("bfloat16", True)
+    got_lp = run("bfloat16", False)
+    err_mp = max(np.abs(a - b).max() for a, b in zip(got_mp, ref))
+    err_lp = max(np.abs(a - b).max() for a, b in zip(got_lp, ref))
+    assert err_mp < err_lp, (err_mp, err_lp)
+    assert err_mp < 0.01
+
+
+def test_sharded_trainer_optimizer_instance_lr_honored():
+    """An Optimizer INSTANCE carries its own lr (and scheduler): the
+    compiled step must use it, not the 0.01 default."""
+    x, y = _zoo_data()
+    net_a = _zoo_net(x)
+    tr_a = ShardedTrainer(net_a, gloss.L2Loss(),
+                          mx.optimizer.SGD(learning_rate=0.05),
+                          mesh=DeviceMesh({"dp": 8}))
+    assert tr_a.learning_rate == 0.05
+    tr_a.step(x, y)
+    tr_a.unshard()
+    net_b = _zoo_net(x)
+    tr_b = ShardedTrainer(net_b, gloss.L2Loss(), "sgd",
+                          {"learning_rate": 0.05},
+                          mesh=DeviceMesh({"dp": 8}))
+    tr_b.step(x, y)
+    tr_b.unshard()
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-6)
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    tr_c = ShardedTrainer(_zoo_net(x), gloss.L2Loss(),
+                          mx.optimizer.SGD(learning_rate=0.4,
+                                           lr_scheduler=sched),
+                          mesh=DeviceMesh({"dp": 8}))
+    assert tr_c._lr_scheduler is sched
+    assert tr_c.learning_rate == 0.4
+
+
+def test_sharded_trainer_nadam_zero_scalar_state():
+    """ZeRO + a scalar state slot (Nadam momentum schedule) + a sharded
+    weight: the per-slot sharding must not apply a param-rank spec to
+    the rank-0 state."""
+    x, y = _zoo_data()
+    net = _zoo_net(x)
+    tr = ShardedTrainer(net, gloss.L2Loss(), "nadam",
+                        {"learning_rate": 0.01},
+                        mesh=DeviceMesh({"dp": 4, "tp": 2}), zero=True)
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(2)]
+    assert all(np.isfinite(losses))
