@@ -150,16 +150,20 @@ def _lbsgd_update(opt, w, g, st, lr, wd, t, rng):
         return w - step, ()
     tt = t + float(opt.init_updates)
     if nwup <= 1:
-        mult = jnp.float32(1.0)
-    elif opt.warmup_strategy == "linear":
-        mult = 1.0 + (maxmult - 1) * tt / nwup
-    elif opt.warmup_strategy == "power2":
-        mult = 1.0 + (maxmult - 1) * (tt * tt) / (nwup * nwup)
-    elif opt.warmup_strategy == "sqrt":
-        mult = 1.0 + (maxmult - 1) * jnp.sqrt(tt / nwup)
+        # eager _get_lbmult: nup >= nwup wins first, so a zero/one-step
+        # warmup window means the full batch_scale multiplier from the
+        # first update
+        mult = jnp.float32(maxmult)
     else:
-        mult = jnp.float32(1.0)
-    mult = jnp.where(tt >= nwup, maxmult, mult) if nwup > 1 else mult
+        if opt.warmup_strategy == "linear":
+            mult = 1.0 + (maxmult - 1) * tt / nwup
+        elif opt.warmup_strategy == "power2":
+            mult = 1.0 + (maxmult - 1) * (tt * tt) / (nwup * nwup)
+        elif opt.warmup_strategy == "sqrt":
+            mult = 1.0 + (maxmult - 1) * jnp.sqrt(tt / nwup)
+        else:
+            mult = jnp.float32(1.0)
+        mult = jnp.where(tt >= nwup, maxmult, mult)
     kw = dict(lr=_lr_of(lr * mult, w), wd=wd,
               rescale_grad=opt.rescale_grad, clip_gradient=_clip(opt))
     if opt.momentum:

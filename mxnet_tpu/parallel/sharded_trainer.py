@@ -99,10 +99,6 @@ class ShardedTrainer:
         # of the compiled step (one executable, lr varies per call)
         self._lr_scheduler = opt_params.pop("lr_scheduler", None)
         self._lr = float(opt_params.pop("learning_rate", 0.01))
-        if self._lr_scheduler is not None:
-            # same contract as Optimizer: learning_rate seeds the
-            # scheduler's base_lr (optimizer/optimizer.py:41)
-            self._lr_scheduler.base_lr = self._lr
         # the eager optimizer instance validates hyper-params and is the
         # static hyper source for the compiled update rule (opt_rules.py)
         from .. import optimizer as _opt_mod
@@ -110,6 +106,13 @@ class ShardedTrainer:
 
         if isinstance(optimizer, _opt_mod.Optimizer):
             self._opt = optimizer
+            if opt_params:
+                # hypers live on the instance; silently ignoring leftovers
+                # would train with different dynamics than requested
+                raise ValueError(
+                    "optimizer_params other than learning_rate/"
+                    "lr_scheduler cannot be combined with an Optimizer "
+                    f"instance: {sorted(opt_params)}")
             # honour the instance's own lr/scheduler unless explicitly
             # overridden through optimizer_params
             if "learning_rate" not in (optimizer_params or {}):
@@ -117,7 +120,6 @@ class ShardedTrainer:
             if self._lr_scheduler is None and \
                     self._opt.lr_scheduler is not None:
                 self._lr_scheduler = self._opt.lr_scheduler
-                self._lr_scheduler.base_lr = self._lr
         else:
             try:
                 self._opt = _opt_mod.create(
@@ -126,6 +128,11 @@ class ShardedTrainer:
                 raise ValueError(
                     f"unsupported optimizer params for {optimizer!r}: "
                     f"{e}") from None
+        if self._lr_scheduler is not None:
+            # same contract as Optimizer: learning_rate seeds the
+            # scheduler's base_lr (optimizer/optimizer.py:41) — AFTER the
+            # instance branch may have adopted the instance's lr
+            self._lr_scheduler.base_lr = self._lr
         self._opt_name = type(self._opt).__name__.lower()
         if self._opt_name not in RULES:
             raise ValueError(

@@ -785,3 +785,68 @@ def test_sharded_trainer_nadam_zero_scalar_state():
                         mesh=DeviceMesh({"dp": 4, "tp": 2}), zero=True)
     losses = [float(tr.step(x, y).asscalar()) for _ in range(2)]
     assert all(np.isfinite(losses))
+
+
+def test_sharded_trainer_lbsgd_warmup_ramp():
+    """batch_scale>1 LBSGD: the compiled step must apply the eager
+    _get_lbmult lr ramp each step (accumulation itself is accum_steps'
+    job). Reference trajectory: compiled SGD-momentum re-fed the ramped
+    lr per step."""
+    from mxnet_tpu.optimizer import LBSGD
+
+    x, y = _zoo_data()
+    base_lr, steps = 0.02, 5
+    for strategy, epochs in [("sqrt", 1), ("linear", 0)]:
+        mx.random.seed(11)
+        net_a = _zoo_net(x)
+        with pytest.warns(UserWarning, match="batch_scale"):
+            tr_a = ShardedTrainer(
+                net_a, gloss.L2Loss(), "lbsgd",
+                {"learning_rate": base_lr, "momentum": 0.9,
+                 "warmup_strategy": strategy, "batch_scale": 4,
+                 "warmup_epochs": epochs, "updates_per_epoch": 3},
+                mesh=DeviceMesh({"dp": 8}))
+        for _ in range(steps):
+            tr_a.step(x, y)
+        tr_a.unshard()
+
+        ref_opt = LBSGD(momentum=0.9, warmup_strategy=strategy,
+                        batch_scale=4, warmup_epochs=epochs,
+                        updates_per_epoch=3)
+        mx.random.seed(11)
+        net_b = _zoo_net(x)
+        tr_b = ShardedTrainer(net_b, gloss.L2Loss(), "sgd",
+                              {"learning_rate": base_lr, "momentum": 0.9},
+                              mesh=DeviceMesh({"dp": 8}))
+        for t in range(1, steps + 1):
+            tr_b.set_learning_rate(base_lr * ref_opt._get_lbmult(t))
+            tr_b.step(x, y)
+        tr_b.unshard()
+        for pa, pb in zip(net_a.collect_params().values(),
+                          net_b.collect_params().values()):
+            np.testing.assert_allclose(pa.data().asnumpy(),
+                                       pb.data().asnumpy(),
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_sharded_trainer_instance_rejects_leftover_params():
+    x, _ = _zoo_data()
+    net = _zoo_net(x)
+    with pytest.raises(ValueError, match="Optimizer instance"):
+        ShardedTrainer(net, gloss.L2Loss(),
+                       mx.optimizer.SGD(learning_rate=0.05),
+                       {"momentum": 0.9}, mesh=DeviceMesh({"dp": 8}))
+
+
+def test_sharded_trainer_instance_lr_seeds_param_scheduler():
+    """A scheduler passed via optimizer_params must be seeded with the
+    INSTANCE's lr, not the 0.01 default."""
+    x, _ = _zoo_data()
+    net = _zoo_net(x)
+    sched = mx.lr_scheduler.FactorScheduler(step=100, factor=0.5)
+    tr = ShardedTrainer(net, gloss.L2Loss(),
+                        mx.optimizer.SGD(learning_rate=0.4),
+                        {"lr_scheduler": sched},
+                        mesh=DeviceMesh({"dp": 8}))
+    assert sched.base_lr == 0.4
+    assert tr.learning_rate == 0.4
