@@ -596,6 +596,9 @@ def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
                      if getattr(type(x), "_np_frontend", False)), NDArray)
     prof_t0 = _profiler._now_us() if _profiler._REC_IMPERATIVE else None
     op = _reg.get(op_name)
+    # dmlc::Parameter analogue: structured validation + string coercion;
+    # the frozen key is reused by bound() (one freeze per call)
+    kwargs, _kw_key = op.checked(kwargs)
     raws = [x._data for x in nd_inputs]
     if _amp_core.ACTIVE:
         raws = _amp_core.cast_inputs(op_name, raws)
@@ -630,7 +633,7 @@ def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
             # and breaks vjp of some primitives (reduce_window)
             raw_out = op.fn(*raws, **kwargs)
         else:
-            raw_out = op.bound(kwargs)(*raws)
+            raw_out = op.bound(kwargs, _key=_kw_key)(*raws)
         result = _wrap_outputs(op, raw_out, wrap)
     engine.maybe_sync([r._data for r in (result if isinstance(result, tuple) else (result,))])
     if prof_t0 is not None:

@@ -115,7 +115,10 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad
 
 # --------------------------------------------------------------- Pooling ---
 
-@register("Pooling")
+@register("Pooling", param_specs={
+    "pool_type": {"choices": ("max", "avg", "sum", "lp"),
+                  "doc": "Pooling reduction"},
+    "pooling_convention": {"choices": ("valid", "full", "same")}})
 def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
              global_pool=False, pooling_convention="valid", cudnn_off=False,
              count_include_pad=True, layout=None):
@@ -139,6 +142,14 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             out_sz = -(-(in_sz + 2 * pad[i] - kernel[i]) // stride[i]) + 1
             needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
             pads.append((pad[i], max(needed, pad[i])))
+    elif pooling_convention == "same" and not global_pool:
+        # TF-style SAME: out = ceil(in/stride), asymmetric split padding
+        pads = [(0, 0), (0, 0)]
+        for i in range(n):
+            in_sz = data.shape[2 + i]
+            out_sz = -(-in_sz // stride[i])
+            needed = max((out_sz - 1) * stride[i] + kernel[i] - in_sz, 0)
+            pads.append((needed // 2, needed - needed // 2))
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     # init values MUST be python scalar literals: array-valued inits break
@@ -363,7 +374,10 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                                 out_grad, smooth_alpha, axis)
 
 
-@register("Activation")
+@register("Activation", param_specs={
+    "act_type": {"choices": ("relu", "sigmoid", "tanh", "softrelu",
+                             "softsign"),
+                 "doc": "Activation function to apply"}})
 def _activation(data, act_type="relu"):
     return {
         "relu": jax.nn.relu,
@@ -407,7 +421,8 @@ def _leaky_relu(data, gamma=None, key=None, act_type="leaky", slope=0.25,
 
 # --------------------------------------------------------------- Dropout ---
 
-@register("Dropout")
+@register("Dropout", param_specs={
+    "p": {"low": 0.0, "high": 1.0, "doc": "Fraction of units to drop"}})
 def _dropout(data, key=None, p=0.5, mode="training", axes=(), training=True,
              cudnn_off=False):
     """parity: src/operator/nn/dropout-inl.h. `key` is a uint32 PRNG key array

@@ -55,7 +55,11 @@ def _randint(key, low=0, high=1, shape=(), dtype="int32"):
 
 @register("_sample_multinomial", differentiable=False)
 def _multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
-    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
+    # `shape` is a static hyper-param: derive the draw count from the
+    # Python tuple, not a traced array (int(jnp.prod(...)) breaks jit)
+    n = 1
+    for d in tuple(shape):
+        n *= int(d)
     logits = jnp.log(jnp.clip(data, 1e-30, None))
     if data.ndim == 1:
         out = jax.random.categorical(key, logits, shape=(n,))

@@ -63,7 +63,7 @@ class Operator:
 
     def __init__(self, name: str, fn: Callable, num_outputs: Optional[int] = None,
                  differentiable: bool = True, aliases=(), eager: bool = False,
-                 input_names: Optional[Callable] = None):
+                 input_names: Optional[Callable] = None, param_specs=None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
@@ -74,23 +74,68 @@ class Operator:
         # input list depends on hyper-parameters (Custom); lets the symbol
         # layer accept keyword Symbol inputs by declared name
         self.input_names = input_names
+        self._param_specs = param_specs  # schema enrichment (range/doc)
+        self._schema = None
         self._jit_cache: Dict = {}
+        self._check_cache: Dict = {}
 
-    def bound(self, kwargs: dict) -> Callable:
-        """A jitted executable for these static kwargs (cached)."""
+    @property
+    def schema(self):
+        """dmlc::Parameter analogue: the op's reflected parameter schema
+        (ops/schema.py), derived from the fn signature + enrichment."""
+        if self._schema is None:
+            from .schema import OpSchema
+
+            self._schema = OpSchema.from_fn(self.name, self.fn,
+                                            self._param_specs)
+        return self._schema
+
+    def check_kwargs(self, kwargs: dict) -> dict:
+        """Validate + string-coerce hyper-parameters (structured
+        OpParamError instead of a TypeError deep inside a trace)."""
+        return self.checked(kwargs)[0]
+
+    def checked(self, kwargs: dict):
+        """(validated_kwargs, frozen_key) — the key is shared with
+        bound()'s jit cache so the imperative hot path freezes each
+        kwargs dict ONCE per call; None when unhashable (array kwargs),
+        meaning skip caching downstream."""
+        if not kwargs:
+            return kwargs, ()
+        try:
+            key = _freeze(kwargs)
+            hit = self._check_cache.get(key)
+            if hit is None:
+                hit = self._check_cache[key] = self.schema.validate(kwargs)
+            return hit, key
+        except TypeError:
+            # unhashable value (array kwarg) — validate without caching
+            return self.schema.validate(kwargs), None
+
+    def bound(self, kwargs: dict, _key=False) -> Callable:
+        """A jitted executable for these static kwargs (cached). `_key`
+        is an optional precomputed `_freeze(kwargs)` (from `checked`);
+        None means the kwargs are unhashable."""
         import jax
 
         if self.eager:
             # data-dependent output shape (nonzero/unique/...): run the
             # emitter directly on concrete arrays, never under jit
             return functools.partial(self.fn, **kwargs)
-        key = _freeze(kwargs)
+        if _key is False:
+            try:
+                _key = _freeze(kwargs)
+            except TypeError:
+                _key = None
+        if _key is None:
+            # unhashable kwarg (e.g. array or traced value) — run eagerly
+            return functools.partial(self.fn, **kwargs)
+        key = _key
         try:
             return self._jit_cache[key]
         except KeyError:
             pass
         except TypeError:
-            # unhashable kwarg (e.g. a traced array leaked in) — run eagerly
             return functools.partial(self.fn, **kwargs)
         fn = self.fn
         if kwargs:
@@ -108,13 +153,18 @@ class Operator:
 
 
 def register(name: str, num_outputs: Optional[int] = None, differentiable: bool = True,
-             aliases=(), eager: bool = False, input_names: Optional[Callable] = None):
-    """Decorator: register a pure JAX function as a named op."""
+             aliases=(), eager: bool = False, input_names: Optional[Callable] = None,
+             param_specs=None):
+    """Decorator: register a pure JAX function as a named op.
+
+    param_specs : optional {param: ParamSpec | dict} enriching the
+        signature-derived schema with range/choices/doc metadata."""
 
     def deco(fn: Callable) -> Operator:
         op = Operator(name, fn, num_outputs=num_outputs,
                       differentiable=differentiable, aliases=aliases,
-                      eager=eager, input_names=input_names)
+                      eager=eager, input_names=input_names,
+                      param_specs=param_specs)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op
@@ -133,6 +183,13 @@ def get(name: str) -> Operator:
 
 def list_ops():
     return sorted({op.name for op in _REGISTRY.values()})
+
+
+def op_schemas():
+    """{op_name: schema dict} for every registered op — the reflected
+    parameter-schema dump (doc generation, opperf arg synthesis; parity
+    role: MXSymbolGetAtomicSymbolInfo's arg listing)."""
+    return {name: get(name).schema.describe() for name in list_ops()}
 
 
 def apply_op(name: str, *arrays, **kwargs):

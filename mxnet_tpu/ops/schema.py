@@ -1,0 +1,234 @@
+"""Per-op parameter schemas — the dmlc::Parameter layer (SURVEY §5.6).
+
+Parity target: the reference declares every op's hyper-parameters through
+``DMLC_DECLARE_PARAMETER`` blocks (exemplar:
+`/root/reference/src/operator/control_flow.cc:35-59`), giving each op a
+reflected schema used for keyword validation, string parsing on the C
+boundary, error messages, and doc generation.
+
+TPU-native redesign: every registered op is already a pure Python
+function whose keyword arguments *are* its hyper-parameters, so the
+schema is DERIVED from the function signature (name + default + type
+inferred from the default) instead of hand-declared twice. Ops can
+enrich the derived specs (range/choices/doc) through
+``register(..., param_specs=...)``. The schema then provides:
+
+* structured validation — unknown keywords raise ``OpParamError`` naming
+  the op and listing its valid parameters (instead of a TypeError from
+  deep inside a jit trace);
+* dmlc-style string coercion — ``"2"`` -> 2, ``"(1, 2)"`` -> (1, 2),
+  ``"True"`` -> True, matching how the reference parses parameter
+  strings on the C ABI / symbol-JSON boundary;
+* range/choices checks for enriched specs;
+* ``describe()`` dumps — consumed by ``registry.op_schemas()`` and
+  opperf arg synthesis.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+from typing import Any, Dict, Optional
+
+from ..base import MXNetError
+
+__all__ = ["OpParamError", "ParamSpec", "OpSchema",
+           "OPTIONAL_ARRAY_PARAMS", "RUNTIME_PARAMS"]
+
+_REQUIRED = object()
+
+# Signature params that are ARRAY INPUTS even though they default to None
+# (optional weights/labels/keys). Canonical set shared with the symbol
+# layer's input classification (symbol/symbol.py imports these) so the
+# schema dump and graph composition never disagree about what is an
+# input vs a hyper-parameter.
+OPTIONAL_ARRAY_PARAMS = frozenset(
+    {"bias", "gamma", "beta", "moving_mean", "moving_var", "weight",
+     "state", "state_cell", "label", "data_lengths", "label_lengths",
+     "sequence_length", "lhs", "rhs", "mean", "var", "grad", "mom",
+     "condition", "index", "indices", "a", "b", "x", "y", "data", "key"})
+
+# Runtime-injected params — never graph inputs, never static attrs.
+RUNTIME_PARAMS = frozenset({"key", "training"})
+
+
+class OpParamError(MXNetError):
+    """Invalid hyper-parameter for a registered op (structured analogue
+    of dmlc::ParamError)."""
+
+    def __init__(self, op_name, param, reason, valid=None):
+        self.op_name = op_name
+        self.param = param
+        self.reason = reason
+        msg = f"op {op_name!r}, parameter {param!r}: {reason}"
+        if valid:
+            msg += f"; valid parameters: {sorted(valid)}"
+        super().__init__(msg)
+
+
+class ParamSpec:
+    """One hyper-parameter: name, inferred/declared type, default, and
+    optional doc/range/choices enrichment."""
+
+    __slots__ = ("name", "type", "default", "doc", "choices", "low", "high")
+
+    def __init__(self, name, type=None, default=_REQUIRED, doc="",
+                 choices=None, low=None, high=None):
+        self.name = name
+        self.type = type
+        self.default = default
+        self.doc = doc
+        self.choices = tuple(choices) if choices is not None else None
+        self.low = low
+        self.high = high
+
+    @property
+    def required(self):
+        return self.default is _REQUIRED
+
+    def describe(self) -> Dict[str, Any]:
+        out = {"name": self.name,
+               "type": self.type.__name__ if self.type else "any"}
+        if not self.required:
+            out["default"] = self.default
+        else:
+            out["required"] = True
+        if self.doc:
+            out["doc"] = self.doc
+        if self.choices is not None:
+            out["choices"] = list(self.choices)
+        if self.low is not None:
+            out["low"] = self.low
+        if self.high is not None:
+            out["high"] = self.high
+        return out
+
+    # ------------------------------------------------------- validation ---
+    def coerce(self, op_name, value):
+        """dmlc-style scalar parsing + type/range/choices checks."""
+        t = self.type
+        was_string = isinstance(value, str) and t not in (None, str)
+        if was_string:
+            try:
+                value = ast.literal_eval(value)
+            except (ValueError, SyntaxError):
+                raise OpParamError(
+                    op_name, self.name,
+                    f"cannot parse {value!r} as {t.__name__}") from None
+        if t is bool and isinstance(value, int) and not isinstance(value, bool):
+            value = bool(value)
+        elif t is float and isinstance(value, int) and not isinstance(value, bool):
+            value = float(value)
+        elif t is int and isinstance(value, float) and value.is_integer():
+            value = int(value)
+        elif t in (tuple, list) and isinstance(value, (tuple, list)):
+            value = t(value)
+        # Type enforcement, dmlc-style but Python-polymorphism-aware:
+        # a string that parsed to the wrong type, or a bare scalar where
+        # a shape tuple is declared, raises HERE with op/param context
+        # instead of a TypeError deep inside the jit trace. Other
+        # mismatches pass — many params are deliberately polymorphic
+        # (dtype accepts str or np.dtype; tensordot axes int or tuple).
+        if t not in (None, object) and value is not None:
+            wrong = not isinstance(value, t) and \
+                not (t is float and isinstance(value, int))
+            scalar_for_shape = t in (tuple, list) and \
+                isinstance(value, (int, float, bool))
+            if (was_string and wrong) or scalar_for_shape:
+                raise OpParamError(
+                    op_name, self.name,
+                    f"expected {t.__name__}, got {type(value).__name__} "
+                    f"({value!r})")
+        if self.choices is not None and value not in self.choices:
+            raise OpParamError(
+                op_name, self.name,
+                f"got {value!r}, expected one of {list(self.choices)}")
+        if self.low is not None and isinstance(value, (int, float)) \
+                and value < self.low:
+            raise OpParamError(
+                op_name, self.name, f"{value!r} is below minimum {self.low}")
+        if self.high is not None and isinstance(value, (int, float)) \
+                and value > self.high:
+            raise OpParamError(
+                op_name, self.name, f"{value!r} is above maximum {self.high}")
+        return value
+
+
+class OpSchema:
+    """Array inputs + hyper-parameter specs of one op, derived from its
+    function signature."""
+
+    __slots__ = ("op_name", "inputs", "variadic", "params", "open_kwargs")
+
+    def __init__(self, op_name, inputs, variadic, params, open_kwargs):
+        self.op_name = op_name
+        self.inputs = inputs          # positional array-input names
+        self.variadic = variadic      # fn takes *arrays
+        self.params = params          # {name: ParamSpec}
+        self.open_kwargs = open_kwargs  # fn has **kw: accept any name
+
+    @classmethod
+    def from_fn(cls, op_name, fn, overrides: Optional[dict] = None):
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return cls(op_name, [], True, {}, True)
+        inputs, params = [], {}
+        variadic = open_kwargs = False
+        for p in sig.parameters.values():
+            if p.kind is inspect.Parameter.VAR_POSITIONAL:
+                variadic = True
+            elif p.kind is inspect.Parameter.VAR_KEYWORD:
+                open_kwargs = True
+            elif p.default is inspect.Parameter.empty:
+                if p.kind is inspect.Parameter.KEYWORD_ONLY:
+                    params[p.name] = ParamSpec(p.name)
+                else:
+                    inputs.append(p.name)
+            elif p.default is None and p.name in OPTIONAL_ARRAY_PARAMS:
+                # optional array input (bias/gamma/key/...), not a hyper
+                inputs.append(p.name)
+            else:
+                d = p.default
+                t = None if d is None else type(d)
+                params[p.name] = ParamSpec(p.name, type=t, default=d)
+        for name, extra in (overrides or {}).items():
+            if name not in params and not open_kwargs:
+                # a typo'd enrichment key would otherwise silently mint a
+                # new accepted parameter AND leave the real one unchecked
+                raise ValueError(
+                    f"op {op_name!r}: param_specs entry {name!r} does not "
+                    f"match any signature parameter {sorted(params)}")
+            base = params.get(name) or ParamSpec(name)
+            if isinstance(extra, ParamSpec):
+                params[name] = extra
+            else:
+                for k, v in dict(extra).items():
+                    setattr(base, k, v)
+                params[name] = base
+        return cls(op_name, inputs, variadic, params, open_kwargs)
+
+    def validate(self, kwargs: dict) -> dict:
+        """Check names, parse strings, apply range/choices. Returns the
+        coerced kwargs (input dict is not mutated)."""
+        if not kwargs:
+            return kwargs
+        out = {}
+        for k, v in kwargs.items():
+            spec = self.params.get(k)
+            if spec is None:
+                if self.open_kwargs or k in self.inputs:
+                    out[k] = v
+                    continue
+                raise OpParamError(
+                    self.op_name, k, "unknown parameter",
+                    valid=self.params.keys())
+            out[k] = spec.coerce(self.op_name, v)
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "op": self.op_name,
+            "inputs": list(self.inputs) + (["*arrays"] if self.variadic
+                                           else []),
+            "params": [s.describe() for s in self.params.values()],
+        }

@@ -198,10 +198,11 @@ def _where(condition, x, y):
     return jnp.where(condition.astype(bool), x, y)
 
 
-@register("boolean_mask", differentiable=False)
+@register("boolean_mask", differentiable=False, eager=True)
 def _boolean_mask(data, index, axis=0):
-    # dynamic-shape op: only usable outside jit traces (parity:
-    # test_dynamic_shape.py); inside traces use `where`.
+    # dynamic-shape op: output size depends on the mask VALUES, so it
+    # must run eagerly, never under jit (parity: test_dynamic_shape.py);
+    # inside traces use `where`.
     return jnp.compress(_np.asarray(index).astype(bool), data, axis=axis)
 
 

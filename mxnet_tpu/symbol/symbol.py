@@ -70,15 +70,9 @@ _LAYER_PARAMS = {
     "MAERegressionOutput": [("label", False, None)],
 }
 
-# signature params that are array inputs even though they default to None
-_OPTIONAL_ARRAY_PARAMS = frozenset(
-    {"bias", "gamma", "beta", "moving_mean", "moving_var", "weight",
-     "state", "state_cell", "label", "data_lengths", "label_lengths",
-     "sequence_length", "lhs", "rhs", "mean", "var", "grad", "mom",
-     "condition", "index", "indices", "a", "b", "x", "y", "data"})
-
-# runtime-injected params — never graph inputs, never static attrs
-_RUNTIME_PARAMS = frozenset({"key", "training"})
+# canonical classification sets live with the op schema layer so graph
+# composition and schema dumps cannot drift apart
+from ..ops.schema import RUNTIME_PARAMS as _RUNTIME_PARAMS  # noqa: E402
 
 
 def _op_kwargs(node):
@@ -802,6 +796,10 @@ def _apply_op(op_name, args, kwargs):
     sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
     static = {k: v for k, v in kwargs.items()
               if not isinstance(v, Symbol) and k not in _RUNTIME_PARAMS}
+    # graph-build-time parameter validation + dmlc-style string coercion
+    # (symbol JSON attrs arrive as strings) — errors surface at compose
+    # time, like dmlc::Parameter::Init in the reference
+    static = op.check_kwargs(static)
 
     if name is None:
         from .. import name as _name_mod
@@ -932,7 +930,14 @@ def load_json(json_str):
             node = _Node(None, rn["name"], attrs)
         else:
             op = _registry.get(op_name)
-            node = _Node(op.name, rn["name"], attrs)
+            # JSON attrs are the string-valued dmlc params: validate and
+            # coerce HERE so a bad attr raises a structured OpParamError
+            # at load time, not a TypeError at bind/execution
+            from ..attribute import is_dunder
+
+            clean = op.check_kwargs(
+                {k: v for k, v in attrs.items() if not is_dunder(k)})
+            node = _Node(op.name, rn["name"], {**attrs, **clean})
         built.append(node)
     for rn, node in zip(raw_nodes, built):
         node.inputs = [(built[i], oi) for i, oi, *_ in rn["inputs"]]

@@ -50,6 +50,7 @@ _SLOW_FILES = {
     "test_gluon_rnn.py",     # scan compiles + LM training
     "test_sparse_dist.py",   # 2-process distributed suites
     "test_onnx.py",          # export/import numeric roundtrips
+    "test_op_sweep.py",      # 800-test registry-wide sweep (~2 min)
 }
 _SLOW_TESTS = {
     "test_graft_entry_dryrun",

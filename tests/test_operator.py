@@ -453,3 +453,78 @@ def test_npi_tail_and_image_ops():
     np.testing.assert_allclose(
         mx.nd._linalg_det(mx.nd.array(a)).asnumpy(),
         np.linalg.det(a), rtol=1e-4)
+
+
+# ------------------------------------------------------ parameter schema ---
+# SURVEY §5.6: dmlc::Parameter equivalent (exemplar declaration:
+# reference src/operator/control_flow.cc:35-59) — reflected per-op param
+# schemas with validation, string coercion, and schema dumps.
+
+def test_schema_unknown_param_structured_error():
+    from mxnet_tpu.ops.schema import OpParamError
+
+    x = mx.nd.ones((2, 3))
+    with pytest.raises(OpParamError, match="'softmax'.*'axsi'.*axis"):
+        mx.nd.invoke("softmax", x, axsi=1)
+    # symbolic path: error at COMPOSE time, before any execution
+    data = mx.sym.Variable("data")
+    with pytest.raises(OpParamError, match="unknown parameter"):
+        mx.sym.invoke("softmax", data, axsi=1)
+
+
+def test_schema_string_coercion():
+    """dmlc-style parsing: symbol-JSON/C-ABI string params become typed."""
+    x = mx.nd.random.uniform(shape=(1, 3, 8, 8))
+    w = mx.nd.random.uniform(shape=(4, 3, 3, 3))
+    out = mx.nd.invoke("Convolution", x, w, kernel="(3, 3)",
+                       num_filter="4", no_bias="True")
+    assert out.shape == (1, 4, 6, 6)
+
+
+def test_schema_choices_and_range():
+    from mxnet_tpu.ops.schema import OpParamError
+
+    x = mx.nd.ones((2, 3))
+    with pytest.raises(OpParamError, match="expected one of"):
+        mx.nd.invoke("Activation", x, act_type="gelu_bogus")
+    with pytest.raises(OpParamError, match="above maximum"):
+        mx.nd.invoke("Dropout", x, p=1.5)
+
+
+def test_schema_dump():
+    from mxnet_tpu.ops import registry
+
+    schemas = registry.op_schemas()
+    assert len(schemas) == len(registry.list_ops())
+    conv = schemas["Convolution"]
+    assert "data" in conv["inputs"]
+    names = {p["name"]: p for p in conv["params"]}
+    assert names["num_filter"]["default"] == 1
+    act = {p["name"]: p for p in schemas["Activation"]["params"]}
+    assert "relu" in act["act_type"]["choices"]
+
+
+def test_schema_type_enforcement_and_override_check():
+    from mxnet_tpu.ops.schema import OpParamError, OpSchema
+
+    x = mx.nd.random.uniform(shape=(1, 3, 8, 8))
+    w = mx.nd.random.uniform(shape=(4, 3, 3, 3))
+    with pytest.raises(OpParamError, match="expected tuple"):
+        mx.nd.invoke("Convolution", x, w, kernel=3, num_filter=4)
+    with pytest.raises(OpParamError, match="expected int"):
+        mx.nd.invoke("Convolution", x, w, kernel=(3, 3), num_filter="(4,)")
+    # typo'd enrichment keys must fail loudly, not mint new params
+    with pytest.raises(ValueError, match="does not match"):
+        OpSchema.from_fn("Pooling",
+                         lambda data, pool_type="max": data,
+                         {"pool_typ": {"choices": ("max",)}})
+
+
+def test_schema_optional_arrays_are_inputs():
+    from mxnet_tpu.ops import registry
+
+    conv = registry.get("Convolution").schema.describe()
+    assert "bias" in conv["inputs"]
+    assert "bias" not in [p["name"] for p in conv["params"]]
+    drop = registry.get("Dropout").schema.describe()
+    assert "key" in drop["inputs"]
