@@ -42,6 +42,66 @@ def _synthetic(num, shape, num_classes, sample_seed, center_seed):
     return x, y
 
 
+class SyntheticDataIter(mx.io.DataIter):
+    """Benchmark feeder (parity: the reference fit.py --benchmark mode's
+    SyntheticDataIter): ONE device-resident random batch yielded
+    `epoch_size` times, so the measured img/s is pure train-step
+    throughput with no host input pipeline in the loop."""
+
+    def __init__(self, num_classes, data_shape, epoch_size, dtype="float32"):
+        super().__init__(batch_size=data_shape[0])
+        self.batch_size = data_shape[0]
+        self.epoch_size = epoch_size
+        rs = np.random.RandomState(0)
+        x = rs.uniform(-1, 1, data_shape).astype(np.float32)
+        y = rs.randint(0, num_classes, data_shape[0]).astype(np.float32)
+        self._data = mx.nd.array(x).astype(dtype)
+        self._label = mx.nd.array(y)
+        self._cur = 0
+        self.provide_data = [mx.io.DataDesc("data", data_shape, dtype)]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (data_shape[0],), "float32")]
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= self.epoch_size:
+            raise StopIteration
+        self._cur += 1
+        return mx.io.DataBatch(data=[self._data], label=[self._label],
+                               pad=0, provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+
+def get_rec_iter(args, kv):
+    """ImageRecordIter over --data-train/--data-val .rec files, or the
+    synthetic fallback at the same shapes (parity: data.py
+    get_rec_iter)."""
+    shape = tuple(int(d) for d in args.image_shape.split(","))
+    train_rec = getattr(args, "data_train", None)
+    if train_rec and os.path.exists(train_rec):
+        train = mx.io.ImageRecordIter(
+            path_imgrec=train_rec, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True,
+            rand_crop=True, rand_mirror=True)
+        val_rec = getattr(args, "data_val", None)
+        val = mx.io.ImageRecordIter(
+            path_imgrec=val_rec, data_shape=shape,
+            batch_size=args.batch_size) if val_rec and \
+            os.path.exists(val_rec) else None
+        return train, val
+    x, y = _synthetic(args.num_examples, shape, args.num_classes, 11,
+                      center_seed=3)
+    xv, yv = _synthetic(args.num_val_examples, shape, args.num_classes,
+                        12, center_seed=3)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size,
+                            label_name="softmax_label")
+    return train, val
+
+
 def get_mnist_iter(args, kv):
     """28x28x1, 10 classes (parity: data.py get_mnist_iter)."""
     shape = (1, 28, 28)

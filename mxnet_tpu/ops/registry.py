@@ -99,7 +99,9 @@ class Operator:
         """(validated_kwargs, frozen_key) — the key is shared with
         bound()'s jit cache so the imperative hot path freezes each
         kwargs dict ONCE per call; None when unhashable (array kwargs),
-        meaning skip caching downstream."""
+        meaning skip caching downstream. The returned dict is CACHED and
+        shared across calls: callers must treat it as immutable (copy
+        before storing anywhere that mutates, e.g. node attrs)."""
         if not kwargs:
             return kwargs, ()
         try:

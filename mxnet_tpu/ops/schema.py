@@ -37,10 +37,10 @@ __all__ = ["OpParamError", "ParamSpec", "OpSchema",
 _REQUIRED = object()
 
 # Signature params that are ARRAY INPUTS even though they default to None
-# (optional weights/labels/keys). Canonical set shared with the symbol
-# layer's input classification (symbol/symbol.py imports these) so the
-# schema dump and graph composition never disagree about what is an
-# input vs a hyper-parameter.
+# (optional weights/labels/keys) — used by OpSchema.from_fn to keep them
+# out of the hyper-parameter dump. The symbol layer classifies inputs
+# from Symbol-ness at compose time and consumes RUNTIME_PARAMS below;
+# keep this set in sync with its expectations when adding ops.
 OPTIONAL_ARRAY_PARAMS = frozenset(
     {"bias", "gamma", "beta", "moving_mean", "moving_var", "weight",
      "state", "state_cell", "label", "data_lengths", "label_lengths",

@@ -798,8 +798,10 @@ def _apply_op(op_name, args, kwargs):
               if not isinstance(v, Symbol) and k not in _RUNTIME_PARAMS}
     # graph-build-time parameter validation + dmlc-style string coercion
     # (symbol JSON attrs arrive as strings) — errors surface at compose
-    # time, like dmlc::Parameter::Init in the reference
-    static = op.check_kwargs(static)
+    # time, like dmlc::Parameter::Init in the reference. COPY the result:
+    # check_kwargs returns the op's cached validated dict, and node.attrs
+    # is mutated later (_set_attr) — sharing would poison the cache
+    static = dict(op.check_kwargs(static))
 
     if name is None:
         from .. import name as _name_mod

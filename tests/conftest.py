@@ -75,6 +75,9 @@ _SLOW_TESTS = {
     "test_amp_training_converges",
     "test_predict_abi_end_to_end",
     "test_sharded_trainer_matches_eager_optimizer",
+    "test_factorization_machine_example",
+    "test_transformer_finetune_example",
+    "test_train_imagenet_benchmark_mode",
     "test_sharded_trainer_multi_precision_master_weights",
 }
 

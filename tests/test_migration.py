@@ -286,3 +286,55 @@ def test_bucketing_module_over_context_group():
     mod.forward(batch8)
     assert mod._curr_module._exec._mesh is not None
     assert mod.get_outputs()[0].shape == (8, 8)
+
+
+def _example_module(relpath, name):
+    import importlib.util
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "examples", relpath)
+    for d in (os.path.dirname(path), root):
+        if d not in sys.path:
+            sys.path.insert(0, d)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_factorization_machine_example():
+    """BASELINE config: sparse FM on the real row_sparse kvstore path
+    (reference example/sparse/factorization_machine) learns a planted
+    FM dataset CPU-small."""
+    fm = _example_module("sparse/factorization_machine.py",
+                         "fm_example")
+    acc = fm.main(["--num-epoch", "12", "--input-size", "300",
+                   "--num-examples", "960", "--factor-size", "4",
+                   "--nnz", "8"])
+    assert acc > 0.7, acc
+
+
+def test_transformer_finetune_example(tmp_path):
+    """BASELINE config: BERT-class pretrain->fine-tune over flash
+    attention + ShardedTrainer (stands in for the GluonNLP config)."""
+    tf = _example_module("gluon/transformer_finetune.py",
+                         "transformer_finetune_example")
+    acc = tf.main(["--num-examples", "256", "--pretrain-steps", "10",
+                   "--finetune-epochs", "4", "--layers", "1",
+                   "--seq-len", "12",
+                   "--checkpoint", str(tmp_path / "backbone.params")])
+    assert acc > 0.8, acc
+
+
+def test_train_imagenet_benchmark_mode():
+    """The flagship fit driver's --benchmark synthetic mode produces a
+    throughput run end-to-end (reference fit.py:150-321)."""
+    ti = _example_module("image_classification/train_imagenet.py",
+                         "train_imagenet_example")
+    model = ti.main(["--benchmark", "1", "--network", "resnet18_v1",
+                     "--batch-size", "8", "--image-shape", "3,32,32",
+                     "--num-classes", "10", "--num-examples", "32",
+                     "--ctx", "cpu", "--disp-batches", "2"])
+    assert model is not None

@@ -114,7 +114,7 @@ _NPI_UNARY = {
     "_npi_isinf": np.isinf, "_npi_isfinite": np.isfinite,
     "_npi_isposinf": np.isposinf, "_npi_isneginf": np.isneginf,
     "_npi_logical_not": np.logical_not, "_npi_conj": np.conj,
-    "_npi_real": np.real, "_npi_imag": np.imag, "_npi_negative": np.negative,
+    "_npi_real": np.real, "_npi_imag": np.imag,
     "_np_copy": np.array,
 }
 for _n, _fn in _NPI_UNARY.items():
@@ -596,8 +596,6 @@ case("_npx_nonzero", lambda: [np.array([[1, 0], [0, 2]], np.float32)],
      check=lambda outs, ins, kw: outs[0].shape[0] == 2)
 case("_contrib_getnnz", lambda: [np.array([[1, 0], [0, 2]], np.float32)],
      oracle=lambda data: np.array(2, np.int32), atol=0)
-case("_npi_count_nonzero_", lambda: [F(1)], check=None)
-del CASES["_npi_count_nonzero_"]
 case("_sparse_retain", lambda: [F(4, 3), np.array([0, 2], np.float32)],
      check=lambda outs, ins, kw: np.allclose(outs[0][1], 0))
 case("cast_storage", lambda: [F(2, 3)], kwargs={"stype": "default"},
@@ -649,8 +647,6 @@ case("_npi_indices", lambda: [], kwargs={"dimensions": (2, 3)},
 case("_npi_tril_indices", lambda: [], kwargs={"n": 3},
      oracle=lambda n: np.stack(np.tril_indices(n)).astype(np.int32),
      atol=0)
-case("_npi_identity", lambda: [F(1)], check=None)
-del CASES["_npi_identity"]
 case("_contrib_arange_like", lambda: [F(2, 3)],
      oracle=lambda data: np.arange(6, dtype=np.float32))
 case("_contrib_index_array", lambda: [F(2, 3)],
@@ -1411,9 +1407,14 @@ case("_contrib_quantized_conv",
      kwargs={"kernel": (3, 3), "num_filter": 3, "no_bias": True,
              "min_calib_range": -1.0, "max_calib_range": 1.0},
      check=lambda outs, ins, kw: outs[0].shape[:2] == (1, 3))
-case("_contrib_quantized_fully_connected_",
-     lambda: [F(1)], check=None)
-del CASES["_contrib_quantized_fully_connected_"]
+case("_contrib_quantized_fully_connected",
+     lambda: [I(2, 4, high=100).astype(np.int8),
+              I(3, 4, high=100).astype(np.int8),
+              np.array([0.01], np.float32)],
+     kwargs={"num_hidden": 3, "no_bias": True,
+             "min_calib_range": -1.0, "max_calib_range": 1.0},
+     check=lambda outs, ins, kw: outs[0].shape[:2] == (2, 3) or
+     outs[0].shape == (2, 3))
 
 # ------------------------------------------------------------ harness -----
 

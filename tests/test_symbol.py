@@ -337,3 +337,15 @@ def test_attr_and_name_scope_edge_cases():
     with mxname.Prefix("pp_"):
         d = gluon.nn.Dense(3)
     assert d.prefix.startswith("pp_dense")
+
+
+def test_set_attr_does_not_poison_validation_cache():
+    """node-attr mutation after compose must not leak into the op's
+    cached validated kwargs (checked() hands out a shared dict)."""
+    import mxnet_tpu as mx
+
+    s = mx.sym.Activation(mx.sym.Variable("data"), act_type="relu")
+    s._set_attr(force_mirroring="True")
+    # same static kwargs through the imperative path: must still work
+    out = mx.nd.Activation(mx.nd.ones((2, 2)), act_type="relu")
+    assert out.shape == (2, 2)
