@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""ImageRecordIter end-to-end throughput benchmark.
+
+Measures the host input pipeline's sustained img/s (RecordIO read ->
+native OMP JPEG decode+resize -> augment -> normalize -> batch), the
+number that must exceed the chip's training consumption rate for
+ResNet-50 (reference bar: iter_image_recordio_2.cc's OMP ParseChunk).
+
+Prints ONE JSON line: {"metric": "image_record_iter", "value": img/s,
+"unit": "img/s", ...}.
+
+    python benchmark/iter_bench.py --num-images 512 --batch-size 128
+"""
+import argparse
+import io as _io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_rec(path, num_images, src_hw):
+    from PIL import Image
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rs = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(num_images):
+        arr = rs.randint(0, 255, (src_hw, src_hw, 3), np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, "JPEG", quality=90)
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.getvalue()))
+    rec.close()
+    return path + ".rec"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-images", type=int, default=512)
+    p.add_argument("--src-size", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--data-shape", type=str, default="3,224,224")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--preprocess-threads", type=int,
+                   default=os.cpu_count() or 4)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import native
+
+    shape = tuple(int(d) for d in args.data_shape.split(","))
+    with tempfile.TemporaryDirectory() as d:
+        rec = build_rec(os.path.join(d, "bench"), args.num_images,
+                        args.src_size)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True,
+            rand_crop=True, rand_mirror=True,
+            preprocess_threads=args.preprocess_threads)
+        # warm epoch (native lib build, file cache)
+        for batch in it:
+            batch.data[0].wait_to_read()
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(args.epochs):
+            it.reset()
+            for batch in it:
+                batch.data[0].wait_to_read()
+                n += batch.data[0].shape[0]
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "image_record_iter",
+            "value": round(n / dt, 1),
+            "unit": "img/s",
+            "native_decode": native.available(),
+            "threads": args.preprocess_threads,
+            "data_shape": list(shape),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -535,7 +535,9 @@ class ImageRecordIter(DataIter):
                  batch_size=128, shuffle=False, label_width=1,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
-                 round_batch=True, seed=0, **kwargs):
+                 round_batch=True, seed=0, rand_crop=False,
+                 rand_mirror=False, preprocess_threads=4,
+                 prefetch_buffer=2, **kwargs):
         from .. import recordio as _recordio
 
         super().__init__(batch_size)
@@ -553,7 +555,14 @@ class ImageRecordIter(DataIter):
         self._std = _np.asarray([std_r, std_g, std_b], _np.float32)
         self._scale = scale
         self._round_batch = round_batch
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._threads = max(int(preprocess_threads), 1)
+        self._prefetch = max(int(prefetch_buffer), 0)
         self._cursor = 0
+        self._queue = None
+        self._producer = None
+        self._executor = None
         self.provide_data = [DataDesc("data",
                                       (batch_size,) + self._data_shape)]
         lshape = (batch_size,) if label_width == 1 \
@@ -562,44 +571,83 @@ class ImageRecordIter(DataIter):
         self.reset()
 
     def reset(self):
+        self._stop_producer()
         self._cursor = 0
         if self._shuffle:
             self._rng.shuffle(self._order)
 
-    def _decode(self, key):
-        from .. import recordio as _recordio
-
-        header, img = _recordio.unpack_img(self._rec.read_idx(key))
-        arr = img.asnumpy() if hasattr(img, "asnumpy") else _np.asarray(img)
+    # -------------------------------------------------- decode pipeline ---
+    def _decode_size(self):
+        """Decode target; with rand_crop the decode is oversized so the
+        crop has room (reference: rand_crop samples a region of the
+        source image)."""
         c, h, w = self._data_shape
-        if arr.shape[0] != h or arr.shape[1] != w:
-            from .. import image as image_mod
-            from ..ndarray import array as _array
+        if self._rand_crop:
+            return h + max(8, h // 8), w + max(8, w // 8)
+        return h, w
 
-            arr = image_mod.imresize(_array(arr), w, h).asnumpy()
-        label = header.label
-        label = _np.asarray(label, _np.float32).reshape(-1)
-        return arr.astype(_np.uint8), label[:self._label_width]
+    def _decode_batch_py(self, bufs, dh, dw):
+        """Threaded PIL fallback (libjpeg releases the GIL, so threads
+        give real decode parallelism like the reference's OMP loop).
+        The executor is cached on the iterator — per-batch pool churn
+        would dominate the steady state this path serves."""
+        import io as _io
 
-    def next(self):
+        from PIL import Image
+
+        def one(buf):
+            img = Image.open(_io.BytesIO(buf)).convert("RGB")
+            if img.size != (dw, dh):
+                img = img.resize((dw, dh), Image.BILINEAR)
+            return _np.asarray(img, _np.uint8)
+
+        if self._threads > 1 and len(bufs) > 1:
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._executor = ThreadPoolExecutor(self._threads)
+            return _np.stack(list(self._executor.map(one, bufs)))
+        return _np.stack([one(b) for b in bufs])
+
+    def _produce(self, keys):
+        """keys -> one assembled DataBatch (decode, augment, normalize)."""
         from .. import native
+        from .. import recordio as _recordio
         from ..ndarray import array as _array
 
-        if self._cursor >= len(self._order):
-            raise StopIteration
-        end = self._cursor + self.batch_size
-        if end > len(self._order) and not self._round_batch:
-            raise StopIteration
-        keys = self._order[self._cursor:end]
-        if len(keys) < self.batch_size:  # wrap (round_batch)
-            keys = keys + self._order[:self.batch_size - len(keys)]
-        self._cursor += self.batch_size
-        imgs, labels = [], []
+        bufs, labels = [], []
         for k in keys:
-            a, l = self._decode(k)
-            imgs.append(a)
-            labels.append(l)
-        batch_u8 = _np.stack(imgs)  # (N, H, W, C)
+            header, img_bytes = _recordio.unpack(self._rec.read_idx(k))
+            bufs.append(img_bytes)
+            label = _np.asarray(header.label, _np.float32).reshape(-1)
+            labels.append(label[:self._label_width])
+        c, h, w = self._data_shape
+        dh, dw = self._decode_size()
+        decoded = native.decode_jpeg_batch(bufs, dh, dw,
+                                           n_threads=self._threads)
+        if decoded is None or len(decoded[1]) == len(bufs):
+            # no native lib, or payloads are not JPEG at all: PIL path
+            batch_u8 = self._decode_batch_py(bufs, dh, dw)
+        else:
+            batch_u8, bad = decoded
+            if bad:
+                # keep the native layer's graceful zero-fill for the few
+                # corrupt records (reference logs and continues too)
+                import warnings
+
+                warnings.warn(
+                    f"ImageRecordIter: {len(bad)} corrupt image(s) in "
+                    "batch zero-filled", stacklevel=2)
+        if self._rand_crop:
+            n = batch_u8.shape[0]
+            ys = self._rng.randint(0, dh - h + 1, n)
+            xs = self._rng.randint(0, dw - w + 1, n)
+            batch_u8 = _np.stack([batch_u8[i, ys[i]:ys[i] + h,
+                                           xs[i]:xs[i] + w]
+                                  for i in range(n)])
+        if self._rand_mirror:
+            flip = self._rng.rand(batch_u8.shape[0]) < 0.5
+            batch_u8[flip] = batch_u8[flip, :, ::-1]
         chw = native.normalize_batch(batch_u8, self._mean, self._std,
                                      scale=self._scale)
         label_arr = _np.stack(labels)
@@ -607,3 +655,99 @@ class ImageRecordIter(DataIter):
             label_arr = label_arr.reshape(-1)
         return DataBatch(data=[_array(chw)], label=[_array(label_arr)],
                          pad=0, index=None)
+
+    def _next_keys(self):
+        if self._cursor >= len(self._order):
+            return None
+        end = self._cursor + self.batch_size
+        if end > len(self._order) and not self._round_batch:
+            return None
+        keys = self._order[self._cursor:end]
+        if len(keys) < self.batch_size:  # wrap (round_batch)
+            keys = keys + self._order[:self.batch_size - len(keys)]
+        self._cursor += self.batch_size
+        return keys
+
+    # ------------------------------------------------------- prefetch ----
+    def _stop_producer(self):
+        if self._producer is not None:
+            self._drain = True
+            while self._producer.is_alive():
+                try:  # unblock a producer waiting on a full queue
+                    self._queue.get_nowait()
+                except Exception:
+                    pass
+                self._producer.join(timeout=0.05)
+            self._producer = None
+            self._queue = None
+
+    def close(self):
+        """Stop the prefetch producer and release the decode pool; a
+        dropped iterator would otherwise pin its thread, queued batches
+        and the open record file until process exit."""
+        self._stop_producer()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _start_producer(self):
+        import queue
+        import weakref
+
+        self._drain = False
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        key_lists = []
+        while True:
+            keys = self._next_keys()
+            if keys is None:
+                break
+            key_lists.append(keys)
+
+        # the producer must NOT hold a strong ref to the iterator while
+        # blocked on a full queue — that would make a dropped iterator
+        # uncollectable (thread is a GC root) and leak the thread, the
+        # queued batches and the record file for the process lifetime
+        wself = weakref.ref(self)
+        q = self._queue
+
+        def run():
+            for keys in key_lists:
+                it = wself()
+                if it is None or it._drain:
+                    return
+                try:
+                    item = it._produce(keys)
+                except BaseException as e:  # surface at next(), not hang
+                    q.put(e)
+                    return
+                del it  # release before blocking: __del__ can then run
+                q.put(item)
+            q.put(None)  # end-of-epoch sentinel
+
+        self._producer = threading.Thread(target=run, daemon=True)
+        self._producer.start()
+
+    def next(self):
+        if self._prefetch:
+            # overlap host decode of the NEXT batches with device compute
+            # (parity: iter_prefetcher.h wrapped around the parser)
+            if self._producer is None:
+                self._start_producer()
+            item = self._queue.get()
+            if item is None:
+                self._producer = None
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self._producer = None
+                raise item
+            return item
+        keys = self._next_keys()
+        if keys is None:
+            raise StopIteration
+        return self._produce(keys)

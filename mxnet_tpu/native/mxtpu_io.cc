@@ -123,3 +123,131 @@ long long mxtpu_recordio_pack(const uint8_t* payloads,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------------------
+// JPEG batch decode + bilinear resize (parity: the OMP ParseChunk decode
+// loop of iter_image_recordio_2.cc:79,146 — the input-pipeline hot path
+// that must outrun the chip's training consumption rate). Uses the
+// system libjpeg(-turbo); one OMP thread per image.
+
+#ifndef MXTPU_NO_JPEG
+#include <csetjmp>
+#include <jpeglib.h>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct JerrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void jerr_exit(j_common_ptr cinfo) {
+  JerrMgr* e = reinterpret_cast<JerrMgr*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+// decode one JPEG to RGB u8 then bilinear-resize into out (oh*ow*3)
+bool decode_resize_one(const uint8_t* buf, uint64_t len, int oh, int ow,
+                       uint8_t* out) {
+  jpeg_decompress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jerr_exit;
+  // volatile: modified between setjmp and longjmp — without it the
+  // error path would free() an indeterminate register copy (C11
+  // 7.13.2.1) under -O3
+  uint8_t* volatile pixels = nullptr;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    free(pixels);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int h = cinfo.output_height, w = cinfo.output_width;
+  const int stride = w * 3;
+  pixels = static_cast<uint8_t*>(malloc(static_cast<size_t>(h) * stride));
+  if (!pixels) { jpeg_destroy_decompress(&cinfo); return false; }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = pixels + static_cast<size_t>(cinfo.output_scanline) *
+                   stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  // bilinear resize (h, w) -> (oh, ow)
+  const float sy = oh > 1 ? float(h - 1) / float(oh - 1) : 0.f;
+  const float sx = ow > 1 ? float(w - 1) / float(ow - 1) : 0.f;
+  for (int y = 0; y < oh; ++y) {
+    const float fy = y * sy;
+    const int y0 = int(fy), y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    const float wy = fy - y0;
+    for (int x = 0; x < ow; ++x) {
+      const float fx = x * sx;
+      const int x0 = int(fx), x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      const float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        const float p00 = pixels[(size_t(y0) * w + x0) * 3 + c];
+        const float p01 = pixels[(size_t(y0) * w + x1) * 3 + c];
+        const float p10 = pixels[(size_t(y1) * w + x0) * 3 + c];
+        const float p11 = pixels[(size_t(y1) * w + x1) * 3 + c];
+        const float v = p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+                        p10 * wy * (1 - wx) + p11 * wy * wx;
+        out[(size_t(y) * ow + x) * 3 + c] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+  free(pixels);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode `n` JPEGs (payloads at blob+offsets[i], lengths[i]) into an
+// (n, oh, ow, 3) u8 HWC buffer, OMP-parallel over images (`n_threads`
+// bounds the team; <=0 means the OMP default). Returns the number
+// successfully decoded; failed slots are zero-filled and their index
+// recorded in `failed` (capacity n, -1 terminated).
+long long mxtpu_decode_jpeg_batch(const uint8_t* blob,
+                                  const uint64_t* offsets,
+                                  const uint64_t* lengths, long long n,
+                                  int oh, int ow, uint8_t* out,
+                                  long long* failed, int n_threads) {
+  long long ok = 0;
+  long long nfail = 0;
+#ifdef _OPENMP
+  if (n_threads > 0) omp_set_num_threads(n_threads);
+#pragma omp parallel for schedule(dynamic) reduction(+:ok)
+#endif
+  for (long long i = 0; i < n; ++i) {
+    uint8_t* dst = out + static_cast<size_t>(i) * oh * ow * 3;
+    if (decode_resize_one(blob + offsets[i], lengths[i], oh, ow, dst)) {
+      ++ok;
+    } else {
+      memset(dst, 0, static_cast<size_t>(oh) * ow * 3);
+#ifdef _OPENMP
+#pragma omp critical
+#endif
+      { failed[nfail++] = i; }
+    }
+  }
+  if (nfail < n) failed[nfail] = -1;
+  return ok;
+}
+
+}  // extern "C"
+#endif  // MXTPU_NO_JPEG
