@@ -76,6 +76,37 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        const char **param_keys, const char **param_vals);
 int MXHandleArrayFree(NDArrayHandle *handles);
 
+/* ndarray container IO (parity: MXNDArraySave/Load) ------------------- */
+/* keys may be NULL (positional save). Load returns a NULL-terminated
+ * malloc'd handle array (free with MXHandleArrayFree after freeing each
+ * handle); names point at thread-local storage valid until the next
+ * load on this thread. */
+int MXNDArraySave(const char *fname, int num_args, NDArrayHandle *handles,
+                  const char **keys);
+int MXNDArrayLoad(const char *fname, int *out_size,
+                  NDArrayHandle **out_handles, int *out_name_size,
+                  const char ***out_names);
+int MXRandomSeed(int seed);
+
+/* symbol (graph) API (parity: MXSymbolCreateFromJSON & co.) ----------- */
+typedef void *SymbolHandle;
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+/* out_json points at thread-local storage valid until the next
+ * string-returning symbol call on this thread */
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+int MXSymbolFree(SymbolHandle handle);
+int MXSymbolListArguments(SymbolHandle handle, int *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle handle, int *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, int *out_size,
+                                const char ***out_array);
+/* reflected per-op parameter schema as JSON (the dmlc::Parameter arg
+ * listing; parity role: MXSymbolGetAtomicSymbolInfo) */
+int MXSymbolGetAtomicSymbolInfo(const char *op_name, const char **out_json);
+
 /* predictor (standalone inference; parity: c_predict_api.h) ----------- */
 
 typedef void *PredictorHandle;

@@ -169,3 +169,61 @@ def pred_output_shape(pred, index):
 
 def pred_output_bytes(pred, index):
     return to_bytes(pred.output(index))
+
+
+# ----------------------------------------------------------- symbol API ----
+# parity: MXSymbolCreateFromJSON / SaveToJSON / ListArguments /
+# ListOutputs / ListAuxiliaryStates / GetAtomicSymbolInfo in the
+# reference c_api.h
+
+def symbol_from_json(json_str):
+    return mx.sym.load_json(json_str)
+
+
+def symbol_from_file(fname):
+    return mx.sym.load(fname)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def op_schema_json(op_name):
+    """The per-op reflected parameter schema as JSON (dmlc
+    GetAtomicSymbolInfo analogue, fed by ops/schema.py)."""
+    import json
+
+    return json.dumps(registry.get(op_name).schema.describe())
+
+
+# ------------------------------------------------------- ndarray save/load -
+def nd_save(fname, handles, keys):
+    payload = {k: h for k, h in zip(keys, handles)} if keys \
+        else list(handles)
+    mx.nd.save(fname, payload)
+
+
+def nd_load(fname):
+    """Returns (names list, arrays list); positional entries get
+    empty-string names (reference MXNDArrayLoad contract)."""
+    loaded = mx.nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return names, [loaded[n] for n in names]
+    return [""] * len(loaded), list(loaded)
+
+
+def random_seed(seed):
+    mx.random.seed(int(seed))

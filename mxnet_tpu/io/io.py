@@ -527,9 +527,15 @@ class ImageRecordIter(DataIter):
     ImageRecordIter).
 
     Decodes each packed image, resizes to `data_shape`, and assembles
-    NCHW float32 batches; the u8->f32 channel-normalization inner loop
-    runs in the native C++ library when built (`mxnet_tpu.native`),
-    matching the reference's C++ ProcessImage path."""
+    NCHW float32 batches. The JPEG decode + resize runs OMP-parallel in
+    the native C++ library when built (PIL threads as fallback) and the
+    u8->f32 channel-normalization inner loop likewise, matching the
+    reference's C++ ProcessImage path.
+
+    Channel order is RGB, matching the reference ImageRecordIter (its
+    ProcessImage swaps cv2's BGR to RGB for 3-channel data_shapes);
+    earlier versions of this class produced BGR — models normalized
+    against that order should swap their mean_r/mean_b (std likewise)."""
 
     def __init__(self, path_imgrec, data_shape, path_imgidx=None,
                  batch_size=128, shuffle=False, label_width=1,

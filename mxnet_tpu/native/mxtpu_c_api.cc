@@ -455,3 +455,207 @@ int MXPredFree(PredictorHandle handle) {
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------ symbol API --
+
+extern "C" {
+typedef void *SymbolHandle;
+}
+
+namespace {
+thread_local std::string tls_json;
+thread_local std::vector<std::string> tls_strs;
+thread_local std::vector<const char *> tls_str_ptrs;
+// MXNDArrayLoad gets its own storage: its names must stay valid until
+// the next LOAD (header contract), not until any string-list call
+thread_local std::vector<std::string> tls_load_strs;
+thread_local std::vector<const char *> tls_load_ptrs;
+
+// bridge fn(handle-or-string) -> string, returned via tls_json
+int call_to_string(const char *fn, PyObject *arg, const char **out) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, arg);
+  PyObject *r = bridge_call(fn, args);
+  if (r == nullptr) return -1;
+  const char *c = PyUnicode_AsUTF8(r);
+  if (c == nullptr) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  tls_json = c;
+  Py_DECREF(r);
+  *out = tls_json.c_str();
+  return 0;
+}
+
+// bridge fn(handle) -> list[str], returned via tls string storage
+int call_to_strlist(const char *fn, PyObject *arg, int *out_size,
+                    const char ***out_array) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, arg);
+  PyObject *r = bridge_call(fn, args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  tls_strs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    tls_strs.emplace_back(c ? c : "");
+  }
+  Py_DECREF(r);
+  tls_str_ptrs.clear();
+  for (const auto &s : tls_strs) tls_str_ptrs.push_back(s.c_str());
+  *out_size = static_cast<int>(n);
+  *out_array = tls_str_ptrs.data();
+  return 0;
+}
+
+PyObject *incref_handle(void *h) {
+  Py_INCREF(static_cast<PyObject *>(h));
+  return static_cast<PyObject *>(h);
+}
+}  // namespace
+
+extern "C" {
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(json));
+  PyObject *r = bridge_call("symbol_from_json", args);
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(fname));
+  PyObject *r = bridge_call("symbol_from_file", args);
+  if (r == nullptr) return -1;
+  *out = static_cast<SymbolHandle>(r);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  GilGuard gil;
+  return call_to_string("symbol_to_json", incref_handle(handle), out_json);
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  if (handle == nullptr) return 0;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle handle, int *out_size,
+                          const char ***out_array) {
+  GilGuard gil;
+  return call_to_strlist("symbol_list_arguments", incref_handle(handle),
+                         out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, int *out_size,
+                        const char ***out_array) {
+  GilGuard gil;
+  return call_to_strlist("symbol_list_outputs", incref_handle(handle),
+                         out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, int *out_size,
+                                const char ***out_array) {
+  GilGuard gil;
+  return call_to_strlist("symbol_list_aux", incref_handle(handle),
+                         out_size, out_array);
+}
+
+// Reflected parameter schema of one op as JSON (parity role:
+// MXSymbolGetAtomicSymbolInfo's argument listing, fed by ops/schema.py)
+int MXSymbolGetAtomicSymbolInfo(const char *op_name, const char **out_json) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  return call_to_string("op_schema_json", PyUnicode_FromString(op_name),
+                        out_json);
+}
+
+// --------------------------------------------------- ndarray save / load --
+
+int MXNDArraySave(const char *fname, int num_args, NDArrayHandle *handles,
+                  const char **keys) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *hs = PyList_New(num_args);
+  for (int i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(hs, i, incref_handle(handles[i]));
+  PyObject *ks;
+  if (keys != nullptr) {
+    ks = PyList_New(num_args);
+    for (int i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+  } else {
+    ks = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(fname));
+  PyTuple_SET_ITEM(args, 1, hs);
+  PyTuple_SET_ITEM(args, 2, ks);
+  PyObject *r = bridge_call("nd_save", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, int *out_size,
+                  NDArrayHandle **out_handles, int *out_name_size,
+                  const char ***out_names) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(fname));
+  PyObject *r = bridge_call("nd_load", args);
+  if (r == nullptr) return -1;
+  PyObject *names = PyTuple_GET_ITEM(r, 0);
+  PyObject *arrays = PyTuple_GET_ITEM(r, 1);
+  Py_ssize_t n = PyList_Size(arrays);
+  auto **handles = static_cast<NDArrayHandle *>(
+      malloc(sizeof(NDArrayHandle) * (n + 1)));
+  tls_load_strs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *a = PyList_GET_ITEM(arrays, i);
+    Py_INCREF(a);
+    handles[i] = a;
+    const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+    tls_load_strs.emplace_back(c ? c : "");
+  }
+  handles[n] = nullptr;
+  tls_load_ptrs.clear();
+  for (const auto &s : tls_load_strs) tls_load_ptrs.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<int>(n);
+  *out_handles = handles;
+  *out_name_size = static_cast<int>(n);
+  *out_names = tls_load_ptrs.data();
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  if (ensure_init() != 0) return -1;
+  GilGuard gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyLong_FromLong(seed));
+  PyObject *r = bridge_call("random_seed", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

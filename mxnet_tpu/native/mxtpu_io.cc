@@ -230,8 +230,10 @@ long long mxtpu_decode_jpeg_batch(const uint8_t* blob,
   long long ok = 0;
   long long nfail = 0;
 #ifdef _OPENMP
-  if (n_threads > 0) omp_set_num_threads(n_threads);
-#pragma omp parallel for schedule(dynamic) reduction(+:ok)
+  // num_threads clause, NOT omp_set_num_threads: the setter is
+  // process-global and would throttle every later OMP region
+  const int team = n_threads > 0 ? n_threads : omp_get_max_threads();
+#pragma omp parallel for schedule(dynamic) reduction(+:ok) num_threads(team)
 #endif
   for (long long i = 0; i < n; ++i) {
     uint8_t* dst = out + static_cast<size_t>(i) * oh * ow * 3;

@@ -244,6 +244,365 @@ for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
     register_translation(_mx)(_unary(_ox))
 
 
+# ---------------------------------------------------------------------------
+# wider translation set (parity direction: the reference ships ~130
+# translations in python/mxnet/onnx/mx2onnx/_op_translations/; this covers
+# the families the test suite round-trips numerically)
+
+for _mx, _ox in [("floor", "Floor"), ("ceil", "Ceil"), ("round", "Round"),
+                 ("rint", "Round"), ("sin", "Sin"), ("cos", "Cos"),
+                 ("tan", "Tan"), ("arcsin", "Asin"), ("arccos", "Acos"),
+                 ("arctan", "Atan"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+                 ("arctanh", "Atanh"), ("arcsinh", "Asinh"),
+                 ("arccosh", "Acosh"), ("erf", "Erf"), ("sign", "Sign"),
+                 ("reciprocal", "Reciprocal"), ("softsign", "Softsign"),
+                 ("softplus", "Softplus")]:
+    register_translation(_mx)(_unary(_ox))
+
+
+@register_translation("square")
+def _square(ctx, name, ins, out, attrs):
+    ctx.emit("Mul", [ins[0], ins[0]], [out])
+
+
+@register_translation("rsqrt")
+def _rsqrt(ctx, name, ins, out, attrs):
+    ctx.emit("Sqrt", ins[:1], [f"{name}_sqrt"])
+    ctx.emit("Reciprocal", [f"{name}_sqrt"], [out])
+
+
+@register_translation("expm1")
+def _expm1(ctx, name, ins, out, attrs):
+    one = ctx.const(name, _np.float32(1.0))
+    ctx.emit("Exp", ins[:1], [f"{name}_exp"])
+    ctx.emit("Sub", [f"{name}_exp", one], [out])
+
+
+@register_translation("log1p")
+def _log1p(ctx, name, ins, out, attrs):
+    one = ctx.const(name, _np.float32(1.0))
+    ctx.emit("Add", [ins[0], one], [f"{name}_p1"])
+    ctx.emit("Log", [f"{name}_p1"], [out])
+
+
+@register_translation("log_softmax")
+def _log_softmax(ctx, name, ins, out, attrs):
+    ctx.emit("LogSoftmax", ins[:1], [out],
+             axis=int(attrs.get("axis", -1)))
+
+
+for _mx, _ox in [("elemwise_maximum", "Max"), ("broadcast_maximum", "Max"),
+                 ("elemwise_minimum", "Min"), ("broadcast_minimum", "Min"),
+                 ("elemwise_power", "Pow"), ("broadcast_power", "Pow"),
+                 ("elemwise_mod", "Mod"), ("broadcast_mod", "Mod"),
+                 ("batch_dot", "MatMul")]:
+    register_translation(_mx)(_binary(_ox))
+
+
+def _compare(onnx_op):
+    """mx comparisons return float32; ONNX compare ops return bool."""
+    def tr(ctx, name, ins, out, attrs):
+        ctx.emit(onnx_op, ins[:2], [f"{name}_b"])
+        ctx.emit("Cast", [f"{name}_b"], [out], to=proto.FLOAT)
+
+    return tr
+
+
+for _mx, _ox in [("elemwise_equal", "Equal"), ("broadcast_equal", "Equal"),
+                 ("elemwise_greater", "Greater"),
+                 ("broadcast_greater", "Greater"),
+                 ("elemwise_lesser", "Less"), ("broadcast_lesser", "Less"),
+                 ("elemwise_greater_equal", "GreaterOrEqual"),
+                 ("broadcast_greater_equal", "GreaterOrEqual"),
+                 ("elemwise_lesser_equal", "LessOrEqual"),
+                 ("broadcast_lesser_equal", "LessOrEqual")]:
+    register_translation(_mx)(_compare(_ox))
+
+
+def _logical(onnx_op):
+    """float in/out with bool compute in between."""
+    def tr(ctx, name, ins, out, attrs):
+        bs = []
+        for i, t in enumerate(ins[:2]):
+            b = f"{name}_b{i}"
+            ctx.emit("Cast", [t], [b], to=proto.BOOL)
+            bs.append(b)
+        ctx.emit(onnx_op, bs, [f"{name}_o"])
+        ctx.emit("Cast", [f"{name}_o"], [out], to=proto.FLOAT)
+
+    return tr
+
+
+for _mx, _ox in [("elemwise_logical_and", "And"),
+                 ("broadcast_logical_and", "And"),
+                 ("elemwise_logical_or", "Or"),
+                 ("broadcast_logical_or", "Or"),
+                 ("elemwise_logical_xor", "Xor"),
+                 ("broadcast_logical_xor", "Xor")]:
+    register_translation(_mx)(_logical(_ox))
+
+
+@register_translation("logical_not")
+def _not(ctx, name, ins, out, attrs):
+    ctx.emit("Cast", ins[:1], [f"{name}_b"], to=proto.BOOL)
+    ctx.emit("Not", [f"{name}_b"], [f"{name}_o"])
+    ctx.emit("Cast", [f"{name}_o"], [out], to=proto.FLOAT)
+
+
+for _mx, _ox in [("_rminus_scalar", "Sub"), ("_rdiv_scalar", "Div"),
+                 ("_power_scalar", "Pow"), ("_rpower_scalar", "Pow"),
+                 ("_maximum_scalar", "Max"), ("_minimum_scalar", "Min")]:
+    def _mk_scalar(onnx_op, reverse):
+        def tr(ctx, name, ins, out, attrs):
+            c = ctx.const(name, _np.float32(attrs.get("scalar", 0.0)))
+            args = [c, ins[0]] if reverse else [ins[0], c]
+            ctx.emit(onnx_op, args, [out])
+
+        return tr
+
+    register_translation(_mx)(
+        _mk_scalar(_ox, _mx.startswith("_r")))
+
+
+def _axes_of(attrs):
+    ax = attrs.get("axis", None)
+    if ax is None or ax == ():
+        return None
+    return [int(a) for a in (ax if isinstance(ax, (tuple, list))
+                             else (ax,))]
+
+
+def _reduce(onnx_op, axes_as_input=False):
+    """mx reductions (axis=None|int|tuple, keepdims) -> ONNX Reduce*.
+    ReduceSum takes axes as an INPUT at opset 13; the others keep the
+    attribute form until opset 18."""
+    def tr(ctx, name, ins, out, attrs):
+        axes = _axes_of(attrs)
+        keep = int(bool(attrs.get("keepdims", False)))
+        if axes_as_input:
+            inputs = ins[:1]
+            if axes is not None:
+                inputs = inputs + [ctx.const(
+                    name, _np.asarray(axes, _np.int64))]
+            ctx.emit(onnx_op, inputs, [out], keepdims=keep)
+        elif axes is not None:
+            ctx.emit(onnx_op, ins[:1], [out], axes=axes, keepdims=keep)
+        else:
+            ctx.emit(onnx_op, ins[:1], [out], keepdims=keep)
+
+    return tr
+
+
+register_translation("sum")(_reduce("ReduceSum", axes_as_input=True))
+register_translation("mean")(_reduce("ReduceMean"))
+register_translation("max")(_reduce("ReduceMax"))
+register_translation("min")(_reduce("ReduceMin"))
+register_translation("prod")(_reduce("ReduceProd"))
+
+
+@register_translation("norm")
+def _norm(ctx, name, ins, out, attrs):
+    if int(attrs.get("ord", 2)) != 2:
+        raise NotImplementedError("only ord=2 norm exports to ReduceL2")
+    _reduce("ReduceL2")(ctx, name, ins, out, attrs)
+
+
+def _arg_reduce(onnx_op):
+    def tr(ctx, name, ins, out, attrs):
+        # the op's own default is axis=None (FLATTENED argmax)
+        ax = attrs.get("axis", None)
+        src = ins[0]
+        if ax is None:
+            # mx axis=None means argmax over the FLATTENED array
+            flat_shape = ctx.const(name, _np.asarray([-1], _np.int64))
+            src = f"{name}_flat"
+            ctx.emit("Reshape", [ins[0], flat_shape], [src])
+            ax = 0
+        ctx.emit(onnx_op, [src], [f"{name}_i"], axis=int(ax), keepdims=0)
+        ctx.emit("Cast", [f"{name}_i"], [out], to=proto.FLOAT)
+
+    return tr
+
+
+register_translation("argmax")(_arg_reduce("ArgMax"))
+register_translation("argmin")(_arg_reduce("ArgMin"))
+
+
+@register_translation("expand_dims")
+def _expand_dims(ctx, name, ins, out, attrs):
+    axes = ctx.const(name, _np.asarray([int(attrs.get("axis", 0))],
+                                       _np.int64))
+    ctx.emit("Unsqueeze", [ins[0], axes], [out])
+
+
+@register_translation("squeeze")
+def _squeeze(ctx, name, ins, out, attrs):
+    ax = attrs.get("axis", None)
+    if ax is None:
+        ctx.emit("Squeeze", ins[:1], [out])
+    else:
+        axes = ctx.const(name, _np.asarray(
+            [int(a) for a in (ax if isinstance(ax, (tuple, list))
+                              else (ax,))], _np.int64))
+        ctx.emit("Squeeze", [ins[0], axes], [out])
+
+
+@register_translation("slice")
+def _slice(ctx, name, ins, out, attrs):
+    begin = [int(b) for b in attrs.get("begin", ())]
+    end = [int(0x7FFFFFFF) if e is None else int(e)
+           for e in attrs.get("end", ())]
+    axes = list(range(len(begin)))
+    ctx.emit("Slice", [
+        ins[0],
+        ctx.const(name, _np.asarray(begin, _np.int64)),
+        ctx.const(name, _np.asarray(end, _np.int64)),
+        ctx.const(name, _np.asarray(axes, _np.int64))], [out])
+
+
+@register_translation("slice_axis")
+def _slice_axis(ctx, name, ins, out, attrs):
+    ax = int(attrs.get("axis", 0))
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end", None)
+    end = int(0x7FFFFFFF) if end is None else int(end)
+    ctx.emit("Slice", [
+        ins[0],
+        ctx.const(name, _np.asarray([begin], _np.int64)),
+        ctx.const(name, _np.asarray([end], _np.int64)),
+        ctx.const(name, _np.asarray([ax], _np.int64))], [out])
+
+
+@register_translation("tile")
+def _tile(ctx, name, ins, out, attrs):
+    reps = ctx.const(name, _np.asarray(
+        [int(r) for r in attrs.get("reps", ())], _np.int64))
+    ctx.emit("Tile", [ins[0], reps], [out])
+
+
+@register_translation("pad")
+def _pad(ctx, name, ins, out, attrs):
+    pw = [int(p) for p in attrs.get("pad_width", ())]
+    # mx interleaved (before,after) per dim -> onnx all-befores,all-afters
+    befores, afters = pw[0::2], pw[1::2]
+    pads = ctx.const(name, _np.asarray(befores + afters, _np.int64))
+    mode = attrs.get("mode", "constant")
+    cval = ctx.const(name, _np.float32(attrs.get("constant_value", 0.0)))
+    ctx.emit("Pad", [ins[0], pads, cval], [out],
+             mode={"constant": "constant", "edge": "edge",
+                   "reflect": "reflect"}[mode])
+
+
+@register_translation("broadcast_to")
+def _broadcast_to(ctx, name, ins, out, attrs):
+    shape = ctx.const(name, _np.asarray(
+        [int(d) for d in attrs.get("shape", ())], _np.int64))
+    ctx.emit("Expand", [ins[0], shape], [out])
+
+
+@register_translation("stack")
+def _stack(ctx, name, ins, out, attrs):
+    ax = int(attrs.get("axis", 0))
+    axes = ctx.const(name, _np.asarray([ax], _np.int64))
+    unsq = []
+    for i, t in enumerate(ins):
+        u = f"{name}_u{i}"
+        ctx.emit("Unsqueeze", [t, axes], [u])
+        unsq.append(u)
+    ctx.emit("Concat", unsq, [out], axis=ax)
+
+
+@register_translation("SliceChannel")
+def _slice_channel(ctx, name, ins, out, attrs):
+    n = int(attrs.get("num_outputs", 1))
+    outs = [out] + [f"{name}_{i}" for i in range(1, n)]
+    ctx.emit("Split", ins[:1], outs, axis=int(attrs.get("axis", 1)))
+
+
+@register_translation("Embedding")
+def _embedding(ctx, name, ins, out, attrs):
+    # Gather(weight, indices): mx passes (data, weight); indices int
+    idx = f"{name}_idx"
+    ctx.emit("Cast", [ins[0]], [idx], to=proto.INT64)
+    ctx.emit("Gather", [ins[1], idx], [out], axis=0)
+
+
+@register_translation("take")
+def _take(ctx, name, ins, out, attrs):
+    idx = f"{name}_idx"
+    ctx.emit("Cast", [ins[1]], [idx], to=proto.INT64)
+    ctx.emit("Gather", [ins[0], idx], [out],
+             axis=int(attrs.get("axis", 0)))
+
+
+@register_translation("where")
+def _where(ctx, name, ins, out, attrs):
+    cond = f"{name}_c"
+    ctx.emit("Cast", [ins[0]], [cond], to=proto.BOOL)
+    ctx.emit("Where", [cond, ins[1], ins[2]], [out])
+
+
+@register_translation("Cast")
+def _cast(ctx, name, ins, out, attrs):
+    dt = str(attrs.get("dtype", "float32"))
+    ctx.emit("Cast", ins[:1], [out], to=proto._NP2ONNX[dt])
+
+
+def _const_like(value):
+    """Shape(x) -> ConstantOfShape(value): exact 0/1 fills that do not
+    propagate inf/NaN the way Sub(x,x) would."""
+    def tr(ctx, name, ins, out, attrs):
+        shp = f"{name}_shape"
+        ctx.emit("Shape", ins[:1], [shp])
+        ctx.emit("ConstantOfShape", [shp], [out],
+                 value=_np.asarray([value], _np.float32))
+
+    return tr
+
+
+register_translation("zeros_like")(_const_like(0.0))
+register_translation("ones_like")(_const_like(1.0))
+
+
+@register_translation("Deconvolution")
+def _deconv(ctx, name, ins, out, attrs):
+    kernel = tuple(attrs.get("kernel", ()))
+    pads = tuple(attrs.get("pad", (0,) * len(kernel)))
+    ctx.emit("ConvTranspose", ins, [out],
+             kernel_shape=list(kernel),
+             strides=list(attrs.get("stride", (1,) * len(kernel))),
+             dilations=list(attrs.get("dilate", (1,) * len(kernel))),
+             pads=list(pads) + list(pads),
+             group=int(attrs.get("num_group", 1)))
+
+
+@register_translation("LRN")
+def _lrn(ctx, name, ins, out, attrs):
+    ctx.emit("LRN", ins[:1], [out],
+             alpha=float(attrs.get("alpha", 1e-4)),
+             beta=float(attrs.get("beta", 0.75)),
+             bias=float(attrs.get("knorm", 2.0)),
+             size=int(attrs.get("nsize", 5)))
+
+
+@register_translation("InstanceNorm")
+def _instance_norm(ctx, name, ins, out, attrs):
+    ctx.emit("InstanceNormalization", ins[:3], [out],
+             epsilon=float(attrs.get("eps", 1e-3)))
+
+
+@register_translation("L2Normalization")
+def _l2norm(ctx, name, ins, out, attrs):
+    ctx.emit("LpNormalization", ins[:1], [out], axis=1, p=2)
+
+
+@register_translation("LayerNorm")
+def _layer_norm(ctx, name, ins, out, attrs):
+    ctx.emit("LayerNormalization", ins[:3], [out],
+             axis=int(attrs.get("axis", -1)),
+             epsilon=float(attrs.get("eps", 1e-5)))
+
+
 def export_model(sym, params, in_shapes=None, in_types=_np.float32,
                  onnx_file_path="model.onnx", verbose=False,
                  dynamic=False, input_type=None, input_shape=None,
@@ -278,6 +637,19 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
             continue
         ins = [out_name[(id(c), i)] for c, i in node.inputs]
         trans = _TRANSLATIONS.get(node.op)
+        if trans is None:
+            # translations may be registered under any alias of the op
+            # (e.g. "Reshape" vs canonical "reshape")
+            from ..ops import registry as _reg
+
+            try:
+                op_obj = _reg.get(node.op)
+                for alias in (op_obj.name,) + op_obj.aliases:
+                    if alias in _TRANSLATIONS:
+                        trans = _TRANSLATIONS[alias]
+                        break
+            except KeyError:
+                pass
         if trans is None:
             raise NotImplementedError(
                 f"no ONNX translation registered for op {node.op!r}")

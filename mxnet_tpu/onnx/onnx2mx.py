@@ -165,7 +165,7 @@ for _ox, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
 
 for _ox, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
                  ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
-                 ("MatMul", "dot")]:
+                 ("MatMul", "_npi_matmul")]:
     def _mk2(mx_name):
         def fn(sym, ins, attrs, name):
             return getattr(sym, mx_name)(ins[0], ins[1], name=name)
@@ -173,6 +173,170 @@ for _ox, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
         return fn
 
     register_import(_ox)(_mk2(_mx))
+
+
+# wider import set mirroring mx2onnx's translations ------------------------
+
+for _ox, _mx in [("Floor", "floor"), ("Ceil", "ceil"), ("Round", "round"),
+                 ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                 ("Asin", "arcsin"), ("Acos", "arccos"),
+                 ("Atan", "arctan"), ("Sinh", "sinh"), ("Cosh", "cosh"),
+                 ("Atanh", "arctanh"), ("Asinh", "arcsinh"),
+                 ("Acosh", "arccosh"), ("Erf", "erf"), ("Sign", "sign"),
+                 ("Reciprocal", "reciprocal"), ("Softsign", "softsign")]:
+    def _mk_u(mx_name):
+        def fn(sym, ins, attrs, name):
+            return getattr(sym, mx_name)(ins[0], name=name)
+
+        return fn
+
+    register_import(_ox)(_mk_u(_mx))
+
+for _ox, _mx in [("Max", "broadcast_maximum"), ("Min", "broadcast_minimum"),
+                 ("Pow", "broadcast_power"), ("Mod", "broadcast_mod"),
+                 ("Equal", "broadcast_equal"),
+                 ("Greater", "broadcast_greater"),
+                 ("Less", "broadcast_lesser"),
+                 ("GreaterOrEqual", "broadcast_greater_equal"),
+                 ("LessOrEqual", "broadcast_lesser_equal"),
+                 ("And", "broadcast_logical_and"),
+                 ("Or", "broadcast_logical_or"),
+                 ("Xor", "broadcast_logical_xor"),
+                 ("Where", "where")]:
+    def _mk_b(mx_name):
+        def fn(sym, ins, attrs, name):
+            return getattr(sym, mx_name)(*ins, name=name)
+
+        return fn
+
+    register_import(_ox)(_mk_b(_mx))
+
+
+@register_import("Squeeze")
+def _squeeze_imp(sym, ins, attrs, name):
+    # attribute/no-axes form; the axes-input form (opset>=13) is handled
+    # in import_model
+    axes = attrs.get("axes")
+    kw = {"axis": tuple(int(a) for a in axes)} if axes else {}
+    return sym.squeeze(ins[0], name=name, **kw)
+
+
+@register_import("Unsqueeze")
+def _unsqueeze_imp(sym, ins, attrs, name):
+    return sym.expand_dims(ins[0], axis=int(attrs["axes"][0]), name=name)
+
+
+@register_import("Not")
+def _not_imp(sym, ins, attrs, name):
+    return sym.logical_not(ins[0], name=name)
+
+
+@register_import("LogSoftmax")
+def _log_softmax_imp(sym, ins, attrs, name):
+    return sym.log_softmax(ins[0], axis=int(attrs.get("axis", -1)),
+                           name=name)
+
+
+@register_import("Cast")
+def _cast_imp(sym, ins, attrs, name):
+    return sym.Cast(ins[0], dtype=proto._ONNX2NP[int(attrs["to"])],
+                    name=name)
+
+
+def _reduce_imp(mx_name):
+    def fn(sym, ins, attrs, name):
+        kw = {"keepdims": bool(attrs.get("keepdims", 1))}
+        axes = attrs.get("axes")
+        if axes is not None:
+            kw["axis"] = tuple(int(a) for a in axes)
+        return getattr(sym, mx_name)(ins[0], name=name, **kw)
+
+    return fn
+
+
+register_import("ReduceMean")(_reduce_imp("mean"))
+register_import("ReduceMax")(_reduce_imp("max"))
+register_import("ReduceMin")(_reduce_imp("min"))
+register_import("ReduceProd")(_reduce_imp("prod"))
+
+
+@register_import("ReduceL2")
+def _reduce_l2_imp(sym, ins, attrs, name):
+    kw = {"keepdims": bool(attrs.get("keepdims", 1)), "ord": 2}
+    axes = attrs.get("axes")
+    if axes is not None:
+        kw["axis"] = tuple(int(a) for a in axes) \
+            if len(axes) > 1 else int(axes[0])
+    return sym.norm(ins[0], name=name, **kw)
+
+
+def _arg_imp(mx_name):
+    def fn(sym, ins, attrs, name):
+        return getattr(sym, mx_name)(ins[0],
+                                     axis=int(attrs.get("axis", 0)),
+                                     name=name)
+
+    return fn
+
+
+register_import("ArgMax")(_arg_imp("argmax"))
+register_import("ArgMin")(_arg_imp("argmin"))
+
+
+@register_import("Gather")
+def _gather_imp(sym, ins, attrs, name):
+    return sym.take(ins[0], ins[1], axis=int(attrs.get("axis", 0)),
+                    name=name)
+
+
+@register_import("Split")
+def _split_imp(sym, ins, attrs, name):
+    # num_outputs is recovered from the node's output count by the
+    # caller, passed through attrs under our private key
+    return sym.SliceChannel(ins[0], axis=int(attrs.get("axis", 0)),
+                            num_outputs=int(attrs["__n_out__"]),
+                            name=name)
+
+
+@register_import("ConvTranspose")
+def _deconv_imp(sym, ins, attrs, name):
+    return sym.Deconvolution(
+        *ins, kernel=tuple(attrs.get("kernel_shape", ())),
+        stride=tuple(attrs.get("strides", ())),
+        dilate=tuple(attrs.get("dilations", ())),
+        pad=_halve_pads(attrs.get("pads", ())),
+        num_group=int(attrs.get("group", 1)),
+        num_filter=0, no_bias=len(ins) < 3, name=name)
+
+
+@register_import("LRN")
+def _lrn_imp(sym, ins, attrs, name):
+    return sym.LRN(ins[0], alpha=float(attrs.get("alpha", 1e-4)),
+                   beta=float(attrs.get("beta", 0.75)),
+                   knorm=float(attrs.get("bias", 1.0)),
+                   nsize=int(attrs.get("size", 5)), name=name)
+
+
+@register_import("InstanceNormalization")
+def _inorm_imp(sym, ins, attrs, name):
+    return sym.InstanceNorm(*ins, eps=float(attrs.get("epsilon", 1e-5)),
+                            name=name)
+
+
+@register_import("LpNormalization")
+def _lpnorm_imp(sym, ins, attrs, name):
+    return sym.L2Normalization(ins[0], name=name)
+
+
+@register_import("LayerNormalization")
+def _lnorm_imp(sym, ins, attrs, name):
+    return sym.LayerNorm(*ins, axis=int(attrs.get("axis", -1)),
+                         eps=float(attrs.get("epsilon", 1e-5)), name=name)
+
+
+@register_import("HardSigmoid")
+def _hard_sigmoid_imp(sym, ins, attrs, name):
+    return sym.hard_sigmoid(ins[0], name=name)
 
 
 def import_model(model_file):
@@ -205,6 +369,9 @@ def import_model(model_file):
         raise KeyError(f"tensor {tname!r} not produced before use "
                        f"(node {node_name!r})")
 
+    def _init_ints(tname):
+        return [int(x) for x in _np.asarray(inits[tname]).reshape(-1)]
+
     for n in g["nodes"]:
         op = n["op_type"]
         name = n["name"] or n["output"][0]
@@ -212,6 +379,78 @@ def import_model(model_file):
             shape = tuple(int(x) for x in inits[n["input"][1]])
             out = sym_mod.Reshape(as_sym(n["input"][0], name), shape=shape,
                                   name=name)
+        elif op == "Unsqueeze" and len(n["input"]) == 2:
+            out = sym_mod.expand_dims(
+                as_sym(n["input"][0], name),
+                axis=_init_ints(n["input"][1])[0], name=name)
+        elif op == "Squeeze" and len(n["input"]) == 2:
+            out = sym_mod.squeeze(
+                as_sym(n["input"][0], name),
+                axis=tuple(_init_ints(n["input"][1])), name=name)
+        elif op == "ReduceSum":
+            kw = {"keepdims": bool(n["attrs"].get("keepdims", 1))}
+            if len(n["input"]) == 2:  # opset>=13 axes input
+                kw["axis"] = tuple(_init_ints(n["input"][1]))
+            elif n["attrs"].get("axes") is not None:
+                kw["axis"] = tuple(int(a) for a in n["attrs"]["axes"])
+            out = sym_mod.sum(as_sym(n["input"][0], name), name=name, **kw)
+        elif op == "Slice" and len(n["input"]) >= 3:
+            begins = _init_ints(n["input"][1])
+            ends = _init_ints(n["input"][2])
+            axes = _init_ints(n["input"][3]) if len(n["input"]) > 3 \
+                else list(range(len(begins)))
+            if len(n["input"]) > 4:
+                steps = _init_ints(n["input"][4])
+                if any(st != 1 for st in steps):
+                    raise NotImplementedError(
+                        f"ONNX Slice with steps={steps} is not "
+                        "supported (only step 1)")
+            out = as_sym(n["input"][0], name)
+            for ax, b, e in zip(axes, begins, ends):
+                out = sym_mod.slice_axis(
+                    out, axis=ax, begin=b,
+                    end=None if e >= 0x7FFFFFFF else e)
+        elif op == "Tile" and len(n["input"]) == 2:
+            out = sym_mod.tile(as_sym(n["input"][0], name),
+                               reps=tuple(_init_ints(n["input"][1])),
+                               name=name)
+        elif op == "Expand" and len(n["input"]) == 2:
+            out = sym_mod.broadcast_to(
+                as_sym(n["input"][0], name),
+                shape=tuple(_init_ints(n["input"][1])), name=name)
+        elif op == "Pad" and len(n["input"]) >= 2:
+            pads = _init_ints(n["input"][1])
+            half = len(pads) // 2
+            interleaved = []
+            for b, a in zip(pads[:half], pads[half:]):
+                interleaved += [b, a]
+            cval = float(_np.asarray(inits[n["input"][2]]).reshape(-1)[0]) \
+                if len(n["input"]) > 2 else 0.0
+            out = sym_mod.pad(as_sym(n["input"][0], name),
+                              mode=n["attrs"].get("mode", "constant"),
+                              pad_width=tuple(interleaved),
+                              constant_value=cval, name=name)
+        elif op == "Shape":
+            # shape-of marker: consumed by ConstantOfShape below (our
+            # exporter's zeros_like/ones_like pattern)
+            tensors[n["output"][0]] = ("__shape_of__",
+                                       as_sym(n["input"][0], name))
+            continue
+        elif op == "ConstantOfShape":
+            src = tensors.get(n["input"][0])
+            if not (isinstance(src, tuple) and src[0] == "__shape_of__"):
+                raise NotImplementedError(
+                    "ConstantOfShape is supported only over Shape(x)")
+            val = n["attrs"].get("value")
+            v = float(_np.asarray(val).reshape(-1)[0]) \
+                if val is not None else 0.0
+            base = sym_mod.zeros_like(src[1], name=name)
+            out = base if v == 0.0 else base + v
+        elif op == "Split":
+            attrs = dict(n["attrs"])
+            attrs["__n_out__"] = len(n["output"])
+            out = _IMPORTS[op](sym_mod,
+                               [as_sym(n["input"][0], name)], attrs, name)
         elif op == "Clip" and len(n["input"]) == 3:
             lo = float(inits[n["input"][1]])
             hi = float(inits[n["input"][2]])

@@ -120,3 +120,46 @@ def test_predict_abi_end_to_end(predict_exe, tmp_path):
     c_sum = float(argmax_line.split("sum=")[1])
     assert c_argmax == int(ref.argmax())
     assert abs(c_sum - float(ref.sum())) < 1e-4  # softmax sums to 1
+
+
+@pytest.fixture(scope="module")
+def symbol_io_exe(capi_lib):
+    build = os.path.dirname(capi_lib)
+    gcc = shutil.which("gcc") or shutil.which("g++")
+    exe = os.path.join(build, "symbol_io")
+    subprocess.run(
+        [gcc, os.path.join(REPO, "examples", "extensions", "c_binding",
+                           "symbol_io.c"),
+         "-I", os.path.join(REPO, "include"),
+         "-L", build, "-lmxtpu", f"-Wl,-rpath,{build}", "-o", exe],
+        check=True, capture_output=True)
+    return exe
+
+
+def test_symbol_and_container_abi(symbol_io_exe, tmp_path):
+    """Symbol load/introspect/json-roundtrip, per-op schema info, and
+    NDArray container save/load — all from pure C (parity:
+    MXSymbolCreateFromJSON & co., MXNDArraySave/Load)."""
+    gen = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import mxnet_tpu as mx\n"
+        "net = mx.sym.FullyConnected(mx.sym.var('data'), num_hidden=4)\n"
+        "net = mx.sym.BatchNorm(net)\n"
+        "net = mx.sym.softmax(net)\n"
+        "net.save(%r)\n"
+    )
+    sym_path = str(tmp_path / "net-symbol.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    subprocess.run([os.sys.executable, "-c", gen % sym_path],
+                   check=True, env=env, timeout=300)
+    env["MXTPU_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [symbol_io_exe, sym_path, str(tmp_path / "params.nd")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, \
+        f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("SYMBOL_IO_OK")][0]
+    # data + fc weight/bias + bn gamma/beta (+2 aux moving stats)
+    assert "args=5" in line and "aux=2" in line, line

@@ -197,3 +197,41 @@ def test_iter_len():
     assert len(a) == 3
     rows = list(a)
     assert len(rows) == 3 and rows[2].shape == (2,)
+
+
+def test_int64_index_posture():
+    """Large-tensor (int64 index) posture. The reference gates
+    >2^31-element tensors behind MXNET_INT64_TENSOR_SIZE and tests them
+    nightly (tests/nightly/test_large_array.py). Here the gate is JAX
+    x64: with it OFF (production default) int64 inputs store as int32 —
+    fine below 2^31 elements; inside `jax.experimental.enable_x64()`
+    int64 indices/labels are preserved end-to-end, which is the
+    large-tensor mode. This pins both halves of that contract."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    # default runtime: int64 narrows to int32 (documented posture)
+    idx32 = mx.nd.array(np.array([0, 2, 1], np.int64), dtype="int64")
+    assert str(idx32.dtype) == "int32"
+    data = mx.nd.array(np.arange(12).reshape(4, 3).astype("f"))
+    out = mx.nd.take(data, idx32)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  data.asnumpy()[[0, 2, 1]])
+
+    # large-tensor mode: x64 scope preserves int64 end-to-end
+    import tempfile
+
+    import jax
+
+    with jax.enable_x64():
+        idx = mx.nd.array(np.array([0, 2, 1], np.int64), dtype="int64")
+        assert str(idx.dtype) == "int64"
+        out = mx.nd.take(data, idx)
+        np.testing.assert_array_equal(out.asnumpy(),
+                                      data.asnumpy()[[0, 2, 1]])
+        with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+            mx.nd.save(f.name, {"i": idx})
+            back = mx.nd.load(f.name)["i"]
+        assert str(back.dtype) == "int64"
+        np.testing.assert_array_equal(back.asnumpy(), idx.asnumpy())

@@ -152,3 +152,150 @@ def test_compression_disable_with_empty_params():
     assert kv.gradient_compression
     kv.set_gradient_compression({})
     assert not kv.gradient_compression
+
+
+# ------------------------------------------------ per-op roundtrip sweep ---
+# each case: build a small symbolic graph, export -> import -> compare
+# outputs numerically (covers the widened translation set)
+
+def _rt(build, feeds, rtol=1e-5, atol=1e-6):
+    """build(vars) -> Symbol over named vars; feeds: {name: np array}."""
+    syms = {k: mx.sym.var(k) for k in feeds}
+    out = build(syms)
+    d = tempfile.mkdtemp()
+    path = mx_onnx.export_model(
+        out, {}, in_shapes=[list(v.shape) for v in feeds.values()],
+        onnx_file_path=os.path.join(d, "m.onnx"))
+    nd_feeds = {k: mx.nd.array(v) for k, v in feeds.items()}
+    ref = out.eval(**nd_feeds)
+    ref = [r.asnumpy() for r in (ref if isinstance(ref, list) else [ref])]
+    sym2, args, auxs = mx_onnx.import_model(path)
+    got = sym2.eval(**nd_feeds, **{k: v for k, v in args.items()})
+    got = [g.asnumpy() for g in (got if isinstance(got, list) else [got])]
+    for r, g in zip(ref, got):
+        onp.testing.assert_allclose(g, r, rtol=rtol, atol=atol)
+
+
+_R = onp.random.RandomState(11)
+_A = _R.rand(2, 6).astype("float32") + 0.1
+_B = _R.rand(2, 6).astype("float32") + 0.1
+_IMG = _R.rand(1, 3, 8, 8).astype("float32")
+
+_OP_CASES = {
+    "floor": lambda s: mx.sym.floor(s["a"] * 5),
+    "ceil": lambda s: mx.sym.ceil(s["a"] * 5),
+    "round": lambda s: mx.sym.round(s["a"] * 5),
+    "sin": lambda s: mx.sym.sin(s["a"]),
+    "cos": lambda s: mx.sym.cos(s["a"]),
+    "arctan": lambda s: mx.sym.arctan(s["a"]),
+    "erf": lambda s: mx.sym.erf(s["a"]),
+    "sign": lambda s: mx.sym.sign(s["a"] - 0.5),
+    "reciprocal": lambda s: mx.sym.reciprocal(s["a"]),
+    "softsign": lambda s: mx.sym.softsign(s["a"]),
+    "square": lambda s: mx.sym.square(s["a"]),
+    "rsqrt": lambda s: mx.sym.rsqrt(s["a"]),
+    "expm1": lambda s: mx.sym.expm1(s["a"]),
+    "log1p": lambda s: mx.sym.log1p(s["a"]),
+    "log_softmax": lambda s: mx.sym.log_softmax(s["a"]),
+    "maximum": lambda s: mx.sym.broadcast_maximum(s["a"], s["b"]),
+    "minimum": lambda s: mx.sym.broadcast_minimum(s["a"], s["b"]),
+    "power": lambda s: mx.sym.broadcast_power(s["a"], s["b"]),
+    "mod": lambda s: mx.sym.broadcast_mod(s["a"], s["b"]),
+    "greater": lambda s: mx.sym.broadcast_greater(s["a"], s["b"]),
+    "lesser_equal": lambda s: mx.sym.broadcast_lesser_equal(s["a"],
+                                                            s["b"]),
+    "logical_and": lambda s: mx.sym.broadcast_logical_and(s["a"] - 0.5,
+                                                          s["b"] - 0.5),
+    "logical_not": lambda s: mx.sym.logical_not(s["a"] - 0.5),
+    "rminus_scalar": lambda s: 2.0 - s["a"],
+    "rdiv_scalar": lambda s: 2.0 / s["a"],
+    "power_scalar": lambda s: s["a"] ** 2.0,
+    "maximum_scalar": lambda s: mx.sym.invoke("_maximum_scalar", s["a"],
+                                              scalar=0.5),
+    "sum": lambda s: mx.sym.sum(s["a"], axis=1),
+    "sum_all": lambda s: mx.sym.sum(s["a"]),
+    "mean": lambda s: mx.sym.mean(s["a"], axis=1, keepdims=True),
+    "max": lambda s: mx.sym.max(s["a"], axis=0),
+    "min": lambda s: mx.sym.min(s["a"], axis=1),
+    "prod": lambda s: mx.sym.prod(s["a"], axis=1),
+    "norm": lambda s: mx.sym.norm(s["a"], axis=1),
+    "argmax": lambda s: mx.sym.argmax(s["a"], axis=1),
+    "argmin": lambda s: mx.sym.argmin(s["a"], axis=1),
+    "expand_dims": lambda s: mx.sym.expand_dims(s["a"], axis=1),
+    "squeeze": lambda s: mx.sym.squeeze(
+        mx.sym.expand_dims(s["a"], axis=1), axis=1),
+    "slice": lambda s: mx.sym.invoke("slice", s["a"], begin=(0, 1),
+                                     end=(2, 4)),
+    "slice_axis": lambda s: mx.sym.slice_axis(s["a"], axis=1, begin=1,
+                                              end=4),
+    "tile": lambda s: mx.sym.tile(s["a"], reps=(2, 1)),
+    "pad": lambda s: mx.sym.invoke(
+        "pad", mx.sym.Reshape(s["a"], shape=(1, 2, 2, 3)),
+        mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 2, 2)),
+    "broadcast_to": lambda s: mx.sym.broadcast_to(
+        mx.sym.sum(s["a"], axis=0, keepdims=True), shape=(4, 6)),
+    "stack": lambda s: mx.sym.invoke("stack", s["a"], s["b"], axis=0),
+    "slice_channel": lambda s: mx.sym.SliceChannel(
+        s["a"], num_outputs=2, axis=1)[0],
+    "where": lambda s: mx.sym.invoke(
+        "where", mx.sym.broadcast_greater(s["a"], s["b"]), s["a"],
+        s["b"]),
+    "cast": lambda s: mx.sym.Cast(s["a"] * 5, dtype="int32"),
+    "zeros_like": lambda s: mx.sym.zeros_like(s["a"]),
+    "ones_like": lambda s: mx.sym.ones_like(s["a"]),
+    "batch_dot": lambda s: mx.sym.batch_dot(
+        mx.sym.Reshape(s["a"], shape=(2, 2, 3)),
+        mx.sym.Reshape(s["b"], shape=(2, 3, 2))),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_OP_CASES))
+def test_onnx_op_roundtrip(case):
+    _rt(_OP_CASES[case], {"a": _A, "b": _B}, rtol=1e-4, atol=1e-5)
+
+
+_NN_CASES = {
+    "deconv": lambda s: mx.sym.Deconvolution(
+        s["x"], mx.sym.var("w"), kernel=(3, 3), num_filter=2,
+        no_bias=True),
+    "lrn": lambda s: mx.sym.LRN(s["x"], nsize=3),
+    "instance_norm": lambda s: mx.sym.InstanceNorm(
+        s["x"], mx.sym.var("g"), mx.sym.var("be")),
+    "l2_normalization": lambda s: mx.sym.L2Normalization(
+        mx.sym.Flatten(s["x"])),
+    "layer_norm": lambda s: mx.sym.LayerNorm(
+        mx.sym.Flatten(s["x"]), mx.sym.var("g2"), mx.sym.var("b2")),
+    "embedding_take": lambda s: mx.sym.take(
+        mx.sym.Flatten(s["x"]),
+        mx.sym.var("idx"), axis=1),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_NN_CASES))
+def test_onnx_nn_roundtrip(case):
+    feeds = {"x": _IMG}
+    if case == "deconv":
+        feeds["w"] = _R.rand(3, 2, 3, 3).astype("float32") * 0.3
+    elif case == "instance_norm":
+        feeds["g"] = onp.ones(3, "float32")
+        feeds["be"] = onp.zeros(3, "float32")
+    elif case == "layer_norm":
+        feeds["g2"] = onp.ones(192, "float32")
+        feeds["b2"] = onp.zeros(192, "float32")
+    elif case == "embedding_take":
+        feeds["idx"] = onp.array([0, 5, 2], "float32")
+    _rt(_NN_CASES[case], feeds, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_argmax_flat_and_inf_zeros_like():
+    """axis=None argmax flattens; zeros_like must not propagate inf/NaN
+    (regressions found in review)."""
+    a = _A.copy()
+    _rt(lambda s: mx.sym.argmax(s["a"]), {"a": a})
+    a_inf = a.copy()
+    a_inf[0, 0] = onp.inf
+    a_inf[1, 1] = onp.nan
+    _rt(lambda s: mx.sym.zeros_like(s["a"]), {"a": a_inf})
+    _rt(lambda s: mx.sym.ones_like(s["a"]), {"a": a_inf})
+    _rt(lambda s: mx.sym.squeeze(mx.sym.expand_dims(s["a"], axis=0)),
+        {"a": a})
