@@ -61,18 +61,27 @@ def test_c_host_program_end_to_end(capi_lib):
     assert ops_line and int(ops_line[0].split("=")[1]) > 400
 
 
-@pytest.fixture(scope="module")
-def predict_exe(capi_lib):
+
+
+def _build_c_example(capi_lib, src_name, out_name, extra_flags=()):
+    """Compile one examples/extensions/c_binding host program against
+    the freshly-built libmxtpu (shared across the ABI fixtures)."""
     build = os.path.dirname(capi_lib)
     gcc = shutil.which("gcc") or shutil.which("g++")
-    exe = os.path.join(build, "predict")
+    exe = os.path.join(build, out_name)
     subprocess.run(
         [gcc, os.path.join(REPO, "examples", "extensions", "c_binding",
-                           "predict.c"),
+                           src_name),
          "-I", os.path.join(REPO, "include"),
-         "-L", build, "-lmxtpu", f"-Wl,-rpath,{build}", "-o", exe],
+         "-L", build, "-lmxtpu", f"-Wl,-rpath,{build}",
+         *extra_flags, "-o", exe],
         check=True, capture_output=True)
     return exe
+
+
+@pytest.fixture(scope="module")
+def predict_exe(capi_lib):
+    return _build_c_example(capi_lib, "predict.c", "predict")
 
 
 def test_predict_abi_end_to_end(predict_exe, tmp_path):
@@ -124,16 +133,7 @@ def test_predict_abi_end_to_end(predict_exe, tmp_path):
 
 @pytest.fixture(scope="module")
 def symbol_io_exe(capi_lib):
-    build = os.path.dirname(capi_lib)
-    gcc = shutil.which("gcc") or shutil.which("g++")
-    exe = os.path.join(build, "symbol_io")
-    subprocess.run(
-        [gcc, os.path.join(REPO, "examples", "extensions", "c_binding",
-                           "symbol_io.c"),
-         "-I", os.path.join(REPO, "include"),
-         "-L", build, "-lmxtpu", f"-Wl,-rpath,{build}", "-o", exe],
-        check=True, capture_output=True)
-    return exe
+    return _build_c_example(capi_lib, "symbol_io.c", "symbol_io")
 
 
 def test_symbol_and_container_abi(symbol_io_exe, tmp_path):
@@ -163,3 +163,45 @@ def test_symbol_and_container_abi(symbol_io_exe, tmp_path):
             if l.startswith("SYMBOL_IO_OK")][0]
     # data + fc weight/bias + bn gamma/beta (+2 aux moving stats)
     assert "args=5" in line and "aux=2" in line, line
+
+
+@pytest.fixture(scope="module")
+def multi_pred_exe(capi_lib):
+    return _build_c_example(capi_lib, "multi_pred.c", "multi_pred",
+                            extra_flags=("-pthread",))
+
+
+def test_multi_threaded_inference_abi(multi_pred_exe, tmp_path):
+    """Concurrent predictors from N host threads over one checkpoint —
+    the reference's example/multi_threaded_inference capability. Each
+    thread owns a PredictorHandle; all must produce identical results
+    with no crashes or cross-talk."""
+    gen = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "net = mx.sym.FullyConnected(mx.sym.var('data'), num_hidden=8,\n"
+        "                            name='fc1')\n"
+        "net = mx.sym.Activation(net, act_type='relu')\n"
+        "net = mx.sym.softmax(mx.sym.FullyConnected(net, num_hidden=3,\n"
+        "                                           name='fc2'))\n"
+        "ex = net.simple_bind(mx.cpu(), data=(1, 8))\n"
+        "rs = np.random.RandomState(3)\n"
+        "args = {n: mx.nd.array(rs.randn(*a.shape).astype('f') * 0.3)\n"
+        "        for n, a in ex.arg_dict.items() if n != 'data'}\n"
+        "from mxnet_tpu.model import save_checkpoint\n"
+        "save_checkpoint(%r, 0, net, args, {})\n"
+    )
+    prefix = str(tmp_path / "mlp")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    subprocess.run([os.sys.executable, "-c", gen % prefix],
+                   check=True, env=env, timeout=300)
+    env["MXTPU_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [multi_pred_exe, prefix + "-symbol.json",
+         prefix + "-0000.params", "4", "5"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, \
+        f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "MULTI_PRED_OK" in proc.stdout

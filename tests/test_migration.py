@@ -338,3 +338,15 @@ def test_train_imagenet_benchmark_mode():
                      "--num-classes", "10", "--num-examples", "32",
                      "--ctx", "cpu", "--disp-batches", "2"])
     assert model is not None
+
+
+def test_dcgan_example():
+    """Adversarial two-optimizer training loop (reference
+    example/gluon/dcgan): alternating D/G steps with a detached fake
+    batch; both losses stay finite and the generator produces samples."""
+    dc = _example_module("gluon/dcgan.py", "dcgan_example")
+    d_loss, g_loss = dc.main(["--epochs", "2", "--num-examples", "96",
+                              "--batch-size", "16"])
+    import numpy as np
+
+    assert np.isfinite(d_loss) and np.isfinite(g_loss)
