@@ -19,7 +19,6 @@ synchronized via wait_to_read (dispatch+device time per call).
 from __future__ import annotations
 
 import argparse
-import inspect
 import json
 import os
 import sys
@@ -71,20 +70,20 @@ def _inputs_for(op_name, n):
     }
     if op_name in special:
         return special[op_name]
+    # generic synthesis from the op's reflected schema (ops/schema.py —
+    # the dmlc::Parameter layer): the schema names the array inputs, so
+    # synthesis no longer re-derives them from raw signature inspection
     op = registry.get(op_name)
-    sig = inspect.signature(op.fn)
+    schema = op.schema
+    if schema.variadic:
+        return [_rand(n, n), _rand(n, n)], {}
     arrays = []
-    for p in sig.parameters.values():
-        if p.kind == inspect.Parameter.VAR_POSITIONAL:
-            arrays.extend([_rand(n, n), _rand(n, n)])
+    for pname in schema.inputs:
+        if pname in ("key", "training"):
             break
-        if p.default is inspect.Parameter.empty and p.name not in (
-                "key", "training"):
-            # scalar-tensor hyper inputs (loss-scale etc.), not matrices
-            arrays.append(_rand(1) if p.name in ("rescale_grad",)
-                          else _rand(n, n))
-        else:
-            break
+        # scalar-tensor hyper inputs (loss-scale etc.), not matrices
+        arrays.append(_rand(1) if pname in ("rescale_grad",)
+                      else _rand(n, n))
     if not arrays:
         return None
     return arrays, {}

@@ -94,6 +94,10 @@ int main(int argc, char **argv) {
   if (!json || !params) return 2;
   int n_threads = atoi(argv[3]);
   int iters = atoi(argv[4]);
+  if (n_threads < 1 || iters < 1) {
+    fprintf(stderr, "n_threads and iters must be >= 1\n");
+    return 2;
+  }
 
   Job *jobs = (Job *)calloc(n_threads, sizeof(Job));
   pthread_t *tids = (pthread_t *)calloc(n_threads, sizeof(pthread_t));

@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import numpy as np
 
 
-def build_nets(mx, nn, ngf=16, ndf=16, nc=1):
+def build_nets(nn, ngf=16, ndf=16, nc=1):
     netG = nn.HybridSequential(prefix="gen_")
     with netG.name_scope():
         # latent (B, nz, 1, 1) -> (B, nc, 16, 16)
@@ -69,7 +69,7 @@ def main(argv=None):
            (yy[None] - centers[:, 1, None, None]) ** 2) / 0.05)) - 0.5)
     real = real[:, None].astype(np.float32)
 
-    netG, netD = build_nets(mx, nn)
+    netG, netD = build_nets(nn)
     netG.initialize(mx.init.Normal(0.02))
     netD.initialize(mx.init.Normal(0.02))
     trainerG = gluon.Trainer(netG.collect_params(), "adam",
