@@ -252,3 +252,256 @@ for _name in _registry.list_ops():
             _short = _cand[len("_contrib_"):]
             if not hasattr(_mod, _short):
                 setattr(_mod, _short, _make_wrapper(_name))
+
+
+# ------------------------------------------------------------ DGL ops -----
+# parity: src/operator/contrib/dgl_graph.cc — host-side graph sampling
+# kernels for DGL (_contrib_dgl_csr_neighbor_uniform_sample :761,
+# _contrib_dgl_csr_neighbor_non_uniform_sample :866, _contrib_dgl_subgraph
+# :1146, _contrib_edge_id :1331, _contrib_dgl_adjacency :1407,
+# _contrib_dgl_graph_compact :1582). Sampling is irregular host work in
+# the reference too (CPU + OMP); here it runs on numpy over the genuinely
+# sparse CSR storage, and the outputs are NDArrays/CSRNDArrays ready for
+# device compute.
+
+def _csr_parts(csr):
+    import numpy as _onp
+
+    return (_onp.asarray(csr.data.asnumpy()),
+            _onp.asarray(csr.indices.asnumpy()).astype(_onp.int64),
+            _onp.asarray(csr.indptr.asnumpy()).astype(_onp.int64))
+
+
+def _dgl_sample_one(data, indices, indptr, seed, probability, num_hops,
+                    num_neighbor, max_num_vertices, rng):
+    """BFS sampling from `seed` up to num_hops, <=num_neighbor neighbors
+    per vertex (uniform, or weighted by `probability`), capped at
+    max_num_vertices (reference SampleSubgraph)."""
+    import numpy as _onp
+
+    seed = [int(v) for v in seed if v >= 0]
+    sampled = {}  # vertex -> layer
+    edges = {}    # expanded vertex -> (sampled neighbor cols, edge vals)
+    frontier = []
+    for v in seed:
+        if v not in sampled and len(sampled) < max_num_vertices:
+            sampled[v] = 0
+            frontier.append(v)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for u in frontier:
+            row = slice(indptr[u], indptr[u + 1])
+            neigh, vals = indices[row], data[row]
+            if len(neigh) == 0:
+                edges[u] = ([], [])
+                continue
+            if probability is not None:
+                pos = probability[neigh] > 0
+                if int(pos.sum()) <= num_neighbor:
+                    pick = _onp.nonzero(pos)[0]
+                else:
+                    p = probability[neigh]
+                    pick = rng.choice(len(neigh), num_neighbor,
+                                      replace=False, p=p / p.sum())
+            elif len(neigh) > num_neighbor:
+                pick = rng.choice(len(neigh), num_neighbor,
+                                  replace=False)
+            else:
+                pick = _onp.arange(len(neigh))
+            edges[u] = ([int(neigh[i]) for i in pick],
+                        [vals[i] for i in pick])
+            for i in pick:
+                v = int(neigh[i])
+                if v not in sampled:
+                    if len(sampled) >= max_num_vertices:
+                        break
+                    sampled[v] = hop
+                    nxt.append(v)
+        frontier = nxt
+    verts = _onp.sort(_onp.asarray(list(sampled), _onp.int64))
+    n = len(verts)
+    # sub-CSR holds only the SAMPLED edges (reference SampleSubgraph:
+    # each expanded vertex contributes its <=num_neighbor picks; cap
+    # overflow neighbors are dropped at assembly). Rows AND columns are
+    # LOCAL positions into `verts` — the sampled-vertex array is the
+    # local->global mapping, DGL-style.
+    vset = {int(v): i for i, v in enumerate(verts)}
+    sub_ptr = _onp.zeros(max_num_vertices + 1, _onp.int64)
+    sub_idx, sub_val = [], []
+    for i, u in enumerate(verts):
+        cols, vals = edges.get(int(u), ([], []))
+        for col, val in zip(cols, vals):
+            j = vset.get(col)
+            if j is not None:
+                sub_idx.append(j)
+                sub_val.append(val)
+        sub_ptr[i + 1] = len(sub_idx)
+    sub_ptr[n + 1:] = sub_ptr[n]
+    # outputs in the reference layout
+    out_verts = _onp.full(max_num_vertices + 1, -1, _onp.int64)
+    out_verts[:n] = verts
+    out_verts[-1] = n
+    layer = _onp.full(max_num_vertices, -1, _onp.int64)
+    layer[:n] = [sampled[int(v)] for v in verts]
+    return out_verts, (sub_val, sub_idx, sub_ptr), layer
+
+
+def _dgl_sample(csr, seeds, probability, num_hops, num_neighbor,
+                max_num_vertices):
+    import numpy as _onp
+
+    from .. import random as _rand
+    from .sparse import csr_matrix
+
+    data, indices, indptr = _csr_parts(csr)
+    # deterministic under mx.random.seed: fold the framework key stream
+    import jax as _jax
+
+    key_bits = _onp.asarray(_jax.device_get(_rand.next_key())).ravel()
+    rng = _onp.random.RandomState(int(key_bits[-1]) & 0x7FFFFFFF)
+    vert_out, prob_out, csr_out, layer_out = [], [], [], []
+    for s in seeds:
+        sv = _onp.asarray(s.asnumpy()).astype(_onp.int64)
+        verts, (sval, sidx, sptr), layer = _dgl_sample_one(
+            data, indices, indptr, sv, probability, num_hops,
+            num_neighbor, max_num_vertices, rng)
+        vert_out.append(array(verts, dtype="int64"))
+        if probability is not None:
+            p = _onp.zeros(max_num_vertices, _onp.float32)
+            nv = int(verts[-1])
+            p[:nv] = probability[verts[:nv]]
+            prob_out.append(array(p))
+        csr_out.append(csr_matrix(
+            (_onp.asarray(sval), _onp.asarray(sidx, _onp.int64), sptr),
+            shape=(max_num_vertices, max_num_vertices)))
+        layer_out.append(array(layer, dtype="int64"))
+    return vert_out + prob_out + csr_out + layer_out
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_args=None, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100):
+    """Uniform neighbor sampling over a CSR graph (parity:
+    _contrib_dgl_csr_neighbor_uniform_sample). Returns, per seed array:
+    sampled vertex ids (length max_num_vertices+1, last element = actual
+    count), then the sampled sub-CSRs (original edge values), then the
+    per-vertex hop layers."""
+    return _dgl_sample(csr, seeds, None, num_hops, num_neighbor,
+                       max_num_vertices)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seeds,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """Weighted neighbor sampling (parity:
+    _contrib_dgl_csr_neighbor_non_uniform_sample); adds the sampled
+    vertices' probabilities as a second output set."""
+    import numpy as _onp
+
+    p = _onp.asarray(probability.asnumpy(), _onp.float64)
+    return _dgl_sample(csr, seeds, p, num_hops, num_neighbor,
+                       max_num_vertices)
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False, num_args=None):
+    """Vertex-induced subgraphs (parity: _contrib_dgl_subgraph). Per vid
+    array: the induced sub-CSR (data = all-1s), plus — with
+    return_mapping — a CSR whose data are the ORIGINAL edge ids."""
+    import numpy as _onp
+
+    from .sparse import csr_matrix
+
+    data, indices, indptr = _csr_parts(graph)
+    subs, maps = [], []
+    for vid_arr in vids:
+        verts = _onp.asarray(vid_arr.asnumpy()).astype(_onp.int64)
+        vset = {int(v): i for i, v in enumerate(verts)}
+        n = len(verts)
+        sptr = _onp.zeros(n + 1, _onp.int64)
+        sidx, sval, smap = [], [], []
+        for i, u in enumerate(verts):
+            row = slice(indptr[u], indptr[u + 1])
+            for pos, col in zip(range(row.start, row.stop), indices[row]):
+                j = vset.get(int(col))
+                if j is not None:
+                    sidx.append(j)
+                    sval.append(1)
+                    smap.append(data[pos])
+            sptr[i + 1] = len(sidx)
+        subs.append(csr_matrix(
+            (_onp.asarray(sval, _onp.int64),
+             _onp.asarray(sidx, _onp.int64), sptr), shape=(n, n)))
+        if return_mapping:
+            maps.append(csr_matrix(
+                (_onp.asarray(smap), _onp.asarray(sidx, _onp.int64),
+                 sptr.copy()), shape=(n, n)))
+    return subs + maps
+
+
+def edge_id(csr, u, v):
+    """data value at (u[i], v[i]) per pair, -1 where no edge (parity:
+    _contrib_edge_id)."""
+    import numpy as _onp
+
+    data, indices, indptr = _csr_parts(csr)
+    us = _onp.asarray(u.asnumpy()).astype(_onp.int64)
+    vs = _onp.asarray(v.asnumpy()).astype(_onp.int64)
+    # keep the edge-data dtype: float32 would corrupt int ids > 2^24
+    out = _onp.full(len(us), -1, data.dtype)
+    for i, (a, b) in enumerate(zip(us, vs)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = _onp.nonzero(row == b)[0]
+        if len(hit):
+            out[i] = data[indptr[a] + hit[0]]
+    return array(out)
+
+
+def dgl_adjacency(csr):
+    """Adjacency CSR with all-1 float data (parity:
+    _contrib_dgl_adjacency)."""
+    import numpy as _onp
+
+    from .sparse import csr_matrix
+
+    data, indices, indptr = _csr_parts(csr)
+    return csr_matrix((_onp.ones(len(data), _onp.float32),
+                       indices, indptr), shape=csr.shape)
+
+
+def dgl_graph_compact(*graphs, return_mapping=False, graph_sizes=(),
+                      num_args=None):
+    """Relabel each subgraph's vertices to remove the max_num_vertices
+    padding (parity: _contrib_dgl_graph_compact): graph i keeps its
+    first graph_sizes[i] vertices. With return_mapping the input list is
+    graphs followed by their edge-id mapping CSRs (the reference's input
+    layout); both halves are compacted."""
+    from .sparse import csr_matrix
+
+    n_graphs = len(graphs) // 2 if return_mapping else len(graphs)
+    if return_mapping and len(graphs) != 2 * n_graphs:
+        raise ValueError(
+            "return_mapping=True needs graphs followed by an equal "
+            f"number of mapping CSRs, got {len(graphs)} inputs")
+    if len(graph_sizes) != n_graphs:
+        raise ValueError(
+            f"graph_sizes must name one size per graph: got "
+            f"{len(graph_sizes)} sizes for {n_graphs} graph(s)")
+
+    def compact(g, size):
+        data, indices, indptr = _csr_parts(g)
+        size = int(size)
+        sptr = indptr[:size + 1].copy()
+        keep = int(sptr[-1])
+        return csr_matrix(
+            (data[:keep], indices[:keep], sptr), shape=(size, size))
+
+    out = [compact(g, s) for g, s in zip(graphs[:n_graphs], graph_sizes)]
+    if return_mapping:
+        out += [compact(g, s)
+                for g, s in zip(graphs[n_graphs:], graph_sizes)]
+    return out
+
+
+__all__ += ["dgl_csr_neighbor_uniform_sample",
+            "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+            "edge_id", "dgl_adjacency", "dgl_graph_compact"]

@@ -51,6 +51,7 @@ _SLOW_FILES = {
     "test_sparse_dist.py",   # 2-process distributed suites
     "test_onnx.py",          # export/import numeric roundtrips
     "test_op_sweep.py",      # 800-test registry-wide sweep (~2 min)
+    "test_c_api.py",         # builds libmxtpu + four C host programs
 }
 _SLOW_TESTS = {
     "test_graft_entry_dryrun",

@@ -409,3 +409,141 @@ def test_arange_like_repeat():
     out = nd.contrib.arange_like(x, start=1.0, step=0.5, repeat=2)
     onp.testing.assert_allclose(out.asnumpy(),
                                 [1.0, 1.0, 1.5, 1.5, 2.0, 2.0])
+
+
+# ------------------------------------------------------------ DGL ops -----
+# parity: src/operator/contrib/dgl_graph.cc (sampling ops for DGL)
+
+def _full_graph(mx):
+    import numpy as np
+
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], np.int64)
+    from mxnet_tpu.ndarray.sparse import csr_matrix
+
+    return csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_dgl_neighbor_uniform_sample():
+    """Reference docstring example (dgl_graph.cc:761): full 5-vertex
+    graph, all-vertex seed, num_neighbor=2 -> all vertices sampled,
+    sub-CSR keeps original edge values, layers valid."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    a = _full_graph(mx)
+    seed = mx.nd.array(np.arange(5), dtype="int64")
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    verts = out[0].asnumpy()
+    assert verts.shape == (6,)
+    assert verts[-1] == 5  # all five vertices sampled (all were seeds)
+    np.testing.assert_array_equal(np.sort(verts[:5]), np.arange(5))
+    sub = out[1].asnumpy()
+    assert sub.shape == (5, 5)
+    dense = a.asnumpy()
+    nz = sub != 0
+    assert nz.sum() > 0
+    np.testing.assert_array_equal(sub[nz], dense[nz])  # original values
+    # each row samples at most num_neighbor edges
+    assert (nz.sum(axis=1) <= 2).all()
+    layers = out[2].asnumpy()
+    assert ((layers == 0)[:5]).all()  # seeds are layer 0
+
+
+def test_dgl_non_uniform_sample_and_subgraph():
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    mx.random.seed(1)
+    a = _full_graph(mx)
+    prob = mx.nd.array(np.array([1, 0, 0, 0, 1], np.float32))
+    seed = mx.nd.array(np.array([0], np.int64))
+    out = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    verts, probs = out[0].asnumpy(), out[1].asnumpy()
+    n = int(verts[-1])
+    # zero-probability neighbors are never sampled: only vertex 4 can
+    # join seed 0 (vertices 1,2,3 have p=0)
+    assert set(verts[:n]) <= {0, 4}
+    assert probs[0] == 1.0
+
+    subs = mx.nd.contrib.dgl_subgraph(
+        a, mx.nd.array(np.array([0, 1, 3], np.int64)), num_args=2,
+        return_mapping=True)
+    sub, mapping = subs[0].asnumpy(), subs[1].asnumpy()
+    assert sub.shape == (3, 3)
+    # induced edges: all pairs among {0,1,3} are connected in the full
+    # graph; diagonal stays empty
+    np.testing.assert_array_equal(sub, 1 - np.eye(3))
+    # mapping carries ORIGINAL edge ids: (0->1) is edge value 1
+    assert mapping[0, 1] == 1.0
+
+
+def test_dgl_edge_id_adjacency_compact():
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    a = _full_graph(mx)
+    ids = mx.nd.contrib.edge_id(
+        a, mx.nd.array(np.array([0, 0, 2], np.int64)),
+        mx.nd.array(np.array([1, 0, 3], np.int64))).asnumpy()
+    np.testing.assert_array_equal(ids, [1, -1, 11])
+
+    adj = mx.nd.contrib.dgl_adjacency(a)
+    dense = adj.asnumpy()
+    assert set(np.unique(dense)) == {0.0, 1.0}
+    assert dense.sum() == 20
+
+    mx.random.seed(2)
+    seed = mx.nd.array(np.array([0, 1], np.int64))
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    n = int(out[0].asnumpy()[-1])
+    compacted = mx.nd.contrib.dgl_graph_compact(
+        out[1], num_args=1, return_mapping=False,
+        graph_sizes=(n,))[0]
+    assert compacted.shape == (n, n)
+
+
+def test_dgl_sample_local_indices_nonidentity():
+    """Sub-CSR rows AND columns are LOCAL positions (review regression):
+    seeds {3,4} with a capped vertex budget produce a consistent local
+    matrix, and compacting stays in bounds."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    mx.random.seed(5)
+    a = _full_graph(mx)
+    seed = mx.nd.array(np.array([3, 4], np.int64))
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=3)
+    verts = out[0].asnumpy()
+    n = int(verts[-1])
+    sub = out[1].asnumpy()
+    nz_rows, nz_cols = np.nonzero(sub)
+    assert nz_cols.max(initial=0) < n  # local, in-bounds columns
+    dense = a.asnumpy()
+    for r, c in zip(nz_rows, nz_cols):
+        # local (r, c) must carry the ORIGINAL edge value between the
+        # corresponding global vertices
+        assert sub[r, c] == dense[verts[r], verts[c]]
+    compacted = mx.nd.contrib.dgl_graph_compact(
+        out[1], num_args=1, return_mapping=False,
+        graph_sizes=(n,))[0]
+    assert compacted.asnumpy().shape == (n, n)
+    import pytest
+
+    with pytest.raises(ValueError, match="graph_sizes"):
+        mx.nd.contrib.dgl_graph_compact(out[1], num_args=1)
