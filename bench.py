@@ -24,6 +24,11 @@ import time
 
 import numpy as np
 
+# forward GFLOP/img @224x224 per model (public model FLOP counts)
+_FWD_GFLOPS = {"resnet50_v1": 4.09, "resnet50_v2": 4.09,
+               "resnet18_v1": 1.82, "resnet101_v1": 7.8,
+               "resnet152_v1": 11.5, "vgg16": 15.5, "alexnet": 0.71}
+
 
 def main():
     import mxnet_tpu as mx
@@ -75,7 +80,7 @@ def main():
     elapsed = time.perf_counter() - start
     throughput = batch * iters / elapsed
 
-    print(json.dumps({
+    line = {
         "metric": f"{model}_infer_bs{batch}_{dtype}",
         "value": round(throughput, 2),
         "unit": "img/s",
@@ -83,7 +88,13 @@ def main():
         # fallback runs must not masquerade as chip numbers in the
         # metric series
         "platform": ctx.device_type,
-    }), flush=True)
+    }
+    fwd_flops = _FWD_GFLOPS.get(model, 0.0) * 1e9
+    if fwd_flops and ctx.device_type != "cpu":
+        achieved = throughput * fwd_flops / 1e12
+        line["achieved_tflops"] = round(achieved, 1)
+        line["mfu"] = round(achieved / _peak_tflops(), 3)
+    print(json.dumps(line), flush=True)
 
     if not skip_train:
         # training compiles a bigger program; cap its timed loop so the
@@ -101,13 +112,8 @@ def bench_train(ctx, batch, dtype, iters, model):
     from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
 
     baseline = 363.69  # ResNet-50 bs=128 fp32 training on V100 (perf.md:254)
-    # forward GFLOP/img @224x224 per model; training ~= 3x forward
-    fwd_gflops = {"resnet50_v1": 4.09, "resnet50_v2": 4.09,
-                  "resnet18_v1": 1.82, "resnet101_v1": 7.8,
-                  "resnet152_v1": 11.5, "vgg16": 15.5, "alexnet": 0.71}
-    flops_per_img = 3 * fwd_gflops.get(model, 0.0) * 1e9
-    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", 0) or
-                        _nominal_peak_tflops())
+    flops_per_img = 3 * _FWD_GFLOPS.get(model, 0.0) * 1e9  # train ~= 3x fwd
+    peak_tflops = _peak_tflops()
 
     mx.random.seed(0)
     net = vision.get_model(model, classes=1000)
@@ -148,6 +154,16 @@ def bench_train(ctx, batch, dtype, iters, model):
             line["measured_peak_tflops"] = round(measured, 1)
             line["mfu_vs_measured"] = round(achieved / measured, 3)
     print(json.dumps(line), flush=True)
+
+
+def _peak_tflops():
+    """BENCH_PEAK_TFLOPS override when set to a positive number, else the
+    auto-detected nominal peak ("0"/unset both mean auto-detect)."""
+    try:
+        override = float(os.environ.get("BENCH_PEAK_TFLOPS", 0))
+    except ValueError:
+        override = 0.0
+    return override if override > 0 else _nominal_peak_tflops()
 
 
 def _nominal_peak_tflops():

@@ -299,3 +299,34 @@ def test_onnx_argmax_flat_and_inf_zeros_like():
     _rt(lambda s: mx.sym.ones_like(s["a"]), {"a": a_inf})
     _rt(lambda s: mx.sym.squeeze(mx.sym.expand_dims(s["a"], axis=0)),
         {"a": a})
+
+
+def test_proto_wire_format_golden_bytes():
+    """Pin the serialized wire format to spec-derived golden bytes so
+    codec drift (field numbers / wire types diverging from onnx.proto3)
+    cannot pass the self-roundtrip tests unnoticed. Field numbers
+    asserted: TensorProto{dims=1, data_type=2, raw_data=9, name=8},
+    NodeProto{input=1, output=2, name=3, op_type=4, attribute=5},
+    AttributeProto{name=1, i=3, type=20}, ModelProto{ir_version=1,
+    opset_import=8, graph=7}, OperatorSetIdProto{version=2}."""
+    t = proto.tensor("w", onp.asarray([[1.0]], onp.float32))
+    # dims: field1 PACKED varints [1,1]; data_type: field2 varint
+    # (1=FLOAT); name: field8 "w"; raw_data: field9 4 bytes LE 1.0f
+    assert t == bytes.fromhex("0a020101") + b"\x10\x01" + \
+        b"\x42\x01w" + b"\x4a\x04" + onp.float32(1.0).tobytes()
+
+    n = proto.node("Relu", ["x"], ["y"], name="r")
+    assert n == b"\x0a\x01x" + b"\x12\x01y" + b"\x1a\x01r" + \
+        b"\x22\x04Relu"
+
+    a = proto.attribute("axis", 2)
+    # name field1; i field3 varint; type field20 (=2 INT)
+    assert a == b"\x0a\x04axis" + b"\x18\x02" + b"\xa0\x01\x02"
+
+    g = proto.graph([], "g", [], [], [])
+    m = proto.model(g, opset=13)
+    # ModelProto: ir_version field1, graph field7, opset_import field8
+    assert m.startswith(b"\x08")            # ir_version varint
+    assert b"\x3a" in m                     # graph (field 7, wire 2)
+    # OperatorSetIdProto: domain field1 (empty), version field2 = 13
+    assert b"\x42\x04\x0a\x00\x10\x0d" in m  # opset_import submessage
