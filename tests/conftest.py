@@ -80,6 +80,7 @@ _SLOW_TESTS = {
     "test_transformer_finetune_example",
     "test_train_imagenet_benchmark_mode",
     "test_dcgan_example",
+    "test_matrix_factorization_example",
     "test_multi_threaded_inference_abi",
     "test_sharded_trainer_multi_precision_master_weights",
 }

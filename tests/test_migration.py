@@ -350,3 +350,12 @@ def test_dcgan_example():
     import numpy as np
 
     assert np.isfinite(d_loss) and np.isfinite(g_loss)
+
+
+def test_matrix_factorization_example():
+    """SURVEY §2.9 sparse row: MF with row_sparse user/item factors over
+    the kvstore (reference example/sparse/matrix_factorization)."""
+    mf = _example_module("sparse/matrix_factorization.py", "mf_example")
+    rmse = mf.main(["--num-epoch", "12", "--num-ratings", "3000",
+                    "--num-users", "300", "--num-items", "250"])
+    assert rmse < 1.8, rmse
