@@ -11,10 +11,16 @@ Usage:
     python benchmark/opperf.py --ops dot,softmax    # chosen ops
     python benchmark/opperf.py --all                # whole registry
     python benchmark/opperf.py --output-format json
+    python benchmark/opperf.py --dispatch           # bulking microbench
 
 Timing methodology matches the reference's profiler-driven runs: warmup
 iterations first (includes XLA compile), then `--runs` timed executions
 synchronized via wait_to_read (dispatch+device time per call).
+
+`--dispatch` measures per-op eager dispatch overhead (ns/op) on an
+elementwise op chain with engine bulking off (bulk_size=1, today's
+per-op jit dispatch) vs on (one fused XLA executable per segment) — the
+analogue of the reference's MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN A/B.
 """
 from __future__ import annotations
 
@@ -144,6 +150,47 @@ def bench_op(op_name, size, runs, warmup, with_backward=True):
     return entry
 
 
+def bench_dispatch(chain_len=16, bulk=16, size=_DEFAULT_SIZE, iters=250,
+                   warmup=40, trials=5):
+    """Per-op eager dispatch time for a `chain_len`-op elementwise chain,
+    bulk_size=1 (per-op executables) vs bulk_size=`bulk` (one fused
+    executable per segment). Each chain ends in wait_to_read, so the
+    bulked side pays its segment flush inside the timed region; median
+    over `trials` interleaved runs defends against scheduler noise."""
+    import statistics
+
+    x0 = _rand(size)
+
+    def chain():
+        x = x0
+        for _ in range(chain_len // 2):
+            x = x * 1.0001
+            x = x + 0.0001
+        x.wait_to_read()
+
+    samples = {1: [], bulk: []}
+    for _ in range(trials):
+        for bs in (1, bulk):
+            with mx.engine.bulk(bs):
+                for _ in range(warmup):
+                    chain()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    chain()
+                dt = time.perf_counter() - t0
+            samples[bs].append(dt / (iters * chain_len) * 1e9)
+    unbulked = statistics.median(samples[1])
+    bulked = statistics.median(samples[bulk])
+    return {
+        "chain_len": chain_len,
+        "bulk_size": bulk,
+        "tensor_size": size,
+        "unbulked_ns_per_op": round(unbulked, 1),
+        "bulked_ns_per_op": round(bulked, 1),
+        "improvement_pct": round((unbulked - bulked) / unbulked * 100, 1),
+    }
+
+
 def run_benchmark(ops, size=_DEFAULT_SIZE, runs=10, warmup=2):
     results = []
     for name in ops:
@@ -164,7 +211,30 @@ def main():
     parser.add_argument("--warmup", type=int, default=2)
     parser.add_argument("--output-format", type=str, default="table",
                         choices=("table", "json"))
+    parser.add_argument("--dispatch", action="store_true",
+                        help="run the engine-bulking dispatch-overhead "
+                             "microbench instead of per-op timings")
+    parser.add_argument("--chain", type=int, default=16,
+                        help="op-chain length for --dispatch")
+    parser.add_argument("--bulk", type=int, default=16,
+                        help="bulk_size for the bulked side of --dispatch")
     args = parser.parse_args()
+
+    if args.dispatch:
+        res = bench_dispatch(chain_len=args.chain, bulk=args.bulk,
+                             size=args.size)
+        if args.output_format == "json":
+            print(json.dumps(res, indent=2))
+        else:
+            print(f"{args.chain}-op elementwise chain, tensor size "
+                  f"{args.size}, CPU backend")
+            print(f"  bulk_size=1           : "
+                  f"{res['unbulked_ns_per_op']:>10.1f} ns/op")
+            print(f"  bulk_size={args.bulk:<12d}: "
+                  f"{res['bulked_ns_per_op']:>10.1f} ns/op")
+            print(f"  dispatch improvement  : "
+                  f"{res['improvement_pct']:>10.1f} %")
+        return
 
     if args.ops:
         ops = args.ops.split(",")

@@ -48,6 +48,12 @@ def is_training() -> bool:
 def set_recording(is_record: bool) -> bool:
     f = _flags()
     prev, f.recording = f.recording, is_record
+    if prev != is_record:
+        # recording-state flips are bulking sync points: a segment opened
+        # under one autograd state must not absorb ops from the other
+        from . import bulk
+
+        bulk.flush()
     return prev
 
 
@@ -186,7 +192,13 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     parity: MXAutogradBackwardEx -> Imperative::Backward (imperative.cc:280).
     """
     import jax.numpy as jnp
+
+    from . import bulk
     from .ndarray import NDArray
+
+    # backward is a sync point: pending bulk segments must execute (and
+    # stamp their per-segment tape nodes) before the tape walk
+    bulk.flush()
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -358,7 +370,10 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     the tape as a pure function and differentiates it under recording, so
     the result supports further `backward()`/`grad()` calls.
     """
+    from . import bulk
     from .ndarray import NDArray
+
+    bulk.flush()  # sync point: segments stamp tape nodes before the walk
 
     if isinstance(heads, NDArray):
         heads = [heads]

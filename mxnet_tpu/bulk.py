@@ -1,0 +1,334 @@
+"""Imperative engine bulking: fuse eager op segments into one XLA executable.
+
+Parity target: the reference's engine bulking
+(`MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN`, `src/imperative/imperative_utils.h:396`
+and `Engine::StartBulk/BulkFlush`): consecutive imperative ops are merged into
+a single engine job so non-hybridized Gluon training is not dispatch-bound.
+
+TPU-native redesign: "merge N ops into one engine job" becomes "trace N ops
+into ONE fused `jax.jit` executable". Each op call that passes the gate in
+``ndarray._invoke`` is *recorded* into the thread's open :class:`BulkSegment`
+instead of being executed: the caller receives NDArrays whose buffer is a
+:class:`LazyRef` placeholder carrying the statically inferred shape/dtype
+(via a cached ``jax.eval_shape``). The segment is compiled and executed as a
+single executable — cached per (op-sequence, static-kwargs, wiring) plan, with
+jit's own signature cache keying shapes/dtypes — when any sync point is hit:
+
+  * a concrete buffer read (``asnumpy``, ``wait_to_read``, control flow on
+    values, any raw access through the ``NDArray._data`` property),
+  * ``engine.wait_all`` / changing the bulk size / leaving ``engine.bulk``,
+  * ``autograd.backward``/``grad`` and recording-state flips,
+  * an in-place mutation (``_rebind``) — ordering + tape identity,
+  * the segment reaching ``engine.bulk_size()`` nodes (the BulkFlush analogue).
+
+Under ``autograd.record()`` a flushed segment becomes ONE tape node whose
+pullback is ``jax.vjp`` of the fused function, recomputing the forward inside
+the backward executable — the same rematerialising backward CachedOp uses
+(`cached_op.cc:990`; MXNET_BACKWARD_DO_MIRROR is the right default on TPU).
+
+Deferred-error semantics match the engine contract: an op that fails inside a
+segment raises at the flush (sync) point, not at the recording call site.
+
+Segments are thread-local. A LazyRef forced from a *different* thread than the
+recording one executes the segment directly; the producing thread's next flush
+then finds every ref already materialised (assignment is idempotent).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+from . import autograd, engine
+from . import profiler as _profiler
+
+__all__ = ["LazyRef", "BulkSegment", "record", "flush", "active",
+           "pending_ops"]
+
+_tls = threading.local()
+
+# plan -> jitted fused forward; (plan, taped_idx) -> jitted fused vjp.
+# jax.jit's own signature cache keys shapes/dtypes below these.
+_FUSED_CACHE = {}
+_VJP_CACHE = {}
+
+_Tracer = None  # lazily bound jax.core.Tracer (keep jax import off cold path)
+
+
+class LazyRef:
+    """Placeholder buffer for one output of a pending bulk segment.
+
+    Shape/dtype are known statically (eval_shape), so metadata queries on a
+    lazy NDArray never force execution; only value reads do."""
+
+    __slots__ = ("segment", "flat_idx", "shape", "dtype", "taped", "_value")
+
+    def __init__(self, segment, flat_idx, shape, dtype, taped):
+        self.segment = segment
+        self.flat_idx = flat_idx
+        self.shape = shape
+        self.dtype = dtype
+        self.taped = taped
+        self._value = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    def force(self):
+        """Materialise: flush the owning segment, return the concrete array."""
+        if self._value is None:
+            seg = self.segment
+            if getattr(_tls, "seg", None) is seg:
+                _tls.seg = None
+            seg.run()
+        return self._value
+
+
+class BulkSegment:
+    """An open sequence of recorded op calls awaiting fused execution."""
+
+    __slots__ = ("recording", "steps", "plan", "ext_raws", "ext_handles",
+                 "ext_index", "refs", "handles", "error")
+
+    def __init__(self, recording):
+        self.recording = recording  # autograd state the segment was opened in
+        self.steps = []        # (bound_fn, slots, single) per recorded op
+        self.plan = []         # hashable (op, kw_key, slots, n_out) per op
+        self.ext_raws = []     # concrete jax.Array inputs from outside
+        self.ext_handles = []  # their NDArray handles (tape entries / replay)
+        self.ext_index = {}    # id(handle) -> ext position (dedup)
+        self.refs = []         # flat LazyRef list across all steps
+        self.handles = []      # weakrefs to the wrapped output NDArrays
+        self.error = None
+
+    # ----------------------------------------------------------- execute ---
+    def run(self):
+        """Execute the segment as one fused jitted call and fill the refs.
+
+        Only outputs whose NDArray handle is still alive become executable
+        outputs — dropped intermediates stay internal to the XLA program, so
+        the compiler fuses straight through them (this is where the bulking
+        win comes from; returning every intermediate would force XLA to
+        materialise each one). A fully dead segment is skipped outright —
+        the engine-level analogue of XLA dead-code elimination.
+
+        Idempotent; a failure is stored and re-raised on later forces (the
+        deferred-exception-at-sync-point contract)."""
+        if self.error is not None:
+            raise self.error
+        if not self.plan:
+            return
+        live = [i for i, wh in enumerate(self.handles)
+                if wh() is not None]
+        if not live:
+            return
+        import jax
+
+        prof = _profiler._REC_IMPERATIVE
+        t0 = _profiler._now_us() if prof else None
+        live_t = tuple(live)
+        plan_key = (tuple(self.plan), live_t)
+        fused = _FUSED_CACHE.get(plan_key)
+        if fused is None:
+            fused = _FUSED_CACHE[plan_key] = jax.jit(
+                _build_fused(self.steps, live_t))
+        try:
+            outs = fused(*self.ext_raws)
+        except Exception as exc:
+            self.error = exc
+            raise
+        for i, val in zip(live, outs):
+            self.refs[i]._value = val
+        if self.recording:
+            taped_idx = tuple(i for i in live if self.refs[i].taped)
+            if taped_idx:
+                self._record_tape(plan_key, taped_idx)
+        if prof:
+            _profiler.record_bulk_segment(t0, _profiler._now_us() - t0,
+                                          [k[0] for k in plan_key[0]])
+
+    def _record_tape(self, plan_key, taped_idx):
+        """One tape node for the whole segment (parity: CachedOp recording a
+        single node for its call). The pullback is jax.vjp of the fused
+        function over the taped outputs, jitted and cached per plan — the
+        forward is rematerialised inside the backward executable."""
+        entries = autograd.make_entries(self.ext_handles)
+        tape_fn = _build_fused(self.steps, taped_idx)
+        vkey = (plan_key, taped_idx)
+        vjp_exec = _VJP_CACHE.get(vkey)
+        if vjp_exec is None:
+            import jax
+
+            def _vjp_run(ext, cots, _fn=tape_fn):
+                _, pull = jax.vjp(_fn, *ext)
+                return pull(tuple(cots))
+
+            vjp_exec = _VJP_CACHE[vkey] = jax.jit(_vjp_run)
+        ext_t = tuple(self.ext_raws)
+
+        def vjp_fn(cots, _exec=vjp_exec, _ext=ext_t):
+            cots = cots if isinstance(cots, tuple) else (cots,)
+            return _exec(_ext, cots)
+
+        node = autograd.TapeNode(
+            "BulkSegment[%d]" % len(self.plan), vjp_fn, entries,
+            len(taped_idx),
+            [self.refs[i].shape for i in taped_idx],
+            [self.refs[i]._value.dtype for i in taped_idx], fwd_fn=tape_fn)
+        for pos, i in enumerate(taped_idx):
+            h = self.handles[i]()
+            if h is not None:
+                h._tape_node = node
+                h._tape_index = pos
+
+
+def _build_fused(steps, out_idx):
+    """Pure fn(*ext) -> tuple of the flat outputs selected by `out_idx`.
+    The python loop runs only while jax traces; the cached executable is
+    one XLA program, and unselected intermediates never materialise."""
+    steps = list(steps)
+
+    def fused(*ext):
+        flat = []
+        for fn, slots, single in steps:
+            args = [ext[i] if k == 0 else flat[i] for k, i in slots]
+            out = fn(*args)
+            if single:
+                flat.append(out)
+            else:
+                flat.extend(out)
+        return tuple(flat[i] for i in out_idx)
+
+    return fused
+
+
+def _wrap_lazy(wrap, ref):
+    """Construct an output array handle around a LazyRef without the
+    NDArray.__init__ device-put path."""
+    nd = object.__new__(wrap)
+    nd._buf = ref
+    nd._grad = None
+    nd._grad_req = "null"
+    nd._tape_node = None
+    nd._tape_index = 0
+    nd._fresh_grad = False
+    return nd
+
+
+# ------------------------------------------------------------- module API --
+
+def active() -> bool:
+    return getattr(_tls, "seg", None) is not None
+
+
+def pending_ops() -> int:
+    """Number of ops recorded in the current (unflushed) segment."""
+    seg = getattr(_tls, "seg", None)
+    return len(seg.plan) if seg is not None else 0
+
+
+def flush() -> None:
+    """Execute and close the thread's open segment (the BulkFlush analogue).
+    No-op when nothing is pending."""
+    seg = getattr(_tls, "seg", None)
+    if seg is None:
+        return
+    _tls.seg = None
+    seg.run()
+
+
+def record(op, kwargs, kw_key, nd_inputs, wrap, size):
+    """Try to append one imperative op call to the current segment.
+
+    Returns the wrapped lazy output(s), or None when the call is not
+    bulkable — dynamic-output-shape (eager) ops, unhashable kwargs, tracer
+    inputs (already inside a CachedOp trace), or outputs of another
+    thread's pending segment — in which case the caller falls through to
+    the per-op dispatch path (whose buffer reads flush as needed).
+    """
+    if op.eager or (kw_key is None and kwargs):
+        return None
+    global _Tracer
+    if _Tracer is None:
+        from jax.core import Tracer as _T
+
+        _Tracer = _T
+    seg = getattr(_tls, "seg", None)
+    recording = autograd.is_recording()
+    if seg is not None and seg.recording != recording:
+        # belt-and-braces: set_recording flushes on flips, but a segment
+        # opened under a different autograd state must never mix
+        flush()
+        seg = None
+    n_ext = len(seg.ext_raws) if seg is not None else 0
+    slots, in_sig = [], []
+    staged = None  # (handle, raw) inputs to commit; lazy — most calls hit
+    any_tape = False
+    for x in nd_inputs:
+        buf = getattr(x, "_buf", None)
+        if type(buf) is LazyRef and buf._value is None:
+            if buf.segment is not seg:
+                return None
+            slots.append((1, buf.flat_idx))
+            in_sig.append((buf.shape, buf.dtype))
+            any_tape = any_tape or buf.taped
+            continue
+        if type(buf) is LazyRef:
+            raw = buf._value
+        elif buf is None:  # sparse storage: dense view
+            raw = x._data
+        else:
+            raw = buf
+        if isinstance(raw, _Tracer):
+            return None
+        pos = seg.ext_index.get(id(x)) if seg is not None else None
+        if pos is None:
+            if staged is None:
+                staged = {}
+            hit = staged.get(id(x))
+            if hit is None:
+                pos = n_ext + len(staged)
+                staged[id(x)] = (pos, x, raw)
+            else:
+                pos = hit[0]
+        slots.append((0, pos))
+        s = raw.shape  # jax arrays expose shape as a tuple already
+        in_sig.append((s if type(s) is tuple else tuple(s), raw.dtype))
+        if recording and (x._tape_node is not None
+                          or x._grad_req != "null"):
+            any_tape = True
+    try:
+        avals, single = op.output_avals(tuple(in_sig), kwargs, kw_key)
+    except Exception:
+        return None  # shape inference failed: let the normal path raise
+    if seg is None:
+        seg = BulkSegment(recording)
+        _tls.seg = seg
+    if staged is not None:
+        for _, x, raw in staged.values():
+            seg.ext_index[id(x)] = len(seg.ext_raws)
+            seg.ext_raws.append(raw)
+            seg.ext_handles.append(x)
+    taped = recording and op.differentiable and any_tape
+    slots = tuple(slots)
+    seg.steps.append((op.partial(kwargs, kw_key), slots, single))
+    seg.plan.append((op.name, kw_key, slots, len(avals)))
+    outs = []
+    for av in avals:
+        ref = LazyRef(seg, len(seg.refs), tuple(av.shape), av.dtype, taped)
+        nd = _wrap_lazy(wrap, ref)
+        seg.refs.append(ref)
+        # weak: a dropped intermediate must not be kept alive (and not be
+        # materialised) by the segment that produced it
+        seg.handles.append(weakref.ref(nd))
+        outs.append(nd)
+    if len(seg.plan) >= size:
+        flush()
+    return outs[0] if single else tuple(outs)

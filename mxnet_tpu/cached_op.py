@@ -152,9 +152,11 @@ class CachedOp:
 
         if _amp_core.cache_stale(self):
             self._cache.clear()
+        from .ops.registry import dtype_str as _dt
+
         key = (tuple(spec_key(s) for s in spec),
-               tuple((tuple(r.shape), str(r.dtype)) for r in in_raws),
-               tuple((tuple(r.shape), str(r.dtype)) for r in param_raws),
+               tuple((tuple(r.shape), _dt(r.dtype)) for r in in_raws),
+               tuple((tuple(r.shape), _dt(r.dtype)) for r in param_raws),
                training)
         entry = self._cache.get(key)
         if entry is None:

@@ -15,9 +15,12 @@ for this layer is:
   * deferred exception semantics — an op that fails inside the runtime
     surfaces at the *next sync point*, like `ThreadedVar::var_exception`
     (`src/engine/threaded_engine.cc:383-437`),
-  * the bulking knobs (`set_bulk_size`) which on TPU map to "how much work is
-    traced into one XLA executable" — kept for API parity, consumed by
-    CachedOp.
+  * the bulking knobs (`set_bulk_size` / `bulk()`), which on TPU mean "how
+    many consecutive imperative ops are traced into one fused XLA
+    executable" — LIVE, not parity stubs: sizes > 1 route eager dispatch
+    through the deferred segment recorder in ``mxnet_tpu.bulk`` (the
+    BulkFlush analogue). Default is 1 (per-op dispatch) unless
+    ``MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN`` is set.
 
 A `NaiveEngine`-style fully synchronous mode (`MXNET_ENGINE_TYPE=NaiveEngine`)
 is honoured by blocking after every op — the same race-bisection debug tool
@@ -28,7 +31,8 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ["wait_all", "is_naive", "set_bulk_size", "bulk", "bulk_size"]
+__all__ = ["wait_all", "is_naive", "set_bulk_size", "bulk", "bulk_size",
+           "bulk_pending"]
 
 _tls = threading.local()
 
@@ -44,8 +48,10 @@ def wait_all() -> None:
     errors (e.g. a failed TPU launch) are raised here, matching the
     reference's exception-at-sync-point semantics.
     """
+    from . import bulk as _bulk
     import jax
 
+    _bulk.flush()  # pending bulk segments execute before the barrier
     # effects_barrier drains all dispatched computations on all backends.
     jax.effects_barrier()
 
@@ -64,25 +70,58 @@ def maybe_sync(arrays) -> None:
 
 # -- bulking knobs (parity: MXEngineSetBulkSize / mx.engine.bulk) ------------
 
+_env_bulk = None  # MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN, parsed once
+
+
 def bulk_size() -> int:
-    return getattr(_tls, "bulk_size", int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15)))
+    """The current bulking segment limit (<= 1 means per-op dispatch).
+
+    NaiveEngine forces 1: fully synchronous per-op execution is the whole
+    point of that debug mode, so segments must never form under it. The
+    naive check is deferred until a size > 1 is requested so the common
+    per-op dispatch path pays no environment read."""
+    size = getattr(_tls, "bulk_size", None)
+    if size is None:
+        global _env_bulk
+        if _env_bulk is None:
+            _env_bulk = int(os.environ.get(
+                "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 1))
+        size = _env_bulk
+    if size > 1 and is_naive():
+        return 1
+    return size
 
 
 def set_bulk_size(size: int) -> int:
     """Set the bulking segment limit; returns the previous value.
 
-    On TPU, bulking (merging consecutive ops into one engine job,
-    `GraphExecutor::BulkOpSegs`) is subsumed by whole-trace XLA compilation;
-    the knob is kept so reference code runs unchanged and is consulted by the
-    imperative fast path when deciding how aggressively to fuse.
+    Sizes > 1 make the imperative fast path accumulate consecutive op calls
+    into one fused XLA executable (mxnet_tpu.bulk, the analogue of
+    `GraphExecutor::BulkOpSegs` / engine bulking). Changing the size is a
+    sync point: any pending segment is flushed first.
     """
-    prev = bulk_size()
+    from . import bulk as _bulk
+
+    _bulk.flush()
+    prev = getattr(_tls, "bulk_size", None)
+    if prev is None:
+        prev = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 1))
     _tls.bulk_size = int(size)
     return prev
 
 
+def bulk_pending() -> int:
+    """Ops recorded in the current thread's open bulk segment (0 when
+    idle) — observability hook used by tests and the profiler story."""
+    from . import bulk as _bulk
+
+    return _bulk.pending_ops()
+
+
 class bulk:
-    """Context manager parity for ``mx.engine.bulk(size)``."""
+    """Context manager parity for ``mx.engine.bulk(size)``. Entering and
+    leaving the scope are both sync points (leave flushes the segment the
+    scope accumulated, like the reference's bulk scope)."""
 
     def __init__(self, size: int):
         self.size = size

@@ -637,13 +637,25 @@ class ImageRecordIter(DataIter):
         else:
             batch_u8, bad = decoded
             if bad:
-                # keep the native layer's graceful zero-fill for the few
-                # corrupt records (reference logs and continues too)
-                import warnings
+                # mixed batches: the native libjpeg path rejects non-JPEG
+                # payloads (PNGs, exotic JPEG variants) record by record.
+                # Retry just the failed records through PIL instead of
+                # zero-filling the slot; only records PIL also rejects
+                # (genuinely corrupt) keep the graceful zero-fill + warning
+                # (reference logs and continues too).
+                still_bad = []
+                for i in bad:
+                    try:
+                        batch_u8[i] = self._decode_batch_py(
+                            [bufs[i]], dh, dw)[0]
+                    except Exception:
+                        still_bad.append(i)
+                if still_bad:
+                    import warnings
 
-                warnings.warn(
-                    f"ImageRecordIter: {len(bad)} corrupt image(s) in "
-                    "batch zero-filled", stacklevel=2)
+                    warnings.warn(
+                        f"ImageRecordIter: {len(still_bad)} corrupt "
+                        "image(s) in batch zero-filled", stacklevel=2)
         if self._rand_crop:
             n = batch_u8.shape[0]
             ys = self._rng.randint(0, dh - h + 1, n)

@@ -25,6 +25,7 @@ import numbers
 import numpy as _np
 
 from .. import _amp_core, autograd, engine
+from .. import bulk as _bulk
 from .. import profiler as _profiler
 from ..base import MXNetError, canonical_dtype
 from ..context import Context, current_context
@@ -65,12 +66,19 @@ def _jax_put(value, ctx: Context | None, dtype=None):
 
 
 class NDArray:
-    """An async, device-resident, mutable-by-rebinding tensor handle."""
+    """An async, device-resident, mutable-by-rebinding tensor handle.
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_tape_node", "_tape_index",
+    The buffer slot ``_buf`` holds either a concrete ``jax.Array`` or a
+    ``bulk.LazyRef`` — a placeholder for the output of a pending bulk
+    segment. ALL value reads go through the ``_data`` property, which
+    materialises lazily (flushing the segment: the sync-point contract);
+    shape/dtype/size/ndim are known statically and never force."""
+
+    __slots__ = ("_buf", "_grad", "_grad_req", "_tape_node", "_tape_index",
                  "_fresh_grad", "__weakref__")
 
     _is_np_shape = False
+    _np_frontend = False  # mx.np.ndarray overrides; read on the hot path
 
     def __init__(self, data, ctx=None, dtype=None):
         import jax
@@ -79,7 +87,7 @@ class NDArray:
             data = data._data
         if not isinstance(data, jax.Array) or ctx is not None or dtype is not None:
             data = _jax_put(data, ctx, dtype)
-        self._data = data
+        self._buf = data
         self._grad = None
         self._grad_req = "null"
         self._tape_node = None
@@ -88,28 +96,40 @@ class NDArray:
 
     # -------------------------------------------------- basic properties ---
     @property
+    def _data(self):
+        """The concrete jax.Array — a sync point for lazy buffers."""
+        buf = self._buf
+        if type(buf) is _bulk.LazyRef:
+            buf = self._buf = buf.force()
+        return buf
+
+    @_data.setter
+    def _data(self, value):
+        self._buf = value
+
+    @property
     def data(self):
         """The underlying jax.Array (read-only view of current value)."""
         return self._data
 
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._buf.shape)
 
     @property
     def dtype(self):
-        dt = self._data.dtype
+        dt = self._buf.dtype
         import jax.numpy as jnp
 
         return jnp.bfloat16 if dt == jnp.bfloat16 else _np.dtype(dt.name)
 
     @property
     def size(self):
-        return int(self._data.size)
+        return int(self._buf.size)
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return self._buf.ndim
 
     @property
     def context(self) -> Context:
@@ -241,6 +261,11 @@ class NDArray:
         the reference enforces ("Inplace operations ... not supported when
         recording with autograd").
         """
+        if _bulk.active():
+            # mutation is a sync point: pending segment ops must read the
+            # pre-mutation value, and tape entries must classify handles
+            # before the rebind clears their tape identity
+            _bulk.flush()
         if autograd.is_recording() and self._tape_node is not None:
             raise MXNetError(
                 "Inplace operations (+=, -=, x[:]=y) are not supported on "
@@ -592,13 +617,25 @@ def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
     class (NDArray, or mx.np.ndarray for the NumPy frontend)."""
     if wrap is None:
         # np-frontend arrays propagate their class through any op
-        wrap = next((type(x) for x in nd_inputs
-                     if getattr(type(x), "_np_frontend", False)), NDArray)
+        wrap = NDArray
+        for x in nd_inputs:
+            if x._np_frontend:
+                wrap = type(x)
+                break
     prof_t0 = _profiler._now_us() if _profiler._REC_IMPERATIVE else None
     op = _reg.get(op_name)
     # dmlc::Parameter analogue: structured validation + string coercion;
     # the frozen key is reused by bound() (one freeze per call)
     kwargs, _kw_key = op.checked(kwargs)
+    if out is None and not _amp_core.ACTIVE:
+        _bs = engine.bulk_size()
+        if _bs > 1:
+            # engine bulking: defer into the segment recorder; the fused
+            # executable runs at the next sync point (one segment event is
+            # emitted to the profiler there instead of per-op events)
+            bulked = _bulk.record(op, kwargs, _kw_key, nd_inputs, wrap, _bs)
+            if bulked is not None:
+                return bulked
     raws = [x._data for x in nd_inputs]
     if _amp_core.ACTIVE:
         raws = _amp_core.cast_inputs(op_name, raws)
@@ -650,8 +687,11 @@ def _invoke_fn(fn, name, nd_inputs, kwargs, wrap=None):
     """Invoke an ad-hoc pure function as if it were an op (used by fancy
     indexing and frontend helpers)."""
     if wrap is None:
-        wrap = next((type(x) for x in nd_inputs
-                     if getattr(type(x), "_np_frontend", False)), NDArray)
+        wrap = NDArray
+        for x in nd_inputs:
+            if x._np_frontend:
+                wrap = type(x)
+                break
     raws = [x._data for x in nd_inputs]
     if autograd.is_recording() and autograd.any_on_tape(nd_inputs):
         import jax

@@ -36,8 +36,10 @@ class _Ctx:
         self.nodes = []
         self.initializers = []
         self.counter = 0
+        self.op_types = set()  # emitted ONNX op types (opset selection)
 
     def emit(self, op_type, inputs, outputs, **attrs):
+        self.op_types.add(op_type)
         self.nodes.append(proto.node(op_type, inputs, outputs, **attrs))
 
     def const(self, base, arr):
@@ -294,9 +296,17 @@ def _log_softmax(ctx, name, ins, out, attrs):
 for _mx, _ox in [("elemwise_maximum", "Max"), ("broadcast_maximum", "Max"),
                  ("elemwise_minimum", "Min"), ("broadcast_minimum", "Min"),
                  ("elemwise_power", "Pow"), ("broadcast_power", "Pow"),
-                 ("elemwise_mod", "Mod"), ("broadcast_mod", "Mod"),
                  ("batch_dot", "MatMul")]:
     register_translation(_mx)(_binary(_ox))
+
+
+@register_translation("elemwise_mod")
+@register_translation("broadcast_mod")
+def _mod(ctx, name, ins, out, attrs):
+    # ONNX Mod with the default fmod=0 is integer-only; exported graph
+    # tensors are floating point (inputs/params are declared float32), so
+    # fmod=1 (C fmod semantics) is the only spec-valid encoding
+    ctx.emit("Mod", ins[:2], [out], fmod=1)
 
 
 def _compare(onnx_op):
@@ -674,6 +684,11 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
             out_name[(id(entry_node), idx)], _np.float32, None))
     g = proto.graph(ctx.nodes, "mxnet_tpu_model", initializers,
                     graph_inputs, outputs)
+    # LayerNormalization only exists in the default domain from opset 17;
+    # declaring 13 with it present makes the file spec-invalid (checkers
+    # and strict runtimes reject it). Everything else we emit is opset-13
+    # compatible, so only bump when the node is actually in the graph.
+    opset = 17 if "LayerNormalization" in ctx.op_types else 13
     with open(onnx_file_path, "wb") as f:
-        f.write(proto.model(g))
+        f.write(proto.model(g, opset=opset))
     return onnx_file_path

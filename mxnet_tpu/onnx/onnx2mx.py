@@ -221,9 +221,28 @@ def _squeeze_imp(sym, ins, attrs, name):
     return sym.squeeze(ins[0], name=name, **kw)
 
 
+def _unsqueeze_axes(sym, data, axes, name):
+    """Multi-axis Unsqueeze as a chain of expand_dims. Axes index the
+    OUTPUT shape, so inserting in ascending order keeps every later axis
+    valid in final coordinates. Mixed negative multi-axis forms would
+    need the input rank (symbols are unranked here) — rejected."""
+    axes = [int(a) for a in axes]
+    if len(axes) > 1 and any(a < 0 for a in axes):
+        raise NotImplementedError(
+            f"ONNX Unsqueeze with multiple negative axes {axes} needs "
+            "rank information; normalize the axes in the source model")
+    axes = sorted(axes)
+    out = data
+    for i, ax in enumerate(axes):
+        out = sym.expand_dims(
+            out, axis=ax,
+            name=name if i == len(axes) - 1 else f"{name}_pre{i}")
+    return out
+
+
 @register_import("Unsqueeze")
 def _unsqueeze_imp(sym, ins, attrs, name):
-    return sym.expand_dims(ins[0], axis=int(attrs["axes"][0]), name=name)
+    return _unsqueeze_axes(sym, ins[0], attrs["axes"], name)
 
 
 @register_import("Not")
@@ -380,9 +399,9 @@ def import_model(model_file):
             out = sym_mod.Reshape(as_sym(n["input"][0], name), shape=shape,
                                   name=name)
         elif op == "Unsqueeze" and len(n["input"]) == 2:
-            out = sym_mod.expand_dims(
-                as_sym(n["input"][0], name),
-                axis=_init_ints(n["input"][1])[0], name=name)
+            # opset>=13 axes-as-input form; may carry several axes
+            out = _unsqueeze_axes(sym_mod, as_sym(n["input"][0], name),
+                                  _init_ints(n["input"][1]), name)
         elif op == "Squeeze" and len(n["input"]) == 2:
             out = sym_mod.squeeze(
                 as_sym(n["input"][0], name),

@@ -37,6 +37,9 @@ _REGISTRY: Dict[str, "Operator"] = {}
 def _freeze(value):
     """Make kwargs hashable for the executable cache key."""
     if isinstance(value, dict):
+        if len(value) == 1:  # scalar-op hot path: skip the sort machinery
+            ((k, v),) = value.items()
+            return ((k, _freeze(v)),)
         return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
     if isinstance(value, (list, tuple)):
         return tuple(_freeze(v) for v in value)
@@ -78,6 +81,8 @@ class Operator:
         self._schema = None
         self._jit_cache: Dict = {}
         self._check_cache: Dict = {}
+        self._partial_cache: Dict = {}  # kw_key -> fn with kwargs bound
+        self._aval_cache: Dict = {}     # (kw_key, input avals) -> out avals
 
     @property
     def schema(self):
@@ -114,6 +119,43 @@ class Operator:
             # unhashable value (array kwarg) — validate without caching
             return self.schema.validate(kwargs), None
 
+    def partial(self, kwargs: dict, key=False) -> Callable:
+        """`fn` with these static kwargs bound, cached on the frozen key
+        (one functools.partial per distinct hyper-parameter set — the
+        imperative/bulking fast paths call this per op invocation)."""
+        if not kwargs:
+            return self.fn
+        if key is False:
+            try:
+                key = _freeze(kwargs)
+            except TypeError:
+                key = None
+        if key is None:
+            return functools.partial(self.fn, **kwargs)
+        hit = self._partial_cache.get(key)
+        if hit is None:
+            hit = self._partial_cache[key] = functools.partial(self.fn,
+                                                               **kwargs)
+        return hit
+
+    def output_avals(self, in_sig, kwargs: dict, key):
+        """(output ShapeDtypeStructs tuple, single?) for inputs with the
+        given (shape, dtype) signature — cached abstract shape inference
+        (FInferShape/FInferType for the bulking recorder: dispatch cost
+        after the first call is one dict lookup, no tracing)."""
+        sig = (key, in_sig)
+        hit = self._aval_cache.get(sig)
+        if hit is None:
+            import jax
+
+            outs = jax.eval_shape(self.partial(kwargs, key),
+                                  *[jax.ShapeDtypeStruct(s, d)
+                                    for s, d in in_sig])
+            single = not isinstance(outs, (tuple, list))
+            hit = self._aval_cache[sig] = (
+                (outs,) if single else tuple(outs), single)
+        return hit
+
     def bound(self, kwargs: dict, _key=False) -> Callable:
         """A jitted executable for these static kwargs (cached). `_key`
         is an optional precomputed `_freeze(kwargs)` (from `checked`);
@@ -123,7 +165,7 @@ class Operator:
         if self.eager:
             # data-dependent output shape (nonzero/unique/...): run the
             # emitter directly on concrete arrays, never under jit
-            return functools.partial(self.fn, **kwargs)
+            return self.partial(kwargs, _key)
         if _key is False:
             try:
                 _key = _freeze(kwargs)
@@ -139,11 +181,7 @@ class Operator:
             pass
         except TypeError:
             return functools.partial(self.fn, **kwargs)
-        fn = self.fn
-        if kwargs:
-            jitted = jax.jit(functools.partial(fn, **kwargs))
-        else:
-            jitted = jax.jit(fn)
+        jitted = jax.jit(self.partial(kwargs, key))
         self._jit_cache[key] = jitted
         return jitted
 
@@ -173,6 +211,19 @@ def register(name: str, num_outputs: Optional[int] = None, differentiable: bool 
         return op
 
     return deco
+
+
+_DTYPE_STR: Dict = {}
+
+
+def dtype_str(dt) -> str:
+    """Memoised str(dtype) — dispatch-path cache-key builders (CachedOp,
+    bulking) stringify the same handful of dtype objects millions of
+    times; one dict hit replaces repeated __str__ calls."""
+    s = _DTYPE_STR.get(dt)
+    if s is None:
+        s = _DTYPE_STR[dt] = str(dt)
+    return s
 
 
 def get(name: str) -> Operator:

@@ -158,6 +158,17 @@ def record_event(name, start_us, dur_us, cat="operator", tid=None,
             agg[3] = max(agg[3], dur_us)
 
 
+def record_bulk_segment(start_us, dur_us, op_names):
+    """One complete event per flushed bulk segment (engine bulking,
+    mxnet_tpu.bulk): op count + fused op list ride in args so traces show
+    what each fused XLA executable contains — the observability the
+    reference loses when ops merge into one engine job is kept here."""
+    record_event(f"BulkSegment[{len(op_names)}]", start_us, dur_us,
+                 cat="bulk",
+                 args={"op_count": len(op_names),
+                       "ops": ",".join(op_names)})
+
+
 def record_instant(name, cat="instant", args=None):
     ev = {"name": name, "cat": cat, "ph": "i", "pid": os.getpid(),
           "tid": threading.get_ident(), "ts": _now_us(), "s": "p"}

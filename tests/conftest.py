@@ -20,7 +20,13 @@ import jax
 
 if os.environ.get("MXNET_TEST_DEVICE", "cpu").startswith("cpu"):
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # jax < 0.5 spells this flag via XLA_FLAGS; still early enough as
+        # long as no backend has been initialised
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
 
 
 import numpy as _onp

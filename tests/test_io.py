@@ -558,3 +558,30 @@ def test_image_record_iter_corrupt_record_zero_filled(tmp_path):
     assert any("corrupt" in str(x.message) for x in w)
     assert np.all(batch[2] == 0)
     assert batch[1].any()
+
+
+def test_image_record_iter_failed_records_retry_pil(tmp_path, monkeypatch):
+    """Records the native JPEG decoder rejects in a mixed batch are
+    retried individually through PIL, not zero-filled."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import native
+
+    rec = _write_jpeg_rec(str(tmp_path / "d"), n=4, hw=32)
+
+    def fake_decode(bufs, dh, dw, n_threads=0):
+        # pretend the native path exists but rejected record 1
+        return np.zeros((len(bufs), dh, dw, 3), np.uint8), [1]
+
+    monkeypatch.setattr(native, "decode_jpeg_batch", fake_decode)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                               batch_size=4, prefetch_buffer=0)
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        batch = it.next().data[0].asnumpy()
+    assert not any("corrupt" in str(x.message) for x in w)
+    assert batch[1].any()          # slot 1 recovered via PIL
+    assert not batch[0].any()      # untouched native zeros stay (fake)

@@ -330,3 +330,65 @@ def test_proto_wire_format_golden_bytes():
     assert b"\x3a" in m                     # graph (field 7, wire 2)
     # OperatorSetIdProto: domain field1 (empty), version field2 = 13
     assert b"\x42\x04\x0a\x00\x10\x0d" in m  # opset_import submessage
+
+
+# --------------------------------------------- opset / Mod / Unsqueeze -----
+
+def test_opset_bumped_to_17_for_layer_norm():
+    """LayerNormalization exists only from opset 17; plain graphs must
+    keep declaring 13 (maximum runtime compatibility)."""
+    d = tempfile.mkdtemp()
+    x = mx.sym.var("x")
+    ln = mx.sym.LayerNorm(x, mx.sym.var("g"), mx.sym.var("b"), name="ln")
+    p_ln = mx_onnx.export_model(ln, {}, in_shapes=[(2, 6), (6,), (6,)],
+                                onnx_file_path=os.path.join(d, "ln.onnx"))
+    assert proto.parse_model(open(p_ln, "rb").read())["opset"] == 17
+
+    plain = mx.sym.relu(mx.sym.var("x"), name="r")
+    p_plain = mx_onnx.export_model(plain, {}, in_shapes=[(2, 6)],
+                                   onnx_file_path=os.path.join(d, "p.onnx"))
+    assert proto.parse_model(open(p_plain, "rb").read())["opset"] == 13
+
+
+def test_mod_exports_with_fmod_for_float():
+    """float Mod must carry fmod=1 (fmod=0 is integer-only per spec)."""
+    d = tempfile.mkdtemp()
+    out = mx.sym.broadcast_mod(mx.sym.var("a"), mx.sym.var("b"), name="m")
+    path = mx_onnx.export_model(out, {}, in_shapes=[(2, 3), (2, 3)],
+                                onnx_file_path=os.path.join(d, "m.onnx"))
+    g = proto.parse_model(open(path, "rb").read())["graph"]
+    mod_nodes = [n for n in g["nodes"] if n["op_type"] == "Mod"]
+    assert mod_nodes and int(mod_nodes[0]["attrs"]["fmod"]) == 1
+
+
+def _import_and_eval(path, feeds):
+    sym2, args, _ = mx_onnx.import_model(path)
+    out = sym2.eval(**{k: mx.nd.array(v) for k, v in feeds.items()}, **args)
+    return (out[0] if isinstance(out, list) else out).asnumpy()
+
+
+def test_unsqueeze_multi_axis_import():
+    """ONNX Unsqueeze with several axes (attribute AND axes-input forms)
+    must expand every axis, not silently use axes[0]."""
+    src = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    d = tempfile.mkdtemp()
+
+    # attribute form (opset < 13)
+    g = proto.graph([proto.node("Unsqueeze", ["x"], ["y"], axes=[0, 3])],
+                    "g", [], [proto.value_info("x", onp.float32, (2, 3))],
+                    [proto.value_info("y", onp.float32, None)])
+    p1 = os.path.join(d, "attr.onnx")
+    open(p1, "wb").write(proto.model(g))
+    got = _import_and_eval(p1, {"x": src})
+    onp.testing.assert_array_equal(got, src.reshape(1, 2, 3, 1))
+
+    # axes-as-input form (opset >= 13)
+    g2 = proto.graph(
+        [proto.node("Unsqueeze", ["x", "ax"], ["y"])], "g",
+        [proto.tensor("ax", onp.asarray([0, 3], onp.int64))],
+        [proto.value_info("x", onp.float32, (2, 3))],
+        [proto.value_info("y", onp.float32, None)])
+    p2 = os.path.join(d, "inp.onnx")
+    open(p2, "wb").write(proto.model(g2))
+    got2 = _import_and_eval(p2, {"x": src})
+    onp.testing.assert_array_equal(got2, src.reshape(1, 2, 3, 1))

@@ -427,3 +427,132 @@ def test_ensure_live_backend_fallback_paths(monkeypatch):
         base.ensure_live_backend(timeout_s=0.1, retries=1)
     assert len(calls) == 2  # initial + one retry
     assert "MXTPU_PLATFORM" not in os.environ
+
+
+# ----------------------------------------------------------- bulking -------
+
+def _mlp():
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _run_mlp(net, x_np, bulk_size):
+    x = mx.nd.array(x_np)
+    with mx.engine.bulk(bulk_size):
+        with mx.autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        fwd = out.asnumpy()
+        grads = {k: p.grad().asnumpy()
+                 for k, p in net.collect_params().items()}
+    return fwd, grads
+
+
+def test_bulk_numerics_match_unbulked_mlp():
+    """Fused-segment execution and its one-tape-node VJP must reproduce
+    per-op dispatch numerics (forward AND parameter grads)."""
+    np.random.seed(7)
+    mx.random.seed(7)
+    net = _mlp()
+    x_np = np.random.rand(8, 12).astype(np.float32)
+    fwd_u, grads_u = _run_mlp(net, x_np, 1)       # today's per-op path
+    for p in net.collect_params().values():
+        p.zero_grad()
+    fwd_b, grads_b = _run_mlp(net, x_np, 16)      # bulked
+    np.testing.assert_allclose(fwd_b, fwd_u, rtol=1e-5, atol=1e-6)
+    assert grads_u.keys() == grads_b.keys()
+    for k in grads_u:
+        np.testing.assert_allclose(grads_b[k], grads_u[k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_bulk_flush_on_sync_points():
+    with mx.engine.bulk(8):
+        a = mx.nd.ones((4,))
+        b = a * 2
+        c = b + 1
+        assert mx.engine.bulk_pending() == 2
+        # metadata is statically known: no flush
+        assert b.shape == (4,) and str(c.dtype) == "float32"
+        assert mx.engine.bulk_pending() == 2
+        # value read flushes the whole segment
+        np.testing.assert_allclose(c.asnumpy(), np.full(4, 3.0))
+        assert mx.engine.bulk_pending() == 0
+        # waitall is a sync point
+        d = a + 5
+        assert mx.engine.bulk_pending() == 1
+        mx.nd.waitall()
+        assert mx.engine.bulk_pending() == 0
+        np.testing.assert_allclose(d.asnumpy(), np.full(4, 6.0))
+        # control flow on values forces too
+        e = (a * 3).sum()
+        assert mx.engine.bulk_pending() == 2  # _mul_scalar + sum
+        assert bool(e > 11.0)
+        assert mx.engine.bulk_pending() == 0
+        # in-place mutation is a sync point (ordering + tape identity)
+        f = a * 7
+        assert mx.engine.bulk_pending() == 1
+        a[:] = 0
+        assert mx.engine.bulk_pending() == 0
+        np.testing.assert_allclose(f.asnumpy(), np.full(4, 7.0))
+        # segment-size limit auto-flushes (BulkFlush analogue)
+        x = mx.nd.ones((4,))
+        for _ in range(9):
+            x = x * 1.5
+        assert mx.engine.bulk_pending() == 1
+        np.testing.assert_allclose(x.asnumpy(), np.full(4, 1.5 ** 9),
+                                   rtol=1e-6)
+    assert mx.engine.bulk_pending() == 0  # scope exit flushed
+
+
+def test_bulk_naive_engine_disables(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert mx.engine.bulk_size() == 1
+    with mx.engine.bulk(8):
+        assert mx.engine.bulk_size() == 1  # naive wins over the knob
+        a = mx.nd.ones((4,))
+        b = a * 2
+        assert mx.engine.bulk_pending() == 0  # executed eagerly
+        np.testing.assert_allclose(b.asnumpy(), np.full(4, 2.0))
+
+
+def test_bulk_nested_contexts(monkeypatch):
+    monkeypatch.delenv("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", raising=False)
+    monkeypatch.setattr(mx.engine, "_env_bulk", None)
+    monkeypatch.setattr(mx.engine._tls, "bulk_size", None, raising=False)
+    assert mx.engine.bulk_size() == 1  # default: per-op dispatch
+    with mx.engine.bulk(4):
+        assert mx.engine.bulk_size() == 4
+        a = mx.nd.ones((2,))
+        b = a * 2
+        assert mx.engine.bulk_pending() == 1
+        with mx.engine.bulk(0):
+            # entering the inner scope flushed the outer segment
+            assert mx.engine.bulk_pending() == 0
+            c = b + 1  # bulking off: executes per-op
+            assert mx.engine.bulk_pending() == 0
+        assert mx.engine.bulk_size() == 4  # restored
+        d = c * 3
+        assert mx.engine.bulk_pending() == 1
+        np.testing.assert_allclose(d.asnumpy(), np.full(2, 9.0))
+    assert mx.engine.bulk_size() == 1
+    assert mx.engine.bulk_pending() == 0
+
+
+def test_bulk_profiler_segment_events(tmp_path):
+    fname = str(tmp_path / "bulk_profile.json")
+    mx.profiler.reset()
+    mx.profiler.set_config(filename=fname, aggregate_stats=True)
+    a = mx.nd.ones((8,))
+    with mx.engine.bulk(8):
+        mx.profiler.set_state("run")
+        ((a * 2) + 1).sum().wait_to_read()
+        mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    evs = json.load(open(fname))["traceEvents"]
+    seg = [e for e in evs if e["name"].startswith("BulkSegment")]
+    assert seg and seg[0]["args"]["op_count"] == 3
+    assert "_mul_scalar" in seg[0]["args"]["ops"]
