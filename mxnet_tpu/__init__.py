@@ -51,7 +51,7 @@ def __getattr__(name):
                 "profiler", "recordio", "callback", "monitor", "model",
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
                 "contrib", "util", "runtime", "onnx", "operator", "library",
-                "log", "name", "attribute"):
+                "log", "name", "attribute", "faults", "checkpoint"):
         import importlib
 
         try:

@@ -131,6 +131,8 @@ class BulkSegment:
             return
         import jax
 
+        from . import faults as _faults
+
         prof = _profiler._REC_IMPERATIVE
         t0 = _profiler._now_us() if prof else None
         live_t = tuple(live)
@@ -140,6 +142,11 @@ class BulkSegment:
             fused = _FUSED_CACHE[plan_key] = jax.jit(
                 _build_fused(self.steps, live_t))
         try:
+            # 'engine.flush' injection point: an injected failure behaves
+            # exactly like an op failing inside the fused segment — it
+            # surfaces HERE, at the sync point, and stays sticky on the
+            # segment (the deferred-exception contract under test)
+            _faults.point("engine.flush")
             outs = fused(*self.ext_raws)
         except Exception as exc:
             self.error = exc

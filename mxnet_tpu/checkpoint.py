@@ -1,0 +1,273 @@
+"""Atomic, checksummed, rotating checkpoint management.
+
+Parity target: the reliability half of MXNet's checkpoint story — estimator
+``CheckpointHandler`` rotation (`event_handler.py:308`), Module/Trainer
+``save_checkpoint``/``save_states`` — hardened for production TPU training,
+where runs die to preemption mid-write and a torn ``.params`` file must
+never take the run's history with it.
+
+Guarantees:
+
+* **Atomic writes** — every file lands via ``tmp + fsync + os.replace``
+  (:func:`atomic_write`), so a checkpoint on disk is either the complete
+  old version or the complete new one, never a torn hybrid. The directory
+  entry is fsync'd too, so the rename survives a power cut.
+* **Checksummed manifest** — ``MANIFEST.json`` records every checkpoint's
+  files with CRC32 + size and the last-known-good epoch. The manifest
+  itself is written atomically.
+* **Keep-N rotation** — old checkpoints beyond ``keep`` are dropped from
+  the manifest and their files deleted.
+* **Corruption fallback** — :meth:`CheckpointManager.load` verifies
+  checksums and silently falls back to the newest *verifying* checkpoint
+  (with a warning naming the corrupt file), so a truncated write at kill
+  time costs one epoch, not the run.
+* **Resume** — :meth:`CheckpointManager.resume` hands back the latest good
+  entry; ``ShardedTrainer.resume``/``CheckpointHandler`` build on it to
+  restore params + optimizer state + epoch/step counters.
+
+The ``ckpt.write`` fault-injection point (mxnet_tpu.faults) fires on every
+atomic write, so preemption-during-checkpoint is a testable scenario.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+import zlib
+
+from . import faults as _faults
+
+__all__ = ["CheckpointManager", "atomic_write", "crc32_file",
+           "MANIFEST_NAME"]
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def crc32_file(path, chunk=1 << 20):
+    """CRC32 of a file's bytes (streamed; cheap vs model-sized IO)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
+
+
+def _fsync_dir(dirname):
+    """fsync the directory entry so a rename survives power loss; best
+    effort — some filesystems refuse O_RDONLY dir fsync."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path, writer):
+    """Write a file atomically: ``writer(tmp_path)`` produces the payload,
+    which reaches `path` only via fsync + ``os.replace``.
+
+    A crash at ANY point leaves either the previous `path` content or the
+    complete new content — never a torn file (stray ``*.tmp.*`` siblings
+    are possible after a kill and are ignored/cleaned by the manager).
+
+    Returns ``(crc32, size)`` of the written payload.
+    """
+    _faults.point("ckpt.write")
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        writer(tmp)
+        # writer implementations (np.savez, json.dump, symbol.save) don't
+        # fsync; do it here so os.replace never publishes unflushed data
+        with open(tmp, "rb+") as f:
+            os.fsync(f.fileno())
+        crc = crc32_file(tmp)
+        size = os.path.getsize(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    _fsync_dir(os.path.dirname(path))
+    return crc, size
+
+
+class CheckpointManager:
+    """Directory of rotated, checksummed checkpoints + MANIFEST.json.
+
+    Each checkpoint is one epoch's set of named files (e.g. ``params`` +
+    ``states``), written atomically and recorded in the manifest with
+    CRC32/size. ``keep`` bounds how many epochs are retained.
+
+    Parameters
+    ----------
+    directory : checkpoint root (created if missing).
+    prefix : filename prefix, ``<prefix>-<epoch:04d>.<name>``.
+    keep : how many most-recent checkpoints to retain (``None``/0 = all).
+    """
+
+    def __init__(self, directory, prefix="ckpt", keep=5):
+        self.directory = os.fspath(directory)
+        self.prefix = prefix
+        self.keep = int(keep) if keep else 0
+        os.makedirs(self.directory, exist_ok=True)
+        self._manifest = self._load_manifest()
+
+    # ------------------------------------------------------------ manifest --
+    @property
+    def manifest_path(self):
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    def _load_manifest(self):
+        try:
+            with open(self.manifest_path) as f:
+                m = json.load(f)
+            if not isinstance(m.get("checkpoints"), list):
+                raise ValueError("manifest has no checkpoint list")
+            return m
+        except FileNotFoundError:
+            pass
+        except (ValueError, OSError) as e:
+            # a torn manifest must not take the run down: the files are
+            # still on disk; start a fresh manifest (old checkpoints become
+            # invisible, which is the conservative choice — their
+            # integrity can no longer be vouched for)
+            warnings.warn(f"corrupt checkpoint manifest "
+                          f"{self.manifest_path}: {e}; starting fresh",
+                          stacklevel=3)
+        return {"version": 1, "prefix": self.prefix, "checkpoints": [],
+                "last_good": None}
+
+    def _write_manifest(self):
+        payload = json.dumps(self._manifest, indent=1, sort_keys=True)
+
+        def writer(tmp):
+            with open(tmp, "w") as f:
+                f.write(payload)
+
+        atomic_write(self.manifest_path, writer)
+
+    # ---------------------------------------------------------------- save --
+    def _path(self, entry_file):
+        return os.path.join(self.directory, entry_file)
+
+    def save(self, epoch, files, step=None, meta=None):
+        """Write one checkpoint atomically and record it as last-good.
+
+        files : {name: writer} where ``writer(path)`` writes that file
+            (or a ``bytes`` payload written verbatim).
+
+        Returns {name: final absolute path}.
+        """
+        epoch = int(epoch)
+        entry = {"epoch": epoch, "step": None if step is None else int(step),
+                 "time": time.time(), "meta": dict(meta or {}), "files": {}}
+        for name, writer in files.items():
+            fname = f"{self.prefix}-{epoch:04d}.{name}"
+            if isinstance(writer, (bytes, bytearray)):
+                data = bytes(writer)
+
+                def writer(tmp, _d=data):
+                    with open(tmp, "wb") as f:
+                        f.write(_d)
+            crc, size = atomic_write(self._path(fname), writer)
+            entry["files"][name] = {"file": fname, "crc32": crc,
+                                    "size": size}
+        cps = [e for e in self._manifest["checkpoints"]
+               if e["epoch"] != epoch]
+        cps.append(entry)
+        cps.sort(key=lambda e: e["epoch"])
+        self._manifest["checkpoints"] = cps
+        self._manifest["last_good"] = epoch
+        self._rotate()
+        self._write_manifest()
+        return {name: self._path(fi["file"])
+                for name, fi in entry["files"].items()}
+
+    def _rotate(self):
+        if not self.keep:
+            return
+        cps = self._manifest["checkpoints"]
+        drop, self._manifest["checkpoints"] = cps[:-self.keep], \
+            cps[-self.keep:]
+        kept_files = {fi["file"] for e in self._manifest["checkpoints"]
+                      for fi in e["files"].values()}
+        for e in drop:
+            for fi in e["files"].values():
+                if fi["file"] in kept_files:
+                    continue
+                try:
+                    os.remove(self._path(fi["file"]))
+                except OSError:
+                    pass
+
+    # ---------------------------------------------------------------- load --
+    def epochs(self):
+        """Recorded epochs, ascending."""
+        return [e["epoch"] for e in self._manifest["checkpoints"]]
+
+    def verify(self, entry):
+        """True when every file of `entry` exists with matching size+CRC."""
+        for fi in entry["files"].values():
+            path = self._path(fi["file"])
+            try:
+                if os.path.getsize(path) != fi["size"] or \
+                        crc32_file(path) != fi["crc32"]:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def load(self, epoch=None):
+        """Return ``(entry, {name: path})`` for the requested (default:
+        newest) checkpoint, verifying checksums and falling back to the
+        newest earlier checkpoint that verifies.
+
+        Raises FileNotFoundError when nothing is recorded (or nothing at or
+        below `epoch`), ValueError when checkpoints exist but every
+        candidate is corrupt.
+        """
+        cands = [e for e in self._manifest["checkpoints"]
+                 if epoch is None or e["epoch"] <= int(epoch)]
+        if not cands:
+            raise FileNotFoundError(
+                f"no checkpoint recorded in {self.directory!r}"
+                + ("" if epoch is None else f" at or below epoch {epoch}"))
+        bad = []
+        for entry in reversed(cands):
+            if self.verify(entry):
+                if bad:
+                    warnings.warn(
+                        "corrupt checkpoint file(s) "
+                        f"{[self._path(b) for b in bad]} failed checksum; "
+                        f"falling back to epoch {entry['epoch']}",
+                        stacklevel=2)
+                return entry, {name: self._path(fi["file"])
+                               for name, fi in entry["files"].items()}
+            bad.extend(fi["file"] for fi in entry["files"].values())
+        raise ValueError(
+            f"all {len(cands)} checkpoint(s) in {self.directory!r} failed "
+            f"checksum verification: {[self._path(b) for b in bad]}")
+
+    def resume(self):
+        """Latest good checkpoint as ``(entry, paths)``, or None when the
+        directory records none (fresh start). Corruption of the newest
+        checkpoint falls back; corruption of ALL of them raises — silently
+        restarting a long run from scratch is never the right default."""
+        if not self._manifest["checkpoints"]:
+            return None
+        return self.load()
+
+    @property
+    def last_good(self):
+        return self._manifest.get("last_good")

@@ -49,9 +49,15 @@ def wait_all() -> None:
     reference's exception-at-sync-point semantics.
     """
     from . import bulk as _bulk
+    from . import faults as _faults
     import jax
 
     _bulk.flush()  # pending bulk segments execute before the barrier
+    # 'engine.flush' injection point: deferred engine failures surface at
+    # the sync point (a pending segment hits the same point inside its own
+    # flush above, so a wait_all that flushes work counts twice — once per
+    # sync layer)
+    _faults.point("engine.flush")
     # effects_barrier drains all dispatched computations on all backends.
     jax.effects_barrier()
 

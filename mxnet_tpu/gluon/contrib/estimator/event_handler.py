@@ -113,20 +113,48 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
 
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
-    """Save params every epoch (parity: event_handler.py:308)."""
+    """Save params every epoch (parity: event_handler.py:308), delegating
+    storage to :class:`mxnet_tpu.checkpoint.CheckpointManager` — every
+    write is atomic and checksummed, ``max_checkpoints`` bounds how many
+    epochs are retained (the reference's max_checkpoints rotation), and
+    ``resume_from_checkpoint`` restores the newest GOOD checkpoint at
+    train_begin (corrupt files are detected by CRC and skipped in favour
+    of the previous epoch)."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
-                 save_best=False, epoch_period=1):
-        import os
+                 save_best=False, epoch_period=1, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        from ....checkpoint import CheckpointManager
 
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.epoch_period = epoch_period
         self.monitor = monitor
         self.save_best = save_best
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
         self.best = None
         self.current_epoch = 0
-        os.makedirs(model_dir, exist_ok=True)
+        self.trained_epochs = 0  # restored on resume
+        self._manager = CheckpointManager(model_dir, prefix=model_prefix,
+                                          keep=max_checkpoints)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if not self.resume_from_checkpoint:
+            return
+        res = self._manager.resume()
+        if res is None:
+            estimator.logger.info(
+                "CheckpointHandler: no checkpoint to resume from in %s; "
+                "starting fresh", self.model_dir)
+            return
+        entry, paths = res
+        estimator.net.load_parameters(paths["params"])
+        self.current_epoch = self.trained_epochs = entry["epoch"]
+        self.best = entry["meta"].get("best")
+        estimator.logger.info(
+            "CheckpointHandler: resumed epoch %d from %s",
+            entry["epoch"], paths["params"])
 
     def epoch_end(self, estimator, *args, **kwargs):
         import os
@@ -134,14 +162,22 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.current_epoch += 1
         if self.current_epoch % self.epoch_period:
             return
-        prefix = os.path.join(self.model_dir, self.model_prefix)
-        estimator.net.save_parameters(
-            f"{prefix}-epoch{self.current_epoch}.params")
+        meta = {}
+        if self.best is not None:
+            meta["best"] = self.best
+        self._manager.save(
+            self.current_epoch,
+            {"params": estimator.net.save_parameters},
+            meta=meta)
         if self.save_best and self.monitor is not None:
+            from ....checkpoint import atomic_write
+
             _, value = self.monitor.get()
             if self.best is None or value > self.best:
                 self.best = value
-                estimator.net.save_parameters(f"{prefix}-best.params")
+                prefix = os.path.join(self.model_dir, self.model_prefix)
+                atomic_write(f"{prefix}-best.params",
+                             estimator.net.save_parameters)
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):

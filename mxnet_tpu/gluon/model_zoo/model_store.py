@@ -1,29 +1,89 @@
 """Pretrained-weight store.
 
 Parity target: `python/mxnet/gluon/model_zoo/model_store.py` — downloads
-pretrained `.params` by (name, sha1) into `~/.mxnet/models`.
+pretrained `.params` by (name, sha1) into `~/.mxnet/models`, retrying
+flaky transfers and verifying the payload hash.
 
-This environment has no network egress, so weights are served from a local
-root directory only; `get_model_file` resolves `<root>/<name>.params` and
-errors with instructions otherwise. Checkpoints saved by this framework's
-`save_parameters` load directly.
+This environment has no network egress, so the "download" is a fetch from
+a local repository directory (``MXNET_TPU_MODEL_REPO`` env var or the
+``repo`` argument) into the cache root. The reliability semantics of the
+reference download path are kept: the copy retries transient ``OSError``
+with exponential backoff (mxnet_tpu.faults.retry — the reference's
+``download(..., retries=5)``), lands atomically (a killed fetch never
+leaves a torn ``.params`` in the cache), and an optional ``sha1`` is
+verified before the file is published. Checkpoints saved by this
+framework's ``save_parameters`` load directly.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+
+from ... import faults as _faults
 
 __all__ = ["get_model_file", "load_pretrained", "purge"]
 
 
-def get_model_file(name, root=None):
-    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+def _default_root(root):
+    return os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+
+
+def _sha1(path, chunk=1 << 20):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
+
+
+def _fetch(src, dst, sha1_hash=None):
+    """Copy src -> dst atomically, verifying the hash BEFORE publishing
+    (parity: model_store.py check_sha1 after download)."""
+    from ...checkpoint import atomic_write
+
+    def writer(tmp):
+        with open(src, "rb") as fin, open(tmp, "wb") as fout:
+            while True:
+                block = fin.read(1 << 20)
+                if not block:
+                    break
+                fout.write(block)
+        if sha1_hash and _sha1(tmp) != sha1_hash:
+            raise OSError(
+                f"hash mismatch fetching {src!r}: expected {sha1_hash}")
+
+    atomic_write(dst, writer)
+
+
+def get_model_file(name, root=None, repo=None, sha1_hash=None):
+    """Resolve `<root>/<name>.params`, fetching it from the local
+    repository directory (`repo` or ``$MXNET_TPU_MODEL_REPO``) on a cache
+    miss — with retry/backoff on transient IO errors and an atomic,
+    hash-verified landing."""
+    root = _default_root(root)
     path = os.path.join(root, f"{name}.params")
     if os.path.exists(path):
-        return path
+        if sha1_hash and _sha1(path) != sha1_hash:
+            os.remove(path)  # stale/corrupt cache entry: refetch
+        else:
+            return path
+    repo = repo or os.environ.get("MXNET_TPU_MODEL_REPO")
+    if repo:
+        src = os.path.join(os.path.expanduser(repo), f"{name}.params")
+        if os.path.exists(src):
+            os.makedirs(root, exist_ok=True)
+            # parity: download(..., retries=5) — transient IO errors are
+            # retried with exponential backoff, then surface
+            _faults.retry(_fetch, retries=4, backoff=0.1,
+                          retry_on=(OSError,))(src, path, sha1_hash)
+            return path
     raise FileNotFoundError(
         f"Pretrained weights for {name!r} not found at {path}. Network "
         "download is unavailable in this environment; place a .params file "
-        "(saved via save_parameters) at that path.")
+        "(saved via save_parameters) at that path, or point "
+        "MXNET_TPU_MODEL_REPO at a local weight repository.")
 
 
 def load_pretrained(net, name, ctx=None, root=None):
@@ -32,7 +92,7 @@ def load_pretrained(net, name, ctx=None, root=None):
 
 
 def purge(root=None):
-    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    root = _default_root(root)
     if os.path.isdir(root):
         for f in os.listdir(root):
             if f.endswith(".params"):

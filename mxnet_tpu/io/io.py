@@ -617,10 +617,15 @@ class ImageRecordIter(DataIter):
 
     def _produce(self, keys):
         """keys -> one assembled DataBatch (decode, augment, normalize)."""
+        from .. import faults as _faults
         from .. import native
         from .. import recordio as _recordio
         from ..ndarray import array as _array
 
+        # 'io.decode' injection point: a raised fault propagates through
+        # the producer thread and surfaces at next() — the flaky-data-
+        # source scenario; delay mode models a slow source
+        _faults.point("io.decode")
         bufs, labels = [], []
         for k in keys:
             header, img_bytes = _recordio.unpack(self._rec.read_idx(k))
@@ -639,15 +644,19 @@ class ImageRecordIter(DataIter):
             if bad:
                 # mixed batches: the native libjpeg path rejects non-JPEG
                 # payloads (PNGs, exotic JPEG variants) record by record.
-                # Retry just the failed records through PIL instead of
-                # zero-filling the slot; only records PIL also rejects
-                # (genuinely corrupt) keep the graceful zero-fill + warning
-                # (reference logs and continues too).
+                # Retry just the failed records through PIL — with
+                # exponential backoff (faults.retry) so a transiently
+                # flaky source gets more than one chance — instead of
+                # zero-filling the slot; only records that exhaust the
+                # retries (genuinely corrupt) keep the graceful zero-fill
+                # + warning (reference logs and continues too).
+                decode_one = _faults.retry(
+                    lambda buf: self._decode_batch_py([buf], dh, dw)[0],
+                    retries=2, backoff=0.01)
                 still_bad = []
                 for i in bad:
                     try:
-                        batch_u8[i] = self._decode_batch_py(
-                            [bufs[i]], dh, dw)[0]
+                        batch_u8[i] = decode_one(bufs[i])
                     except Exception:
                         still_bad.append(i)
                 if still_bad:

@@ -81,6 +81,9 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the per-key merge buffer (parity:
         KVStoreLocal::PushImpl + CommDevice::Reduce)."""
+        from .. import faults as _faults
+
+        _faults.point("kvstore.push")  # flaky-gradient-sync injection
         keys, values = self._canonical_push(key, value)
         for k, vals in zip(keys, values):
             agg = vals[0]
@@ -272,6 +275,9 @@ class _DistKVStore(KVStore):
         return self._procs
 
     def push(self, key, value, priority=0):
+        from .. import faults as _faults
+
+        _faults.point("kvstore.push")  # flaky-gradient-sync injection
         keys, values = self._canonical_push(key, value)
         for k, vals in zip(keys, values):
             agg = vals[0]

@@ -15,21 +15,41 @@ from .module.base_module import BatchEndParam  # noqa: F401  (parity re-export)
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """parity: model.py:403."""
+    """parity: model.py:403. Both files are written atomically
+    (tmp + fsync + os.replace, mxnet_tpu.checkpoint) — a run killed
+    mid-save leaves the previous checkpoint intact, never a torn file."""
+    from .checkpoint import atomic_write
     from .ndarray import utils as nd_utils
 
     if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
+        atomic_write(f"{prefix}-symbol.json", symbol.save)
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    nd_utils.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    atomic_write(f"{prefix}-{epoch:04d}.params",
+                 lambda tmp: nd_utils.save(tmp, save_dict))
 
 
 def load_params(fname):
-    """Split a params file into (arg_params, aux_params) dicts."""
+    """Split a params file into (arg_params, aux_params) dicts.
+
+    Missing files raise FileNotFoundError naming the path; undeserializable
+    files raise a clear "corrupt params file" ValueError instead of a raw
+    zipfile/numpy error (robustness parity: the reference's load paths
+    surface the offending path)."""
+    import os
+
     from .ndarray import utils as nd_utils
 
-    loaded = nd_utils.load(fname)
+    if not os.path.exists(fname):
+        raise FileNotFoundError(f"params file not found: {fname!r}")
+    try:
+        loaded = nd_utils.load(fname)
+    except Exception as e:
+        raise ValueError(
+            f"corrupt params file {fname!r}: {type(e).__name__}: {e} "
+            "(truncated write or not an mx.nd.save container — if this "
+            "came from a CheckpointManager directory, load through the "
+            "manager to fall back to the previous good checkpoint)") from e
     arg_params, aux_params = {}, {}
     for k, v in loaded.items():
         if k.startswith("arg:"):
@@ -42,10 +62,24 @@ def load_params(fname):
 
 
 def load_checkpoint(prefix, epoch):
-    """parity: model.py:448 — returns (symbol, arg_params, aux_params)."""
+    """parity: model.py:448 — returns (symbol, arg_params, aux_params).
+    Raises FileNotFoundError / "corrupt" ValueError naming the offending
+    file rather than surfacing raw deserialization errors."""
+    import os
+
     from . import symbol as sym_mod
 
-    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    sym_file = f"{prefix}-symbol.json"
+    if not os.path.exists(sym_file):
+        raise FileNotFoundError(
+            f"symbol file not found: {sym_file!r} (checkpoint prefix "
+            f"{prefix!r}, epoch {epoch})")
+    try:
+        symbol = sym_mod.load(sym_file)
+    except Exception as e:
+        raise ValueError(
+            f"corrupt symbol file {sym_file!r}: "
+            f"{type(e).__name__}: {e}") from e
     arg_params, aux_params = load_params(f"{prefix}-{epoch:04d}.params")
     return symbol, arg_params, aux_params
 

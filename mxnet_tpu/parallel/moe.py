@@ -42,8 +42,11 @@ def moe_apply(expert_fn, mesh, axis="ep"):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .._jax_compat import get_shard_map
+
+    shard_map = get_shard_map()
 
     jmesh = mesh.jax_mesh
     num_experts = mesh.size(axis)

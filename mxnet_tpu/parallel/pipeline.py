@@ -70,8 +70,11 @@ def pipeline_apply(stage_fn, mesh, num_microbatches, axis="pp"):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .._jax_compat import get_shard_map
+
+    shard_map = get_shard_map()
 
     jmesh = mesh.jax_mesh
     num_stages = mesh.size(axis)
@@ -84,8 +87,10 @@ def pipeline_apply(stage_fn, mesh, num_microbatches, axis="pp"):
         perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
         # mark the carries as device-varying over pp (shard_map's vma check
         # rejects a scan whose carry changes variance mid-loop)
-        state = jax.lax.pcast(jnp.zeros_like(xs[0]), axis, to="varying")
-        out_buf = jax.lax.pcast(jnp.zeros_like(xs), axis, to="varying")
+        from .._jax_compat import pcast
+
+        state = pcast(jnp.zeros_like(xs[0]), axis, to="varying")
+        out_buf = pcast(jnp.zeros_like(xs), axis, to="varying")
 
         def tick(carry, t):
             state, out_buf = carry

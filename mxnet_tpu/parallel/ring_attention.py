@@ -78,12 +78,13 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
         return o_new, m_new, l_new, k_next, v_next
 
     # initial carries must carry the sp-varying type (shard_map type system)
-    o = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), axis_name,
-                      to="varying")
-    m = jax.lax.pcast(jnp.full(q.shape[:-1], -jnp.inf, jnp.float32),
-                      axis_name, to="varying")
-    l = jax.lax.pcast(jnp.zeros(q.shape[:-1], jnp.float32), axis_name,
-                      to="varying")
+    from .._jax_compat import pcast
+
+    o = pcast(jnp.zeros(q.shape, jnp.float32), axis_name, to="varying")
+    m = pcast(jnp.full(q.shape[:-1], -jnp.inf, jnp.float32),
+              axis_name, to="varying")
+    l = pcast(jnp.zeros(q.shape[:-1], jnp.float32), axis_name,
+              to="varying")
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o, m, l,
                                                    k.astype(jnp.float32),
                                                    v.astype(jnp.float32)))
@@ -97,8 +98,11 @@ def ring_attention_sharded(mesh, axis="sp", causal=False, scale=None):
     the sp axis size; inputs may be unsharded (they will be laid out).
     """
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .._jax_compat import get_shard_map
+
+    shard_map = get_shard_map()
 
     jmesh = mesh.jax_mesh
     spec = P(None, None, axis, None)

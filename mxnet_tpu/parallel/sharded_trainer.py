@@ -67,7 +67,8 @@ class ShardedTrainer:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[DeviceMesh] = None, rules=None, donate=True,
-                 zero=False, remat=False, accum_steps=1):
+                 zero=False, remat=False, accum_steps=1, nan_guard=True,
+                 max_consecutive_skips=8):
         """Extra memory levers (all off by default, numerics unchanged):
 
         zero : ZeRO-1 — optimizer state lives dp-sharded (state memory
@@ -83,6 +84,20 @@ class ShardedTrainer:
             full batch for deterministic nets; stochastic layers like
             Dropout draw one rng key per microbatch, so their sample
             stream differs from the accum=1 run).
+
+        Robustness levers:
+
+        nan_guard : a non-finite loss or gradient SKIPS the whole update
+            (params, optimizer state and aux are selected back to their
+            pre-step values INSIDE the compiled step — one jnp.where per
+            buffer, no extra transfers), so one bad batch cannot poison
+            the run. Skips are counted (``skipped_steps`` /
+            ``consecutive_skips``, and in the profiler when recording);
+            after `max_consecutive_skips` skips in a row step() raises —
+            a permanently diverged run must fail loudly, not spin.
+            Reading the skip flag synchronizes the host with each step's
+            completion; pass nan_guard=False to restore fully async
+            dispatch when that latency matters more than the guard.
         """
         self._net = net
         self._loss_fn = loss_fn
@@ -94,6 +109,10 @@ class ShardedTrainer:
         self._accum = int(accum_steps)
         if self._accum < 1:
             raise ValueError("accum_steps must be >= 1")
+        self._nan_guard = bool(nan_guard)
+        self._max_consecutive_skips = int(max_consecutive_skips)
+        self.skipped_steps = 0       # total updates skipped by the guard
+        self.consecutive_skips = 0   # current skip streak
         opt_params = dict(optimizer_params or {})
         # lr_scheduler makes the learning rate a TRACED scalar argument
         # of the compiled step (one executable, lr varies per call)
@@ -369,8 +388,19 @@ class ShardedTrainer:
             grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
             return (loss_sum / accum, new_aux), grads
 
+        nan_guard = self._nan_guard
+
         def step_fn(praws, opt_raws, araws, x, y, rng, t, lr):
             (loss, new_aux), grads = grads_of(praws, araws, x, y, rng)
+            if nan_guard:
+                # one fused all-finite reduction over loss + every grad;
+                # the flag also gates the select-back below
+                finite = jnp.isfinite(loss)
+                for g in grads:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+            else:
+                finite = jnp.bool_(True)
             tt = t.astype(jnp.float32)
             new_p, new_opt = [], []
             for i, (w, g, st) in enumerate(zip(praws, grads, opt_raws)):
@@ -396,7 +426,18 @@ class ShardedTrainer:
                         opt, w, g.astype(w.dtype), st, lr, pwd, tt, rng_i)
                     new_p.append(wn)
                     new_opt.append(tuple(stn))
-            return tuple(new_p), tuple(new_opt), new_aux, loss
+            if nan_guard:
+                # NaN/Inf step guard: select every buffer back to its
+                # pre-step value when any grad (or the loss) is non-finite
+                # — the update is skipped entirely, on device
+                new_p = [jnp.where(finite, n, w)
+                         for n, w in zip(new_p, praws)]
+                new_opt = [tuple(jnp.where(finite, ns, s)
+                                 for ns, s in zip(per_new, per_old))
+                           for per_new, per_old in zip(new_opt, opt_raws)]
+                new_aux = tuple(jnp.where(finite, na, a)
+                                for na, a in zip(new_aux, araws))
+            return tuple(new_p), tuple(new_opt), new_aux, loss, finite
 
         # shardings: batch over dp; params per rules; opt state reuses the
         # per-param state layout the update math is pinned to; aux replicated
@@ -417,18 +458,29 @@ class ShardedTrainer:
             step_fn,
             in_shardings=(p_sh, opt_sh, aux_sh, x_sh, y_sh, rep, rep,
                           rep),
-            out_shardings=(p_sh, opt_sh, aux_sh, rep),
+            out_shardings=(p_sh, opt_sh, aux_sh, rep, rep),
             donate_argnums=donate)
 
     # -------------------------------------------------------------- step ---
     def step(self, x, y):
-        """Run one compiled train step; returns the (replicated) loss."""
+        """Run one compiled train step; returns the (replicated) loss.
+
+        With ``nan_guard`` (the default) a step whose loss or gradients
+        are non-finite leaves params/optimizer/aux untouched; after
+        ``max_consecutive_skips`` such steps in a row a RuntimeError is
+        raised (the step counter still advances on skipped steps — the
+        step was attempted)."""
         import jax
 
+        from .. import faults as _faults
         from .. import random as _rand
 
         x_raw = x._data if isinstance(x, NDArray) else x
         y_raw = y._data if isinstance(y, NDArray) else y
+        if _faults.active():
+            # 'trainer.step' injection: raise/delay/kill, or nan-poison
+            # the batch (which the nan_guard must then absorb)
+            x_raw = _faults.point("trainer.step", x_raw)
         x_raw = self._put_batch(
             x_raw, self._mesh.sharding(
                 *(("dp",) + (None,) * (len(x_raw.shape) - 1))))
@@ -440,7 +492,7 @@ class ShardedTrainer:
 
         lr = self._lr if self._lr_scheduler is None \
             else float(self._lr_scheduler(self._t))
-        new_p, new_opt, new_aux, loss = self._step_fn(
+        new_p, new_opt, new_aux, loss, ok = self._step_fn(
             tuple(h._data for h in self._train_handles),
             self._opt_raws,
             tuple(h._data for h in self._aux_handles),
@@ -453,7 +505,28 @@ class ShardedTrainer:
             for h, raw in zip(self._aux_handles, new_aux):
                 h._data = raw
         self._opt_raws = new_opt
+        if self._nan_guard:
+            self._account_skip(bool(ok))  # blocks on step completion
         return NDArray(loss)
+
+    def _account_skip(self, ok):
+        from .. import profiler as _profiler
+
+        if ok:
+            self.consecutive_skips = 0
+            return
+        self.skipped_steps += 1
+        self.consecutive_skips += 1
+        _profiler.record_skip_step(self.skipped_steps,
+                                   self.consecutive_skips)
+        if self.consecutive_skips >= self._max_consecutive_skips:
+            raise RuntimeError(
+                f"ShardedTrainer: {self.consecutive_skips} consecutive "
+                "steps produced non-finite loss/gradients and were "
+                "skipped (step "
+                f"{self._t}, {self.skipped_steps} skipped total) — the "
+                "run has diverged; lower the learning rate, check the "
+                "data pipeline, or resume from the last good checkpoint")
 
     def predict(self, x):
         """Compiled sharded inference forward (replicated output)."""
@@ -520,19 +593,14 @@ class ShardedTrainer:
             keys += [f"s{i}_{j}" for j in range(len(per))]
         return keys
 
-    def save_states(self, fname):
-        """Checkpoint params + optimizer state + step counter + the
-        global RNG stream to one file in the `mx.nd.save` container
-        (bf16 handled there as uint16 bits). Entries are positional,
-        keyed by `collect_params()` order, so resuming into a freshly
-        built identical architecture works even though gluon
-        auto-prefixes differ between processes. parity role:
-        Trainer.save_states + model checkpoints (SURVEY §5.4)."""
+    def _state_payload(self):
+        """Assemble the full checkpoint payload as {key: NDArray}. Runs
+        COLLECTIVELY on every process (the host copies allgather); the
+        caller decides which rank writes."""
         import jax
         import jax.numpy as jnp
 
         from .. import random as _rand
-        from ..ndarray import utils as nd_utils
 
         _rand._ensure()
         names_blob = "\n".join(self._param_names + self._aux_names)
@@ -558,11 +626,61 @@ class ShardedTrainer:
         for i, per in enumerate(self._opt_raws):
             for j, s in enumerate(per):
                 payload[f"s{i}_{j}"] = NDArray(self._host_copy(s))
-        # _host_copy's allgather is collective (every process runs it),
-        # but only one process may write a SHARED path; host-local
-        # trainers write regardless of rank
-        if not self._multiprocess or jax.process_index() == 0:
-            nd_utils.save(fname, payload)
+        return payload
+
+    def _is_writer_rank(self):
+        """_host_copy's allgather is collective (every process runs it),
+        but only one process may write a SHARED path; host-local
+        trainers write regardless of rank."""
+        import jax
+
+        return not self._multiprocess or jax.process_index() == 0
+
+    def save_states(self, fname):
+        """Checkpoint params + optimizer state + step counter + the
+        global RNG stream to one file in the `mx.nd.save` container
+        (bf16 handled there as uint16 bits). Entries are positional,
+        keyed by `collect_params()` order, so resuming into a freshly
+        built identical architecture works even though gluon
+        auto-prefixes differ between processes. The write is ATOMIC
+        (tmp + fsync + os.replace): a run preempted mid-checkpoint
+        leaves the previous state file intact, never a torn one.
+        parity role: Trainer.save_states + model checkpoints
+        (SURVEY §5.4)."""
+        from ..checkpoint import atomic_write
+        from ..ndarray import utils as nd_utils
+
+        payload = self._state_payload()
+        if self._is_writer_rank():
+            atomic_write(fname, lambda tmp: nd_utils.save(tmp, payload))
+
+    def save_checkpoint(self, manager, epoch, meta=None):
+        """Write trainer state through a :class:`~mxnet_tpu.checkpoint.
+        CheckpointManager` — atomic write, CRC-checksummed manifest entry,
+        keep-N rotation. Collective across processes; only the writer
+        rank touches disk. Returns the manager's {name: path} map (None
+        on non-writer ranks)."""
+        from ..ndarray import utils as nd_utils
+
+        payload = self._state_payload()
+        if not self._is_writer_rank():
+            return None
+        return manager.save(
+            epoch, {"states": lambda tmp: nd_utils.save(tmp, payload)},
+            step=self._t, meta=meta)
+
+    def resume(self, manager):
+        """Restore the latest good checkpoint recorded by `manager`
+        (corrupt files are detected by checksum and skipped in favour of
+        the previous good epoch). Returns the manifest entry — epoch,
+        step, meta — or None when the manager records no checkpoint yet
+        (fresh start)."""
+        res = manager.resume()
+        if res is None:
+            return None
+        entry, paths = res
+        self.load_states(paths["states"])
+        return entry
 
     def load_states(self, fname):
         """Restore a `save_states` checkpoint, re-laying every tensor out
@@ -572,12 +690,24 @@ class ShardedTrainer:
         uninterrupted run's sample stream exactly. The key set AND every
         tensor shape are validated before anything is mutated — a failed
         load never leaves the trainer half-restored."""
+        import os
+
         import jax
 
         from .. import random as _rand
         from ..ndarray import utils as nd_utils
 
-        arrays = nd_utils.load(fname)
+        if not os.path.exists(fname):
+            raise FileNotFoundError(
+                f"trainer state file not found: {fname!r}")
+        try:
+            arrays = nd_utils.load(fname)
+        except Exception as e:
+            raise ValueError(
+                f"corrupt trainer state file {fname!r}: "
+                f"{type(e).__name__}: {e} (truncated write? load through "
+                "CheckpointManager.resume to fall back to the previous "
+                "good checkpoint)") from e
         expected = set(self._ckpt_keys())
         got = set(arrays)
         if expected != got:

@@ -23,7 +23,7 @@ import time
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
            "dumps", "reset", "Domain", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope"]
+           "Marker", "scope", "record_skip_step"]
 
 _lock = threading.Lock()
 _RECORDING = False       # master flag: a session is active and not paused
@@ -167,6 +167,18 @@ def record_bulk_segment(start_us, dur_us, op_names):
                  cat="bulk",
                  args={"op_count": len(op_names),
                        "ops": ",".join(op_names)})
+
+
+def record_skip_step(total, consecutive):
+    """NaN/Inf-guarded optimizer step skipped (ShardedTrainer nan_guard):
+    an instant marker at the skip plus a counter track of the running
+    total, so diverging runs are visible in the trace. No-op unless a
+    profiling session is recording."""
+    if not _RECORDING:
+        return
+    record_instant("trainer.skip_step", cat="trainer",
+                   args={"total": total, "consecutive": consecutive})
+    record_counter("trainer.skipped_steps", total)
 
 
 def record_instant(name, cat="instant", args=None):

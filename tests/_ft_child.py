@@ -1,0 +1,73 @@
+"""Kill-and-resume child process for tests/test_fault_tolerance.py.
+
+Runs a tiny deterministic ShardedTrainer fit, checkpointing through
+CheckpointManager after every epoch. Driven entirely by env vars so the
+parent test can run three variants of the SAME trajectory:
+
+    FT_CKPT_DIR   checkpoint directory (shared between kill + resume runs)
+    FT_EPOCHS     total epochs (default 3)
+    FT_STEPS      steps per epoch (default 4)
+    FT_RESUME     "1" -> resume from the manager's latest good checkpoint
+    FT_OUT        where to np.savez the final parameter values
+    MXNET_TPU_FAULTS  e.g. "trainer.step:kill@6" — SIGKILL mid-epoch-2,
+                      exactly like a TPU preemption (no cleanup, no atexit)
+
+Per-epoch batches are regenerated from a seed derived from the epoch
+number, so a resumed run replays the identical data stream from the epoch
+boundary; the trainer checkpoint restores params + optimizer state + step
+counter + the RNG stream, so the continued trajectory is bit-exact versus
+the uninterrupted run.
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+
+def batch_for(epoch, step):
+    rs = np.random.RandomState(1000 * epoch + step)
+    x = rs.randn(8, 6).astype(np.float32)
+    y = (x @ rs.randn(6, 4) * 0.5).astype(np.float32)
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+def main():
+    epochs = int(os.environ.get("FT_EPOCHS", "3"))
+    steps = int(os.environ.get("FT_STEPS", "4"))
+    ckpt_dir = os.environ["FT_CKPT_DIR"]
+    out = os.environ["FT_OUT"]
+
+    mx.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(batch_for(1, 0)[0])
+    trainer = ShardedTrainer(net, gluon.loss.L2Loss(), "adam",
+                             {"learning_rate": 0.05},
+                             mesh=DeviceMesh({"dp": 1}))
+    manager = CheckpointManager(ckpt_dir, prefix="ft", keep=3)
+
+    start_epoch = 0
+    if os.environ.get("FT_RESUME") == "1":
+        entry = trainer.resume(manager)
+        if entry is not None:
+            start_epoch = entry["epoch"]
+
+    for epoch in range(start_epoch + 1, epochs + 1):
+        for step in range(steps):
+            x, y = batch_for(epoch, step)
+            trainer.step(x, y)
+        trainer.save_checkpoint(manager, epoch)
+
+    np.savez(out, **{name: p.data().asnumpy()
+                     for name, p in net.collect_params().items()})
+    print(f"FT_DONE t={trainer._t}")
+
+
+if __name__ == "__main__":
+    main()

@@ -97,3 +97,41 @@ def pytest_collection_modifyitems(config, items):
         base = item.name.split("[")[0]
         if item.fspath.basename in _SLOW_FILES or base in _SLOW_TESTS:
             item.add_marker(_pytest.mark.slow)
+
+
+# ------------------------------------------------------- per-test timeout --
+# One hung test (deadlocked prefetch thread, wedged collective) must not
+# eat the whole suite budget: raise TimeoutError inside the test after
+# `test_timeout` seconds (pytest.ini; 0 disables). SIGALRM only fires on
+# the main thread, which is where pytest runs tests; background threads a
+# test spawned keep running and are the test's job to join. Complements
+# the faulthandler_timeout stack dump (also pytest.ini).
+
+def pytest_addoption(parser):
+    parser.addini("test_timeout",
+                  "per-test SIGALRM timeout in seconds (0 = off)",
+                  default="0")
+
+
+@_pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    import signal
+    import threading
+
+    seconds = int(item.config.getini("test_timeout") or 0)
+    if (seconds <= 0 or not hasattr(signal, "SIGALRM")
+            or threading.current_thread() is not threading.main_thread()):
+        return (yield)
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded the {seconds}s per-test timeout "
+            "(test_timeout in pytest.ini)")
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)

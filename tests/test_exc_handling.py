@@ -136,6 +136,77 @@ def test_exception_does_not_poison_later_work():
     assert b.asnumpy().shape == (8, 8)
 
 
+def test_bulked_segment_failure_surfaces_at_sync_point():
+    """An op failing INSIDE a bulk(N) fused segment must not raise at the
+    recording call site — it surfaces at the next sync point (here a
+    buffer read), per the engine's deferred-exception contract
+    (mxnet_tpu/bulk.py BulkSegment.run). The failure is injected at the
+    'engine.flush' point, which fires exactly where a fused-executable
+    failure would."""
+    from mxnet_tpu import faults
+
+    faults.configure("engine.flush:raise@1")
+    try:
+        x = mx.nd.ones((4,))
+        with engine.bulk(8):
+            y = x + 1          # recorded, NOT executed — must not raise
+            z = y * 2
+            assert engine.bulk_pending() == 2
+            with pytest.raises(faults.InjectedFault):
+                z.asnumpy()    # sync point: deferred error surfaces here
+            # sticky: the failed segment re-raises on every later force
+            with pytest.raises(faults.InjectedFault):
+                y.asnumpy()
+    finally:
+        faults.reset()
+    # engine usable again after the failure
+    onp.testing.assert_allclose((x + 1).asnumpy(), onp.full(4, 2.0))
+
+
+def test_bulked_segment_failure_surfaces_at_waitall():
+    from mxnet_tpu import faults
+
+    # trigger 2: waitall's own sync fires the point once before the
+    # barrier and once when flushing the pending segment — arm the
+    # segment-flush invocation (the first one hit)
+    faults.configure("engine.flush:raise@1")
+    try:
+        with engine.bulk(8):
+            y = mx.nd.ones((4,)) + 1
+            with pytest.raises(faults.InjectedFault):
+                _sync()
+    finally:
+        faults.reset()
+    _sync()
+
+
+def test_bulked_trace_time_failure_raises_at_call_site():
+    """Shape errors are detected at RECORD time (static shape inference
+    gates bulkability), so they raise immediately even inside a bulk
+    scope — same contract as eager dispatch."""
+    with engine.bulk(8):
+        with pytest.raises(Exception):
+            mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 5)))
+    _sync()
+
+
+def test_bulk_failure_does_not_poison_later_segments():
+    from mxnet_tpu import faults
+
+    faults.configure("engine.flush:raise@1")
+    try:
+        with engine.bulk(4):
+            y = mx.nd.ones((3,)) * 3
+            with pytest.raises(faults.InjectedFault):
+                y.asnumpy()
+    finally:
+        faults.reset()
+    # a fresh segment after the failure computes correctly
+    with engine.bulk(4):
+        z = mx.nd.ones((3,)) * 5
+        onp.testing.assert_allclose(z.asnumpy(), onp.full(3, 5.0))
+
+
 def test_bad_simple_bind_shape_raises():
     data = mx.sym.var("data")
     out = mx.sym.FullyConnected(data, num_hidden=4)

@@ -89,6 +89,10 @@ def test_hybridize_export_symbolblock_roundtrip(tmp_path):
 
 def test_module_workflow_checkpoints(tmp_path):
     """Symbolic Module: bind/fit/score/save/load, the 1.x classic."""
+    # the Xavier init draws from the GLOBAL streams: seed them so the
+    # convergence assert does not depend on suite ordering
+    mx.random.seed(0)
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     X = rng.randn(128, 10).astype(np.float32)
     Y = (X[:, 0] > 0).astype(np.float32)

@@ -1520,6 +1520,11 @@ _GRAD_SKIP = {
     "_npi_floor_divide_scalar", "_npi_rfloor_divide_scalar",
     # (sign, logdet) multi-output with a non-differentiable sign slot
     "_npi_slogdet",
+    # the case input deliberately contains nan/inf (that's the op's whole
+    # point); central differences across non-finite inputs are undefined,
+    # and the float-max substitutes for +-inf swamp every finite
+    # perturbation in the sum (forward oracle covers the op)
+    "_npi_nan_to_num",
 }
 
 

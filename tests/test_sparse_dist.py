@@ -16,6 +16,18 @@ from mxnet_tpu.ndarray.sparse import (RowSparseNDArray, merge_duplicates,
                                       row_sparse_array, sparse_add)
 
 
+# two-process suites need multiprocess collectives on the CPU backend,
+# which this jax/jaxlib only implements from 0.5 on (older versions raise
+# XlaRuntimeError: "Multiprocess computations aren't implemented on the
+# CPU backend" inside the child ranks)
+_JAX_VERSION = tuple(int(x) for x in __import__("jax").__version__
+                     .split(".")[:2])
+_needs_multiprocess_cpu = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason="multiprocess CPU collectives unsupported by jax "
+           f"{__import__('jax').__version__} (needs >= 0.5)")
+
+
 def test_row_sparse_is_lazy():
     """Construction must NOT materialize dense storage."""
     rs = row_sparse_array((onp.ones((2, 4), "float32"), [1, 5]),
@@ -204,6 +216,7 @@ _DIST_CHILD = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
                     reason="distributed tests disabled")
+@_needs_multiprocess_cpu
 def test_two_process_dist_sync_exact_aggregate(tmp_path):
     """2-process localhost jax.distributed: dist_sync push/pull must
     produce the exact cross-worker sum on both ranks."""
@@ -239,6 +252,7 @@ _ASYNC_CHILD = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
                     reason="distributed tests disabled")
+@_needs_multiprocess_cpu
 def test_two_process_dist_async_per_push_updates(tmp_path):
     """dist_async applies every worker's push as its own optimizer step
     (kvstore_dist_server.h async ApplyUpdates parity), observable via a
@@ -250,7 +264,12 @@ _TRAINER_CHILD = textwrap.dedent("""
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax < 0.5 spells this flag via XLA_FLAGS
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=2")
     port, pid = sys.argv[1], int(sys.argv[2])
     jax.distributed.initialize(coordinator_address="localhost:" + port,
                                num_processes=2, process_id=pid)
@@ -322,6 +341,7 @@ _TRAINER_CHILD = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
                     reason="distributed tests disabled")
+@_needs_multiprocess_cpu
 def test_two_process_sharded_trainer(tmp_path):
     """Multi-host ShardedTrainer: 2 processes x 2 devices, each feeding
     its half of the global batch — losses must equal a single-process
@@ -333,7 +353,12 @@ _PIPELINE_CHILD = textwrap.dedent("""
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax < 0.5 spells this flag via XLA_FLAGS
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=2")
     port, pid = sys.argv[1], int(sys.argv[2])
     jax.distributed.initialize(coordinator_address="localhost:" + port,
                                num_processes=2, process_id=pid)
@@ -367,6 +392,7 @@ _PIPELINE_CHILD = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
                     reason="distributed tests disabled")
+@_needs_multiprocess_cpu
 def test_two_process_pipeline_parallel(tmp_path):
     """GPipe pipeline over a mesh spanning 2 processes: stage-to-stage
     ppermutes cross host boundaries; output exact vs the sequential
@@ -378,7 +404,12 @@ _RING_CHILD = textwrap.dedent("""
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax < 0.5 spells this flag via XLA_FLAGS
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=2")
     port, pid = sys.argv[1], int(sys.argv[2])
     jax.distributed.initialize(coordinator_address="localhost:" + port,
                                num_processes=2, process_id=pid)
@@ -409,6 +440,7 @@ _RING_CHILD = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
                     reason="distributed tests disabled")
+@_needs_multiprocess_cpu
 def test_two_process_ring_attention(tmp_path):
     """Long-context SP across hosts: the k/v ring ppermutes cross the
     process boundary every step; output exact vs dense attention
@@ -420,7 +452,12 @@ _MOE_CHILD = textwrap.dedent("""
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax < 0.5 spells this flag via XLA_FLAGS
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=2")
     port, pid = sys.argv[1], int(sys.argv[2])
     jax.distributed.initialize(coordinator_address="localhost:" + port,
                                num_processes=2, process_id=pid)
@@ -458,6 +495,7 @@ _MOE_CHILD = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
                     reason="distributed tests disabled")
+@_needs_multiprocess_cpu
 def test_two_process_expert_parallel(tmp_path):
     """Switch MoE with experts split across 2 processes: the dense-
     dispatch psum crosses the host boundary; output exact vs the dense

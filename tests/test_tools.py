@@ -86,3 +86,22 @@ def test_rec2idx_matches_writer(tmp_path):
     # the rebuilt index actually seeks correctly
     r = recordio.MXIndexedRecordIO(rebuilt, rec_path, "r")
     assert r.read_idx(7) == payloads[7]
+
+
+def test_chaos_smoke_recovers(tmp_path):
+    """tools/chaos_smoke.py: 2-epoch toy fit under the canned fault
+    schedule — NaN guard absorbs a poisoned batch, checkpoint-write
+    retry absorbs an injected write failure, and an injected crash is
+    recovered via CheckpointManager resume — exit code 0."""
+    import chaos_smoke
+
+    from mxnet_tpu import faults
+
+    faults.reset()
+    try:
+        rc = chaos_smoke.main(["--epochs", "2", "--steps", "4",
+                               "--dir", str(tmp_path)])
+    finally:
+        faults.reset()
+    assert rc == 0
+    assert (tmp_path / "MANIFEST.json").exists()
