@@ -51,7 +51,8 @@ def __getattr__(name):
                 "profiler", "recordio", "callback", "monitor", "model",
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
                 "contrib", "util", "runtime", "onnx", "operator", "library",
-                "log", "name", "attribute", "faults", "checkpoint"):
+                "log", "name", "attribute", "faults", "checkpoint",
+                "analysis"):
         import importlib
 
         try:

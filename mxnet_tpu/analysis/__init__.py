@@ -1,0 +1,39 @@
+"""mxnet_tpu.analysis — the static-analysis subsystem.
+
+The NNVM-pass analogue for this reproduction, TPU-flavored:
+
+* :mod:`~mxnet_tpu.analysis.verify` — graph verifier passes over the
+  Symbol node DAG (kwargs vs OpSchema, shape/dtype inference consistency,
+  dangling/duplicate inputs, cycles, dead outputs). ``Symbol.verify()`` /
+  automatic inside ``simple_bind`` (``MXNET_TPU_VERIFY=0`` opts out).
+* :mod:`~mxnet_tpu.analysis.sanitize` — runtime sync-hazard sanitizer
+  layered on the bulking engine (``MXNET_TPU_SANITIZE=1``).
+
+The companion source-level checker lives in ``tools/mxlint.py``.
+
+``sanitize`` is imported eagerly (NDArray sync points read its ``ACTIVE``
+flag); the verifier — which pulls in the symbol/registry layers — loads on
+first use.
+"""
+from __future__ import annotations
+
+from . import sanitize
+
+__all__ = ["sanitize", "verify", "verify_graph", "GraphVerifyError",
+           "Issue", "raise_if_errors", "verify_enabled"]
+
+_VERIFY_NAMES = ("verify_graph", "GraphVerifyError", "Issue",
+                 "raise_if_errors", "verify_enabled", "node_failure_message")
+
+
+def __getattr__(name):
+    if name == "verify" or name in _VERIFY_NAMES:
+        from . import verify as _verify
+
+        globals().setdefault("verify", _verify)
+        if name == "verify":
+            return _verify
+        value = getattr(_verify, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,238 @@
+"""Sync-hazard sanitizer: runtime-assisted checking of the engine contract.
+
+Two hazard classes the reference's threaded engine made loud but XLA's async
+dispatch makes silent:
+
+1. **Implicit host syncs** — ``asnumpy`` / ``asscalar`` / ``__bool__`` /
+   ``wait_to_read`` / forcing a lazy buffer. Each one stalls the dispatch
+   pipeline for a device round-trip; one inside a training loop body is the
+   #1 silent perf killer. Worse, a sync while a :class:`~mxnet_tpu.bulk.
+   BulkSegment` is open *splits the segment*: the ops recorded so far
+   compile as a fragment, losing the fusion the bulking engine exists to
+   provide. The sanitizer records every sync with its user call site and
+   flags the segment-splitting ones as hazards.
+
+2. **Output-aval contract violations** — the bulking recorder trusts each
+   op's predicted ``output_avals`` (cached ``jax.eval_shape``) to wire
+   downstream ops without executing. An op whose runtime output diverges
+   from its abstract prediction (nondeterministic emitter, stale cache,
+   buggy custom op) corrupts every segment it appears in. Under the
+   sanitizer, both the eager dispatch path and the fused segment runner
+   cross-check actual outputs against the prediction and report violations
+   with op name and call site.
+
+Enabled via ``MXNET_TPU_SANITIZE=1`` (read at import) or
+:func:`enable` / the :func:`sanitize` context manager. When disabled the
+only cost at each sync point is one module-attribute truthiness check.
+
+Events are queryable (:func:`events`, :func:`hazards`) and hazards are also
+emitted as :class:`SyncHazardWarning` via ``warnings.warn`` so they surface
+in test runs and ``-W error`` CI configurations.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import warnings
+from collections import deque
+
+__all__ = ["SyncHazardWarning", "SyncEvent", "enable", "disable", "sanitize",
+           "record_sync", "check_contract", "events", "hazards", "reset",
+           "ACTIVE"]
+
+ACTIVE = os.environ.get("MXNET_TPU_SANITIZE", "0").lower() \
+    not in ("", "0", "false", "off")
+
+_MAX_EVENTS = 4096
+
+_events = deque(maxlen=_MAX_EVENTS)
+_lock = threading.Lock()
+_tls = threading.local()
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class SyncHazardWarning(UserWarning):
+    """A host sync split a live bulk segment, or an op violated its
+    output-aval contract."""
+
+
+class SyncEvent:
+    """One recorded sync point / contract check."""
+
+    __slots__ = ("kind", "site", "pending", "hazard", "message")
+
+    def __init__(self, kind, site, pending, hazard, message):
+        self.kind = kind        # asnumpy/asscalar/bool/wait_to_read/...
+        self.site = site        # "file:lineno in func" of the user frame
+        self.pending = pending  # ops pending in the thread's bulk segment
+        self.hazard = hazard
+        self.message = message
+
+    def __repr__(self):
+        flag = "HAZARD " if self.hazard else ""
+        return f"<SyncEvent {flag}{self.kind} at {self.site}: {self.message}>"
+
+
+# ----------------------------------------------------------------- knobs ---
+
+def enable():
+    global ACTIVE
+    ACTIVE = True
+
+
+def disable():
+    global ACTIVE
+    ACTIVE = False
+
+
+@contextlib.contextmanager
+def sanitize():
+    """Scoped enablement: ``with sanitize(): ...`` (tests, profiling runs)."""
+    global ACTIVE
+    prev = ACTIVE
+    ACTIVE = True
+    try:
+        yield
+    finally:
+        ACTIVE = prev
+
+
+def reset():
+    with _lock:
+        _events.clear()
+
+
+def events():
+    with _lock:
+        return list(_events)
+
+
+def hazards():
+    return [e for e in events() if e.hazard]
+
+
+# ------------------------------------------------------------- recording ---
+
+def _callsite():
+    """First stack frame outside the mxnet_tpu package — where the user
+    triggered the sync."""
+    import sys
+
+    f = sys._getframe(1)
+    while f is not None:
+        fname = f.f_code.co_filename
+        internal = fname.startswith(_PKG_DIR) \
+            or fname.endswith("contextlib.py")
+        if not internal or os.sep + "tests" in fname:
+            return f"{fname}:{f.f_lineno} in {f.f_code.co_name}"
+        f = f.f_back
+    return "<library internal>"
+
+
+def _record(kind):
+    from .. import bulk
+
+    pending = bulk.pending_ops()
+    hazard = pending > 0
+    site = _callsite()
+    if hazard:
+        message = (f"host sync ({kind}) split a live bulk segment of "
+                   f"{pending} recorded op"
+                   f"{'s' if pending != 1 else ''} — the segment "
+                   "compiles as a fragment, losing fusion")
+    else:
+        message = f"host sync ({kind})"
+    ev = SyncEvent(kind, site, pending, hazard, message)
+    with _lock:
+        _events.append(ev)
+    if hazard:
+        warnings.warn(f"{message} [at {site}]", SyncHazardWarning,
+                      stacklevel=3)
+
+
+@contextlib.contextmanager
+def synced(kind):
+    """Record one sync event and suppress nested recording for the span of
+    the enclosed host-sync operation (``asscalar`` -> ``asnumpy`` ->
+    ``LazyRef.force`` records once, under the outermost — most precise —
+    kind). Callers check :data:`ACTIVE` first."""
+    if getattr(_tls, "in_sync", False):
+        yield
+        return
+    _tls.in_sync = True
+    try:
+        _record(kind)
+        yield
+    finally:
+        _tls.in_sync = False
+
+
+def record_sync(kind):
+    """Point-record for sync events with no enclosed span (``wait_all``,
+    ``LazyRef.force`` reached through a raw ``_data`` read). No-op when a
+    :func:`synced` scope already recorded the outer operation."""
+    if getattr(_tls, "in_sync", False):
+        return
+    _record(kind)
+
+
+# ------------------------------------------------------ contract checking --
+
+def check_contract(op, raws, kwargs, kw_key, raw_out):
+    """Cross-check an eager op's actual outputs against the registry's
+    predicted ``output_avals`` (the FInferShape/FInferType analogue the
+    bulking recorder trusts blindly). Called from ``ndarray._invoke`` when
+    :data:`ACTIVE`."""
+    if op.eager or (kw_key is None and kwargs):
+        return  # no abstract prediction exists for this call
+    try:
+        in_sig = tuple((tuple(r.shape), r.dtype) for r in raws)
+        avals, single = op.output_avals(in_sig, kwargs, kw_key)
+    except Exception:
+        return  # inference itself failed; the op already ran fine
+    outs = raw_out if isinstance(raw_out, (tuple, list)) else (raw_out,)
+    _compare(op.name, [(tuple(av.shape), av.dtype) for av in avals], outs)
+
+
+def check_segment(plan, refs, live, outs):
+    """Fused-segment variant: compare the executed segment's outputs with
+    the LazyRef avals the recorder promised downstream consumers. Called
+    from ``BulkSegment.run`` when :data:`ACTIVE`."""
+    ops_hint = [p[0] for p in plan]
+    for pos, flat_idx in enumerate(live):
+        if pos >= len(outs):
+            break
+        ref = refs[flat_idx]
+        _compare(f"bulk segment output {flat_idx}",
+                 [(tuple(ref.shape), ref.dtype)], (outs[pos],),
+                 plan_hint=ops_hint)
+
+
+def _compare(what, predicted, outs, plan_hint=None):
+    import numpy as _np
+
+    problems = []
+    if len(predicted) != len(outs):
+        problems.append(f"predicted {len(predicted)} outputs, "
+                        f"got {len(outs)}")
+    for i, ((pshape, pdtype), out) in enumerate(zip(predicted, outs)):
+        ashape = tuple(out.shape)
+        if pshape != ashape:
+            problems.append(f"output {i}: predicted shape {pshape}, "
+                            f"actual {ashape}")
+        elif pdtype is not None and _np.dtype(pdtype) != _np.dtype(out.dtype):
+            problems.append(f"output {i}: predicted dtype {pdtype}, "
+                            f"actual {out.dtype}")
+    if not problems:
+        return
+    site = _callsite()
+    message = (f"output-aval contract violation in {what}: "
+               + "; ".join(problems))
+    if plan_hint:
+        message += f" (segment ops: {plan_hint})"
+    ev = SyncEvent("contract", site, 0, True, message)
+    with _lock:
+        _events.append(ev)
+    warnings.warn(f"{message} [at {site}]", SyncHazardWarning, stacklevel=4)

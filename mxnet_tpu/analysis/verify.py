@@ -1,0 +1,407 @@
+"""Graph verifier: static checking passes over the Symbol ``_Node`` DAG.
+
+Parity target: the reference's correctness guarantees come from NNVM graph
+passes — ``InferShape`` / ``InferType`` run *before* execution
+(`src/executor/infer_graph_attr_pass.cc`), op attribute validation via
+``dmlc::Parameter::Init``, and the graph indexing layer rejecting malformed
+node references. Our reproduction re-grew the execution half; this module is
+the verification half: a set of topo-walk passes that run ahead of
+``bind``/``eval`` and turn "TypeError deep inside a jit trace" into a
+node-level diagnostic.
+
+Passes (all collected into one :class:`Issue` list; none executes device
+code — shape/dtype work happens abstractly via ``jax.eval_shape``):
+
+* **cycle**           — back-edge detection over ``_Node.inputs`` (possible
+                        via hand-mutated graphs or crafted/corrupt JSON).
+* **unknown-op**      — node references an op missing from the registry.
+* **bad-kwarg**       — per-node hyper-parameters validated against the op's
+                        reflected :class:`~mxnet_tpu.ops.schema.OpSchema`.
+* **dangling-input**  — an input edge referencing an output index its
+                        producer does not have.
+* **duplicate-name**  — two distinct variable nodes sharing one name (feed
+                        dicts are keyed by name: ambiguous binding); op-node
+                        name collisions are reported as warnings.
+* **shape-mismatch**  — full shape/dtype inference walk; a node whose
+                        abstract evaluation fails is reported with its input
+                        shapes, and declared ``__shape__``/``__dtype__``
+                        attrs are cross-checked against caller hints.
+* **output-arity**    — predicted output count (``jax.eval_shape`` on the op)
+                        vs the node's declared ``num_outputs``.
+* **dead-output**     — outputs of multi-output nodes that are neither
+                        consumed nor graph heads (warning).
+* **unused-hint**     — shape/type hints naming no graph input (warning —
+                        usually a typo'd feed key).
+
+``Symbol.verify()`` is the public entry; ``simple_bind`` runs the verifier
+automatically unless ``MXNET_TPU_VERIFY=0``.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError, canonical_dtype
+from ..ops import registry as _registry
+from ..ops.schema import OpParamError
+
+__all__ = ["Issue", "GraphVerifyError", "verify_graph", "verify_enabled",
+           "raise_if_errors", "node_failure_message"]
+
+
+class Issue:
+    """One verifier finding, attached to a graph node."""
+
+    __slots__ = ("severity", "code", "node", "op", "message")
+
+    def __init__(self, severity, code, node, op, message):
+        self.severity = severity  # "error" | "warning"
+        self.code = code
+        self.node = node          # node name ("" for graph-level findings)
+        self.op = op              # registry op name, or None for variables
+        self.message = message
+
+    @property
+    def is_error(self):
+        return self.severity == "error"
+
+    def __str__(self):
+        where = f"node {self.node!r}" if self.node else "graph"
+        if self.op:
+            where += f" (op {self.op})"
+        return f"[{self.severity}:{self.code}] {where}: {self.message}"
+
+    def __repr__(self):
+        return f"<Issue {self}>"
+
+
+class GraphVerifyError(MXNetError):
+    """Raised by ``Symbol.verify`` when error-severity issues exist; carries
+    the full issue list (warnings included) as ``.issues``."""
+
+    def __init__(self, issues):
+        self.issues = list(issues)
+        errors = [i for i in self.issues if i.is_error]
+        lines = "\n  ".join(str(i) for i in errors)
+        super().__init__(
+            f"graph verification failed ({len(errors)} error"
+            f"{'s' if len(errors) != 1 else ''}):\n  {lines}")
+
+
+def verify_enabled() -> bool:
+    """The ``MXNET_TPU_VERIFY`` gate for the automatic simple_bind run
+    (on unless explicitly disabled)."""
+    return os.environ.get("MXNET_TPU_VERIFY", "1").lower() \
+        not in ("0", "false", "off")
+
+
+def raise_if_errors(issues):
+    if any(i.is_error for i in issues):
+        raise GraphVerifyError(issues)
+    return issues
+
+
+def _failure_text(in_shapes, exc):
+    shapes = ", ".join(str(tuple(s)) if s is not None else "?"
+                       for s in in_shapes)
+    return (f"abstract evaluation failed for input shapes [{shapes}]: "
+            f"{exc}")
+
+
+def node_failure_message(node, in_shapes, exc):
+    """A node-level diagnostic for an abstract-evaluation failure — shared
+    with ``Symbol.infer_shape``'s error path so inference errors always name
+    the offending node and op."""
+    return f"node {node.name!r} (op {node.op}): " \
+        + _failure_text(in_shapes, exc)
+
+
+# ---------------------------------------------------------------- passes ---
+
+def _walk(entries):
+    """Iterative DFS over the node DAG. Returns (postorder, cycle) where
+    `cycle` is a list of node names forming a back edge path (empty when the
+    graph is acyclic). Unlike ``symbol._topo`` this detects cycles instead
+    of silently truncating them."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {}
+    order = []
+    cycle = []
+    for root, _ in entries:
+        if color.get(id(root), WHITE) is not WHITE:
+            continue
+        stack = [(root, iter([c for c, _ in root.inputs]))]
+        color[id(root)] = GRAY
+        path = [root]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for child in it:
+                c = color.get(id(child), WHITE)
+                if c == GRAY and not cycle:
+                    # back edge: report the enclosing path once
+                    names = [n.name for n in path]
+                    try:
+                        start = next(i for i, n in enumerate(path)
+                                     if n is child)
+                    except StopIteration:
+                        start = 0
+                    cycle = names[start:] + [child.name]
+                    continue
+                if c == WHITE:
+                    color[id(child)] = GRAY
+                    stack.append((child, iter([cc for cc, _
+                                               in child.inputs])))
+                    path.append(child)
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                path.pop()
+                color[id(node)] = BLACK
+                order.append(node)
+    return order, cycle
+
+
+def _op_kwargs(node):
+    from ..attribute import is_dunder
+
+    return {k: v for k, v in node.attrs.items() if not is_dunder(k)}
+
+
+def _check_structure(order, entries, issues):
+    """Registry lookup, kwargs validation, input-edge sanity, name
+    collisions."""
+    var_names = {}
+    op_names = {}
+    head_nodes = {id(n) for n, _ in entries}
+    for node in order:
+        if node.is_var:
+            prev = var_names.get(node.name)
+            if prev is not None and prev is not node:
+                issues.append(Issue(
+                    "error", "duplicate-name", node.name, None,
+                    "two distinct variable nodes share this name; feeds "
+                    "and gradients are keyed by name, so binding is "
+                    "ambiguous"))
+            var_names[node.name] = node
+            continue
+        prev = op_names.get(node.name)
+        if prev is not None and prev is not node:
+            issues.append(Issue(
+                "warning", "duplicate-name", node.name, node.op,
+                "another op node uses the same name; saved JSON and "
+                "attr_dict entries will collide"))
+        op_names[node.name] = node
+        try:
+            op = _registry.get(node.op)
+        except KeyError as exc:
+            issues.append(Issue("error", "unknown-op", node.name, node.op,
+                                str(exc)))
+            continue
+        try:
+            op.schema.validate(_op_kwargs(node))
+        except OpParamError as exc:
+            issues.append(Issue("error", "bad-kwarg", node.name, node.op,
+                                str(exc)))
+        schema = op.schema
+        if not schema.variadic and len(node.inputs) > len(schema.inputs):
+            issues.append(Issue(
+                "error", "dangling-input", node.name, node.op,
+                f"{len(node.inputs)} inputs wired to an op declaring at "
+                f"most {len(schema.inputs)} ({schema.inputs})"))
+        # required inputs may also be satisfied as static attrs (scalar
+        # creation ops: sym.arange passes `start` as a keyword)
+        min_req = 0 if schema.variadic else sum(
+            1 for in_name in schema.inputs[:_min_required(op)]
+            if in_name not in node.attrs)
+        if len(node.inputs) < min_req:
+            issues.append(Issue(
+                "error", "dangling-input", node.name, node.op,
+                f"only {len(node.inputs)} inputs wired; op requires at "
+                f"least {min_req} of {schema.inputs}"))
+        for child, oi in node.inputs:
+            if oi >= child.num_outputs or oi < 0:
+                issues.append(Issue(
+                    "error", "dangling-input", node.name, node.op,
+                    f"input edge references output {oi} of node "
+                    f"{child.name!r}, which has only "
+                    f"{child.num_outputs} output"
+                    f"{'s' if child.num_outputs != 1 else ''}"))
+
+
+def _min_required(op):
+    """Number of leading array inputs with no default (signature-derived)."""
+    import inspect
+
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return 0
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            break
+        if p.default is inspect.Parameter.empty \
+                and p.kind is not inspect.Parameter.KEYWORD_ONLY:
+            n += 1
+        else:
+            break
+    return n
+
+
+def _check_dead_outputs(order, entries, issues):
+    consumed = set()
+    for node in order:
+        for child, oi in node.inputs:
+            consumed.add((id(child), oi))
+    heads = {(id(n), i) for n, i in entries}
+    for node in order:
+        if node.is_var or node.num_outputs <= 1:
+            continue
+        try:
+            op = _registry.get(node.op)
+        except KeyError:
+            continue
+        if not callable(op.num_outputs):
+            # fixed multi-output ops (BatchNorm & co) carry auxiliary
+            # outputs that are unconsumed by design; only hyper-parameter
+            # driven counts (SliceChannel num_outputs=3) are user intent
+            continue
+        dead = [i for i in range(node.num_outputs)
+                if (id(node), i) not in consumed
+                and (id(node), i) not in heads]
+        if dead and len(dead) < node.num_outputs:
+            issues.append(Issue(
+                "warning", "dead-output", node.name, node.op,
+                f"output{'s' if len(dead) > 1 else ''} "
+                f"{dead} of {node.num_outputs} are never consumed "
+                "(dead in the lowered graph; XLA prunes them, but the "
+                "symbol may be over-computing)"))
+
+
+def _check_shapes(order, entries, shape_hints, dtype_hints, issues):
+    """Abstract shape/dtype walk, tolerant of unknown inputs: every node
+    whose inputs are all known is evaluated; failures become node-level
+    issues instead of aborting the pass."""
+    import jax
+
+    from ..symbol.symbol import _eval_shape_node, _param_shape_rules
+
+    vals = {}
+
+    def _known(shape):
+        # MXNet convention: a 0 entry means "unknown dim" (deferred init)
+        return shape is not None and all(int(d) > 0 for d in shape)
+
+    def _conflict(a, b):
+        return len(a) != len(b) or any(
+            int(x) > 0 and int(y) > 0 and int(x) != int(y)
+            for x, y in zip(a, b))
+
+    for node in order:
+        if node.is_var:
+            declared = node.attrs.get("__shape__")
+            hinted = shape_hints.get(node.name)
+            if declared is not None and hinted is not None \
+                    and _conflict(tuple(declared), tuple(hinted)):
+                issues.append(Issue(
+                    "error", "shape-mismatch", node.name, None,
+                    f"declared __shape__ {tuple(declared)} conflicts with "
+                    f"bind-time shape {tuple(hinted)}"))
+            shape = hinted if _known(hinted) else \
+                (declared if _known(declared) else None)
+            dtype = dtype_hints.get(node.name,
+                                    node.attrs.get("__dtype__", "float32"))
+            if shape is not None:
+                try:
+                    vals[id(node), 0] = jax.ShapeDtypeStruct(
+                        tuple(shape), canonical_dtype(dtype))
+                except Exception as exc:  # bad dtype/shape attr
+                    issues.append(Issue(
+                        "error", "shape-mismatch", node.name, None,
+                        f"invalid shape/dtype declaration "
+                        f"({shape!r}, {dtype!r}): {exc}"))
+            continue
+        if any(i.is_error and i.node == node.name for i in issues):
+            continue  # structural/kwarg error already reported for it
+        in_structs = []
+        data_struct = None
+        for child, oi in node.inputs:
+            st = vals.get((id(child), oi))
+            if st is not None and data_struct is None:
+                data_struct = st
+            in_structs.append((child, oi, st))
+        try:
+            rules = _param_shape_rules(node, data_struct)
+        except Exception:
+            rules = {}
+        resolved = []
+        for child, oi, st in in_structs:
+            if st is None and child.is_var and child.name in rules:
+                try:
+                    st = jax.ShapeDtypeStruct(
+                        rules[child.name],
+                        canonical_dtype(dtype_hints.get(
+                            child.name,
+                            child.attrs.get("__dtype__", "float32"))))
+                    vals[id(child), 0] = st
+                except Exception:
+                    st = None
+            resolved.append(st)
+        if any(st is None for st in resolved):
+            continue  # inputs unknown — nothing to check abstractly
+        try:
+            outs = _eval_shape_node(node, resolved)
+        except Exception as exc:  # noqa: BLE001 — converted to a diagnostic
+            issues.append(Issue(
+                "error", "shape-mismatch", node.name, node.op,
+                _failure_text([st.shape for st in resolved], exc)))
+            continue
+        if len(outs) != node.num_outputs:
+            issues.append(Issue(
+                "error", "output-arity", node.name, node.op,
+                f"op predicts {len(outs)} output"
+                f"{'s' if len(outs) != 1 else ''} for these "
+                f"hyper-parameters but the node declares "
+                f"{node.num_outputs}"))
+        for i, st in enumerate(outs):
+            vals[id(node), i] = st
+
+
+def _check_hints(order, shape_hints, dtype_hints, issues):
+    input_names = {n.name for n in order if n.is_var}
+    for src, hints in (("shape", shape_hints), ("type", dtype_hints)):
+        for name in hints:
+            if name not in input_names:
+                issues.append(Issue(
+                    "warning", "unused-hint", name, None,
+                    f"{src} hint matches no graph input (inputs: "
+                    f"{sorted(input_names)})"))
+
+
+# ----------------------------------------------------------------- entry ---
+
+def verify_graph(symbol, shape_hints=None, type_dict=None):
+    """Run every verifier pass over ``symbol``; returns the Issue list
+    (errors and warnings, in pass order). Raises nothing itself — callers
+    decide severity handling via :func:`raise_if_errors`."""
+    shape_hints = {k: tuple(v) for k, v in (shape_hints or {}).items()}
+    dtype_hints = {k: canonical_dtype(v)
+                   for k, v in (type_dict or {}).items()}
+    issues = []
+    entries = symbol._entries
+    order, cycle = _walk(entries)
+    if cycle:
+        issues.append(Issue(
+            "error", "cycle", cycle[0], None,
+            "graph contains a cycle: " + " -> ".join(repr(n)
+                                                     for n in cycle)))
+        return issues  # no topological order: downstream passes undefined
+    _check_structure(order, entries, issues)
+    _check_dead_outputs(order, entries, issues)
+    _check_hints(order, shape_hints, dtype_hints, issues)
+    # inference consistency only when structure held up enough to try
+    if not any(i.code in ("unknown-op",) for i in issues):
+        _check_shapes(order, entries, shape_hints, dtype_hints, issues)
+    return issues

@@ -38,8 +38,9 @@ from __future__ import annotations
 import threading
 import weakref
 
-from . import autograd, engine
+from . import autograd
 from . import profiler as _profiler
+from .analysis import sanitize as _sanitize
 
 __all__ = ["LazyRef", "BulkSegment", "record", "flush", "active",
            "pending_ops"]
@@ -84,6 +85,9 @@ class LazyRef:
     def force(self):
         """Materialise: flush the owning segment, return the concrete array."""
         if self._value is None:
+            if _sanitize.ACTIVE:
+                # an implicit value read is splitting the live segment
+                _sanitize.record_sync("lazy-force")
             seg = self.segment
             if getattr(_tls, "seg", None) is seg:
                 _tls.seg = None
@@ -151,6 +155,9 @@ class BulkSegment:
         except Exception as exc:
             self.error = exc
             raise
+        if _sanitize.ACTIVE:
+            # each executed output must match the aval its LazyRef promised
+            _sanitize.check_segment(self.plan, self.refs, live, outs)
         for i, val in zip(live, outs):
             self.refs[i]._value = val
         if self.recording:

@@ -29,7 +29,6 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import autograd
-from .base import MXNetError
 
 __all__ = ["CachedOp", "current_trace", "update_state"]
 

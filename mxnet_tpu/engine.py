@@ -50,8 +50,12 @@ def wait_all() -> None:
     """
     from . import bulk as _bulk
     from . import faults as _faults
+    from .analysis import sanitize as _sanitize
     import jax
 
+    if _sanitize.ACTIVE:
+        # explicit barrier — recorded (with any open segment it truncates)
+        _sanitize.record_sync("wait_all")
     _bulk.flush()  # pending bulk segments execute before the barrier
     # 'engine.flush' injection point: deferred engine failures surface at
     # the sync point (a pending segment hits the same point inside its own

@@ -16,11 +16,7 @@ import re
 import threading
 from collections import OrderedDict
 
-from .. import autograd
-from ..base import MXNetError
 from ..cached_op import CachedOp, current_trace
-from ..context import current_context
-from ..ndarray import NDArray
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict)
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]

@@ -7,7 +7,6 @@ import logging
 
 from .... import autograd, metric as metric_mod
 from ... import Trainer
-from ...utils import split_and_load
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             LoggingHandler, StoppingHandler, TrainBegin,
                             TrainEnd)

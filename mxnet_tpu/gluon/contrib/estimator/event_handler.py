@@ -4,7 +4,6 @@ protocol TrainBegin/TrainEnd/EpochBegin/EpochEnd/BatchBegin/BatchEnd plus
 the stock handlers)."""
 from __future__ import annotations
 
-import logging
 import time
 
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",

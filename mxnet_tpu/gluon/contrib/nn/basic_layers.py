@@ -4,7 +4,7 @@
 from __future__ import annotations
 
 from .... import ndarray as nd
-from ....ndarray.sparse import RowSparseNDArray, row_sparse_array
+from ....ndarray.sparse import row_sparse_array
 from ...block import Block, HybridBlock
 from ...nn.basic_layers import BatchNorm, HybridSequential, Sequential
 

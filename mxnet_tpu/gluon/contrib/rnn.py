@@ -10,7 +10,7 @@ XLA.
 from __future__ import annotations
 
 from ...ndarray import NDArray
-from ..rnn.rnn_cell import F, RecurrentCell, _ModifierCell
+from ..rnn.rnn_cell import RecurrentCell, _ModifierCell
 
 __all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
            "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",

@@ -10,12 +10,11 @@ from __future__ import annotations
 
 import os
 import pickle
-import tarfile
 
 import numpy as _np
 
 from .... import ndarray as nd
-from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+from ..dataset import Dataset, RecordFileDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageRecordDataset", "ImageFolderDataset"]

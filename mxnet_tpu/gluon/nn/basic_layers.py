@@ -15,7 +15,6 @@ import numpy as _np
 from ... import autograd, initializer as init_mod
 from ...cached_op import update_state
 from ..block import Block, HybridBlock
-from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "Embedding", "LayerNorm", "InstanceNorm", "GroupNorm", "Flatten",

@@ -7,7 +7,6 @@ for the MXU so no NHWC special-casing is needed.
 """
 from __future__ import annotations
 
-import numpy as _np
 
 from ..block import HybridBlock
 

@@ -17,11 +17,10 @@ from collections import OrderedDict
 
 import numpy as _np
 
-from .. import autograd, initializer as init_mod
+from .. import initializer as init_mod
 from ..base import MXNetError
-from ..context import Context, cpu, current_context
-from ..ndarray import NDArray, zeros as nd_zeros
-from ..ndarray import ndarray as _ndmod
+from ..context import Context, current_context
+from ..ndarray import NDArray
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
 

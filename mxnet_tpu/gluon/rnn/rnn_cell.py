@@ -7,12 +7,10 @@ ZoneoutCell.
 """
 from __future__ import annotations
 
-import numpy as _np
 
 from ... import ndarray as F
 from ...ndarray import NDArray
 from ..block import HybridBlock
-from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",

@@ -8,7 +8,6 @@ so checkpoints are interchangeable per-parameter.
 """
 from __future__ import annotations
 
-import numpy as _np
 
 from ... import ndarray as F
 from ...ndarray import NDArray

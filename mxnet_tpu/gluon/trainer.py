@@ -13,7 +13,6 @@ explicitly provided.
 from __future__ import annotations
 
 from .. import optimizer as opt
-from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]

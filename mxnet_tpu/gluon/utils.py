@@ -8,7 +8,6 @@ from __future__ import annotations
 import hashlib
 import os
 
-from ..context import Context
 from ..ndarray import NDArray
 from .. import ndarray as nd
 

@@ -12,7 +12,6 @@ batch ahead — the standard TPU input-pipeline overlap.
 from __future__ import annotations
 
 import gzip
-import os
 import struct
 import threading
 from collections import namedtuple

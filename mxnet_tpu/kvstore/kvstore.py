@@ -26,7 +26,6 @@ API records the setting and the dist path consumes it.
 """
 from __future__ import annotations
 
-import pickle
 
 from .. import optimizer as opt_mod
 from ..ndarray import NDArray

@@ -8,7 +8,6 @@ PearsonCorrelation, Loss, Torch, Caffe, CustomMetric + np/make helpers.
 from __future__ import annotations
 
 import math
-from collections import OrderedDict
 
 import numpy
 

@@ -13,7 +13,6 @@ honored for optimizer-on-store semantics and API parity.
 from __future__ import annotations
 
 import logging
-from collections import OrderedDict
 
 from .. import optimizer as opt_mod
 from ..base import MXNetError

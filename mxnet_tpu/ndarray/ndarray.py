@@ -27,6 +27,7 @@ import numpy as _np
 from .. import _amp_core, autograd, engine
 from .. import bulk as _bulk
 from .. import profiler as _profiler
+from ..analysis import sanitize as _sanitize
 from ..base import MXNetError, canonical_dtype
 from ..context import Context, current_context
 from ..ops import registry as _reg
@@ -157,6 +158,9 @@ class NDArray:
         if self.size != 1:
             raise ValueError("The truth value of an NDArray with multiple "
                              "elements is ambiguous.")
+        if _sanitize.ACTIVE:
+            with _sanitize.synced("bool"):
+                return bool(self.asnumpy().item())
         return bool(self.asnumpy().item())
 
     def __int__(self):
@@ -181,15 +185,25 @@ class NDArray:
     def asnumpy(self) -> _np.ndarray:
         """Copy to host, blocking (the reference's WaitToRead + copy,
         `ndarray.h:370`). Deferred async errors surface here."""
+        if _sanitize.ACTIVE:
+            with _sanitize.synced("asnumpy"):
+                return _np.asarray(self._data)
         return _np.asarray(self._data)
 
     def asscalar(self):
+        if _sanitize.ACTIVE:
+            with _sanitize.synced("asscalar"):
+                return self.asnumpy().item()
         return self.asnumpy().item()
 
     def item(self):
         return self.asscalar()
 
     def wait_to_read(self):
+        if _sanitize.ACTIVE:
+            with _sanitize.synced("wait_to_read"):
+                self._data.block_until_ready()
+                return
         self._data.block_until_ready()
 
     def wait_to_write(self):
@@ -671,6 +685,10 @@ def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
             raw_out = op.fn(*raws, **kwargs)
         else:
             raw_out = op.bound(kwargs, _key=_kw_key)(*raws)
+            if _sanitize.ACTIVE:
+                # sanitizer: the op's actual outputs must match the
+                # abstract prediction the bulking recorder wires against
+                _sanitize.check_contract(op, raws, kwargs, _kw_key, raw_out)
         result = _wrap_outputs(op, raw_out, wrap)
     engine.maybe_sync([r._data for r in (result if isinstance(result, tuple) else (result,))])
     if prof_t0 is not None:

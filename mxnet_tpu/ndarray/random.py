@@ -8,7 +8,6 @@ from __future__ import annotations
 
 from .ndarray import NDArray, _invoke
 from .. import random as _rand
-from ..context import current_context
 
 __all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
            "negative_binomial", "randint", "multinomial", "shuffle", "bernoulli"]

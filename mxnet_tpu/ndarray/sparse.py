@@ -17,7 +17,7 @@ import numpy as _np
 
 import functools as _functools
 
-from .ndarray import NDArray, _invoke_fn, array
+from .ndarray import NDArray, array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
            "cast_storage", "sparse_add", "merge_duplicates"]

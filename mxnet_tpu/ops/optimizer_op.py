@@ -17,7 +17,6 @@ reference kernels do.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .registry import register

@@ -230,8 +230,13 @@ def get(name: str) -> Operator:
     try:
         return _REGISTRY[name]
     except KeyError:
+        import difflib
+
+        close = difflib.get_close_matches(name, _REGISTRY, n=3)
+        hint = f"; did you mean {close}?" if close else ""
         raise KeyError(f"operator {name!r} is not registered "
-                       f"({len(set(_REGISTRY.values()))} ops available)") from None
+                       f"({len(set(_REGISTRY.values()))} ops available"
+                       f"{hint})") from None
 
 
 def list_ops():

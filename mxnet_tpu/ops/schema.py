@@ -219,9 +219,15 @@ class OpSchema:
                 if self.open_kwargs or k in self.inputs:
                     out[k] = v
                     continue
+                import difflib
+
+                close = difflib.get_close_matches(
+                    k, list(self.params) + list(self.inputs), n=1)
+                reason = "unknown parameter"
+                if close:
+                    reason += f" (did you mean {close[0]!r}?)"
                 raise OpParamError(
-                    self.op_name, k, "unknown parameter",
-                    valid=self.params.keys())
+                    self.op_name, k, reason, valid=self.params.keys())
             out[k] = spec.coerce(self.op_name, v)
         return out
 

@@ -18,7 +18,6 @@ import math
 import numpy as _np
 
 from .. import ndarray as nd
-from ..ndarray import NDArray
 
 __all__ = ["Optimizer", "register", "create", "SGD", "Signum", "SignSGD",
            "FTML", "LARS", "LBSGD", "LAMB", "DCASGD", "NAG", "SGLD", "Adam",

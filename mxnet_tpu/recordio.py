@@ -12,7 +12,6 @@ BINARY-COMPATIBLE with the reference so existing `.rec`/`.idx` datasets
 """
 from __future__ import annotations
 
-import ctypes
 import numbers
 import os
 import struct

@@ -34,7 +34,7 @@ from collections import OrderedDict
 import numpy as _np
 
 from .. import _amp_core
-from ..base import MXNetError, canonical_dtype, name_manager
+from ..base import MXNetError, canonical_dtype
 from ..ops import registry as _registry
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
@@ -230,6 +230,30 @@ class Symbol:
         for e in self._entries:
             e[0].attrs.update(kwargs)
 
+    # ------------------------------------------------------------ verify --
+    def verify(self, type_dict=None, raise_on_error=True, **shape_hints):
+        """Run the static graph verifier (parity role: NNVM's pre-execution
+        InferShape/InferType passes + dmlc parameter validation).
+
+        Checks, without executing any device code: per-node kwargs against
+        the op schemas, shape/dtype inference consistency, dangling or
+        duplicate-name inputs, cycles, dead outputs, and unused hints.
+        Returns the full :class:`~mxnet_tpu.analysis.verify.Issue` list
+        (warnings included); raises
+        :class:`~mxnet_tpu.analysis.verify.GraphVerifyError` when
+        error-severity issues exist and ``raise_on_error`` is set.
+
+        ``shape_hints``/``type_dict`` mirror ``infer_shape``/``infer_type``
+        keywords and deepen the checked surface — without hints only
+        structural and kwarg passes can fire.
+        """
+        from ..analysis.verify import raise_if_errors, verify_graph
+
+        issues = verify_graph(self, shape_hints, type_dict)
+        if raise_on_error:
+            raise_if_errors(issues)
+        return issues
+
     # -------------------------------------------------------- shape/type --
     def infer_shape(self, **kwargs):
         """Forward shape inference (parity: symbol.py infer_shape).
@@ -279,17 +303,26 @@ class Symbol:
 
         types = {}
         for node in _topo(self._entries):
-            if node.is_var:
-                types[id(node), 0] = np.dtype(canonical_dtype(
-                    dtype_hints.get(node.name,
-                                    node.attrs.get("__dtype__", "float32"))))
-                continue
-            if "dtype" in node.attrs and node.attrs["dtype"] is not None:
-                dt = np.dtype(canonical_dtype(node.attrs["dtype"]))
-            else:
-                in_ts = [types[id(c), oi] for c, oi in node.inputs]
-                dt = (np.result_type(*in_ts) if in_ts
-                      else np.dtype("float32"))
+            try:
+                if node.is_var:
+                    types[id(node), 0] = np.dtype(canonical_dtype(
+                        dtype_hints.get(
+                            node.name,
+                            node.attrs.get("__dtype__", "float32"))))
+                    continue
+                if "dtype" in node.attrs and node.attrs["dtype"] is not None:
+                    dt = np.dtype(canonical_dtype(node.attrs["dtype"]))
+                else:
+                    in_ts = [types[id(c), oi] for c, oi in node.inputs]
+                    dt = (np.result_type(*in_ts) if in_ts
+                          else np.dtype("float32"))
+            except MXNetError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — add node diagnostics
+                raise MXNetError(
+                    f"infer_type: node {node.name!r}"
+                    f"{f' (op {node.op})' if node.op else ''}: "
+                    f"{exc}") from exc
             for i in range(node.num_outputs):
                 types[id(node), i] = dt
         arg_t = [types[id(n), 0] for n in _topo(self._entries)
@@ -356,7 +389,14 @@ class Symbol:
                             f"cannot infer shape of input {child.name!r} "
                             f"to op {node.name!r} ({node.op})")
                 resolved.append(st)
-            outs = _eval_shape_node(node, resolved)
+            try:
+                outs = _eval_shape_node(node, resolved)
+            except Exception as exc:  # noqa: BLE001 — add node diagnostics
+                from ..analysis.verify import node_failure_message
+
+                raise MXNetError(node_failure_message(
+                    node, [tuple(st.shape) for st in resolved],
+                    exc)) from exc
             for i, st in enumerate(outs):
                 vals[id(node), i] = st
                 shapes[id(node), i] = tuple(st.shape)
@@ -505,6 +545,13 @@ class Symbol:
         primary = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
         shape_hints = {k: v for k, v in kwargs.items()
                        if isinstance(v, (tuple, list))}
+        from ..analysis.verify import verify_enabled
+
+        if verify_enabled():
+            # pre-bind static checking (MXNET_TPU_VERIFY=0 opts out): a bad
+            # kwarg / wiring / shape conflict surfaces here with node-level
+            # diagnostics instead of failing inside the XLA trace below
+            self.verify(type_dict=type_dict, **shape_hints)
         shapes, dtypes = self._infer(
             shape_hints,
             {k: canonical_dtype(v) for k, v in (type_dict or {}).items()})

@@ -1,0 +1,325 @@
+"""Analysis subsystem tests: graph verifier (analysis/verify.py) and
+sync-hazard sanitizer (analysis/sanitize.py) — the NNVM-pass analogue
+(docs/ANALYSIS.md)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import sanitize
+from mxnet_tpu.analysis.verify import GraphVerifyError, verify_graph
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+# ------------------------------------------------------------- verifier ----
+
+def test_verify_clean_graph():
+    issues = _mlp().verify(data=(8, 100), softmax_label=(8,))
+    assert issues == []
+
+
+def test_verify_bad_kwarg_names_node():
+    """A bad hyper-parameter is caught with the node name, op, and the
+    valid choices (compose validates too, so plant it post-compose the way
+    a corrupt JSON would)."""
+    act = mx.sym.Activation(mx.sym.var("x"), act_type="relu", name="a1")
+    act._entries[0][0].attrs["act_type"] = "rleu"
+    with pytest.raises(GraphVerifyError) as ei:
+        act.verify()
+    msg = str(ei.value)
+    assert "bad-kwarg" in msg and "'a1'" in msg and "Activation" in msg
+    assert "relu" in msg  # valid choices listed
+    issues = act.verify(raise_on_error=False)
+    assert [i.code for i in issues if i.is_error] == ["bad-kwarg"]
+
+
+def test_verify_shape_mismatch_names_node():
+    a, b = mx.sym.var("a"), mx.sym.var("b")
+    s = mx.sym.elemwise_add(a, b, name="add0")
+    with pytest.raises(GraphVerifyError) as ei:
+        s.verify(a=(2, 3), b=(4, 5))
+    msg = str(ei.value)
+    assert "shape-mismatch" in msg and "'add0'" in msg
+    assert "(2, 3)" in msg and "(4, 5)" in msg
+
+
+def test_verify_declared_shape_conflict():
+    x = mx.sym.var("x", shape=(3, 3))
+    y = mx.sym.relu(x, name="r")
+    with pytest.raises(GraphVerifyError) as ei:
+        y.verify(x=(4, 4))
+    assert "shape-mismatch" in str(ei.value) and "'x'" in str(ei.value)
+
+
+def test_verify_dangling_input_names_node():
+    """An edge referencing an output its producer doesn't have."""
+    net = _mlp()
+    relu_node = None
+    for node in net.get_internals()._entries:
+        if node[0].name == "relu1":
+            relu_node = node[0]
+    child, _ = relu_node.inputs[0]
+    relu_node.inputs[0] = (child, 7)
+    with pytest.raises(GraphVerifyError) as ei:
+        net.verify()
+    msg = str(ei.value)
+    assert "dangling-input" in msg and "'relu1'" in msg and "7" in msg
+
+
+def test_verify_missing_inputs_flagged():
+    net = _mlp()
+    for node in net.get_internals()._entries:
+        if node[0].name == "fc1":
+            node[0].inputs = node[0].inputs[:1]  # drop the weight input
+    issues = net.verify(raise_on_error=False)
+    assert any(i.code == "dangling-input" and i.node == "fc1"
+               for i in issues if i.is_error)
+
+
+def test_verify_cycle_detected():
+    net = _mlp()
+    nodes = {n.name: n for n, _ in net.get_internals()._entries}
+    # wire fc1's input list back to the head: a back edge
+    nodes["fc1"].inputs.append((nodes["softmax"], 0))
+    with pytest.raises(GraphVerifyError) as ei:
+        net.verify()
+    msg = str(ei.value)
+    assert "cycle" in msg and "fc1" in msg and "softmax" in msg
+
+
+def test_verify_duplicate_var_name_error():
+    a1 = mx.sym.var("a")
+    a2 = mx.sym.var("a")  # distinct node, same name
+    s = mx.sym.elemwise_add(a1, a2, name="add0")
+    with pytest.raises(GraphVerifyError) as ei:
+        s.verify()
+    assert "duplicate-name" in str(ei.value)
+
+
+def test_verify_unused_hint_warning():
+    issues = _mlp().verify(raise_on_error=False, data=(8, 100),
+                           softmax_label=(8,), dta=(8, 100))
+    warn = [i for i in issues if i.code == "unused-hint"]
+    assert len(warn) == 1 and warn[0].node == "dta"
+    assert not warn[0].is_error
+
+
+def test_verify_dead_output_warning():
+    x = mx.sym.var("x")
+    parts = mx.sym.SliceChannel(x, num_outputs=3, axis=1, name="split0")
+    head = parts[0] + 1.0  # outputs 1 and 2 never consumed
+    issues = head.verify(raise_on_error=False, x=(2, 6))
+    dead = [i for i in issues if i.code == "dead-output"]
+    assert len(dead) == 1 and dead[0].node == "split0"
+    assert "[1, 2]" in dead[0].message
+
+
+def test_verify_output_arity_violation():
+    x = mx.sym.var("x")
+    parts = mx.sym.SliceChannel(x, num_outputs=3, axis=1, name="split0")
+    node = parts._entries[0][0]
+    node.attrs["num_outputs"] = 2  # lie about the hyper-parameter
+    issues = mx.sym.Group(list(parts)).verify(raise_on_error=False,
+                                              x=(2, 6))
+    assert any(i.code == "output-arity" for i in issues if i.is_error)
+
+
+def test_simple_bind_runs_verifier(monkeypatch):
+    act = mx.sym.Activation(mx.sym.var("x"), act_type="relu", name="a1")
+    act._entries[0][0].attrs["act_type"] = "rleu"
+    with pytest.raises(GraphVerifyError):
+        act.simple_bind(x=(2, 2))
+    # opt-out restores the old behaviour (error surfaces later, if at all)
+    monkeypatch.setenv("MXNET_TPU_VERIFY", "0")
+    with pytest.raises(Exception) as ei:
+        act.simple_bind(x=(2, 2))
+    assert not isinstance(ei.value, GraphVerifyError)
+
+
+def test_verify_group_and_json_roundtrip():
+    net = _mlp()
+    loaded = mx.sym.load_json(net.tojson())
+    assert loaded.verify(data=(8, 100), softmax_label=(8,)) == []
+    out1 = net.eval_with({"data": mx.nd.ones((2, 100)),
+                          "fc1_weight": mx.nd.ones((16, 100)),
+                          "fc1_bias": mx.nd.zeros((16,)),
+                          "fc2_weight": mx.nd.ones((4, 16)),
+                          "fc2_bias": mx.nd.zeros((4,)),
+                          "softmax_label": mx.nd.zeros((2,))})
+    assert out1.shape == (2, 4)
+
+
+def test_infer_shape_error_names_node():
+    """Satellite: infer_shape failures carry node-level diagnostics."""
+    a, b = mx.sym.var("a"), mx.sym.var("b")
+    s = mx.sym.elemwise_add(a, b, name="add0")
+    with pytest.raises(mx.MXNetError) as ei:
+        s.infer_shape(a=(2, 3), b=(4, 5))
+    msg = str(ei.value)
+    assert "'add0'" in msg and "elemwise_add" in msg
+    assert "(2, 3)" in msg and "(4, 5)" in msg
+
+
+def test_infer_type_error_names_node():
+    x = mx.sym.var("x")
+    y = mx.sym.Cast(x, dtype="float16", name="cast0")
+    y._entries[0][0].attrs["dtype"] = "floatsixteen"
+    with pytest.raises(mx.MXNetError) as ei:
+        y.infer_type(x="float32")
+    msg = str(ei.value)
+    assert "'cast0'" in msg and "Cast" in msg
+
+
+def test_verify_graph_function_api():
+    issues = verify_graph(_mlp(), {"data": (8, 100)}, {"data": "float32"})
+    assert issues == []
+
+
+# ------------------------------------------------------------ sanitizer ----
+
+@pytest.fixture
+def clean_sanitizer():
+    sanitize.reset()
+    yield
+    sanitize.disable()
+    sanitize.reset()
+
+
+def test_sanitizer_disabled_by_default(clean_sanitizer):
+    x = mx.nd.ones((2, 2))
+    _ = x.asnumpy()
+    assert sanitize.events() == []
+
+
+def test_sanitizer_records_syncs_with_callsite(clean_sanitizer):
+    with sanitize.sanitize():
+        x = mx.nd.ones((2, 2))
+        _ = x.asnumpy()
+        _ = (x.sum()).asscalar()
+        _ = bool(x[0, 0] > 0)
+        x.wait_to_read()
+    kinds = [e.kind for e in sanitize.events()]
+    assert kinds == ["asnumpy", "asscalar", "bool", "wait_to_read"]
+    assert all(__file__ in e.site for e in sanitize.events())
+    assert sanitize.hazards() == []  # no segment was open
+
+
+def test_sanitizer_flags_mid_segment_sync(clean_sanitizer):
+    """Acceptance: a planted host sync inside a live bulk segment is
+    flagged as a hazard, exactly once, with the user call site."""
+    with sanitize.sanitize():
+        with mx.engine.bulk(8):
+            a = mx.nd.ones((4, 4))
+            c = (a * 2) + 1
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                np.testing.assert_allclose(c.asnumpy(), 3.0)  # splits it
+            hazard_warns = [x for x in w
+                            if issubclass(x.category,
+                                          sanitize.SyncHazardWarning)]
+            assert len(hazard_warns) == 1
+            assert "split a live bulk segment of 2" in \
+                str(hazard_warns[0].message)
+    hz = sanitize.hazards()
+    assert len(hz) == 1 and hz[0].kind == "asnumpy" and hz[0].pending == 2
+    assert "test_analysis.py" in hz[0].site
+
+
+def test_sanitizer_lazy_force_hazard(clean_sanitizer):
+    """A raw buffer read (not via asnumpy) also records, as lazy-force."""
+    with sanitize.sanitize():
+        with mx.engine.bulk(8):
+            a = mx.nd.ones((4, 4))
+            b = a * 2
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", sanitize.SyncHazardWarning)
+                _ = b._data  # direct force
+    hz = sanitize.hazards()
+    assert len(hz) == 1 and hz[0].kind == "lazy-force"
+
+
+def test_sanitizer_clean_bulk_flush_not_flagged(clean_sanitizer):
+    with sanitize.sanitize():
+        with mx.engine.bulk(4):
+            a = mx.nd.ones((4, 4))
+            c = (a * 2) + 1
+        # scope exit flushed the segment: reading now is not a hazard
+        np.testing.assert_allclose(c.asnumpy(), 3.0)
+    assert sanitize.hazards() == []
+
+
+def test_sanitizer_contract_violation_eager(clean_sanitizer):
+    """Acceptance: an output-aval contract violation (stale/poisoned
+    inference cache) is reported with the op name and call site."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import registry
+
+    op = registry.get("relu")
+    x = mx.nd.ones((3,))
+    in_sig = ((tuple(x.shape), x._data.dtype),)
+    op._aval_cache[((), in_sig)] = (
+        (jax.ShapeDtypeStruct((99,), jnp.float32),), True)
+    try:
+        with sanitize.sanitize():
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                y = mx.nd.invoke("relu", x)
+            assert y.shape == (3,)  # execution itself is unaffected
+            msgs = [str(x.message) for x in w
+                    if issubclass(x.category, sanitize.SyncHazardWarning)]
+            assert len(msgs) == 1
+            assert "contract violation" in msgs[0] and "relu" in msgs[0]
+            assert "(99,)" in msgs[0] and "(3,)" in msgs[0]
+    finally:
+        op._aval_cache.clear()
+    ev = [e for e in sanitize.events() if e.kind == "contract"]
+    assert len(ev) == 1 and ev[0].hazard
+
+
+def test_sanitizer_contract_violation_in_segment(clean_sanitizer):
+    """The fused-segment runner cross-checks too: poison the prediction the
+    recorder will wire against, then flush."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import registry
+
+    op = registry.get("_plus_scalar")
+    x = mx.nd.ones((5,))
+    in_sig = ((tuple(x.shape), x._data.dtype),)
+    kwargs, key = op.checked({"scalar": 1.0})
+    op._aval_cache[(key, in_sig)] = (
+        (jax.ShapeDtypeStruct((7,), jnp.float32),), True)
+    try:
+        with sanitize.sanitize():
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                with mx.engine.bulk(8):
+                    y = x + 1.0  # recorded with the poisoned aval
+                assert y.shape == (7,)  # the recorder believed the lie
+            msgs = [str(x.message) for x in w
+                    if "contract violation" in str(x.message)]
+            assert msgs and "bulk segment" in msgs[0]
+    finally:
+        op._aval_cache.clear()
+
+
+def test_sanitizer_reset_and_bounded(clean_sanitizer):
+    with sanitize.sanitize():
+        x = mx.nd.ones((1,))
+        for _ in range(3):
+            x.asnumpy()
+    assert len(sanitize.events()) == 3
+    sanitize.reset()
+    assert sanitize.events() == []

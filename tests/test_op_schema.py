@@ -1,0 +1,165 @@
+"""OpSchema / ParamSpec error-path coverage (satellite of the analysis PR):
+OpParamError message quality — op name, parameter, valid choices /
+expected types — asserted across representative ops, plus the
+tojson -> load -> verify round trip."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+from mxnet_tpu.ops.schema import OpParamError, OpSchema, ParamSpec
+
+
+# ------------------------------------------------ representative op errors --
+
+def test_activation_bad_choice_message():
+    with pytest.raises(OpParamError) as ei:
+        registry.get("Activation").check_kwargs({"act_type": "rleu"})
+    msg = str(ei.value)
+    assert "'Activation'" in msg and "'act_type'" in msg
+    assert "'rleu'" in msg and "relu" in msg and "sigmoid" in msg
+    assert ei.value.op_name == "Activation"
+    assert ei.value.param == "act_type"
+
+
+def test_pooling_bad_choice_message():
+    with pytest.raises(OpParamError) as ei:
+        registry.get("Pooling").check_kwargs({"pool_type": "average"})
+    msg = str(ei.value)
+    assert "'Pooling'" in msg and "'pool_type'" in msg
+    assert "max" in msg and "avg" in msg
+
+
+def test_dropout_range_message():
+    with pytest.raises(OpParamError) as ei:
+        registry.get("Dropout").check_kwargs({"p": 1.5})
+    msg = str(ei.value)
+    assert "'Dropout'" in msg and "'p'" in msg and "maximum" in msg
+    with pytest.raises(OpParamError) as ei:
+        registry.get("Dropout").check_kwargs({"p": -0.1})
+    assert "minimum" in str(ei.value)
+
+
+def test_fully_connected_unknown_param_suggests():
+    with pytest.raises(OpParamError) as ei:
+        registry.get("FullyConnected").check_kwargs({"num_hiden": 16})
+    msg = str(ei.value)
+    assert "'FullyConnected'" in msg and "'num_hiden'" in msg
+    assert "did you mean 'num_hidden'" in msg
+    assert "valid parameters" in msg and "no_bias" in msg
+
+
+def test_convolution_scalar_for_shape_message():
+    with pytest.raises(OpParamError) as ei:
+        registry.get("Convolution").check_kwargs({"kernel": 3,
+                                                  "num_filter": 8})
+    msg = str(ei.value)
+    assert "'Convolution'" in msg and "'kernel'" in msg
+    assert "expected tuple" in msg and "int" in msg
+
+
+def test_concat_string_parse_failure_message():
+    with pytest.raises(OpParamError) as ei:
+        registry.get("Concat").check_kwargs({"dim": "one"})
+    msg = str(ei.value)
+    assert "'Concat'" in msg and "'dim'" in msg and "cannot parse" in msg
+
+
+def test_registry_unknown_op_suggests():
+    with pytest.raises(KeyError) as ei:
+        registry.get("Activaton")
+    assert "Activation" in str(ei.value)
+
+
+# ------------------------------------------------------- string coercion ----
+
+def test_coerce_dmlc_string_forms():
+    """Symbol-JSON attrs arrive as dmlc strings; coercion must round them
+    back to typed values."""
+    op = registry.get("Pooling")
+    out = op.check_kwargs({"kernel": "(2, 2)", "stride": "(2, 2)",
+                           "global_pool": "True", "pool_type": "avg"})
+    assert out["kernel"] == (2, 2) and isinstance(out["kernel"], tuple)
+    assert out["global_pool"] is True
+    out = registry.get("Dropout").check_kwargs({"p": "0.25"})
+    assert out["p"] == pytest.approx(0.25)
+
+
+def test_coerce_int_float_promotions():
+    spec = ParamSpec("x", type=float, default=0.0)
+    assert spec.coerce("op", 2) == 2.0
+    spec_i = ParamSpec("n", type=int, default=1)
+    assert spec_i.coerce("op", 3.0) == 3
+    spec_b = ParamSpec("flag", type=bool, default=False)
+    assert spec_b.coerce("op", 1) is True
+
+
+def test_coerce_choices_and_range_direct():
+    spec = ParamSpec("mode", type=str, default="a", choices=("a", "b"))
+    with pytest.raises(OpParamError) as ei:
+        spec.coerce("myop", "c")
+    assert "'myop'" in str(ei.value) and "['a', 'b']" in str(ei.value)
+    spec = ParamSpec("k", type=int, default=1, low=1, high=5)
+    with pytest.raises(OpParamError):
+        spec.coerce("myop", 0)
+    with pytest.raises(OpParamError):
+        spec.coerce("myop", 9)
+    assert spec.coerce("myop", "3") == 3
+
+
+def test_schema_from_fn_override_typo_rejected():
+    def fake_op(data, alpha=1.0):
+        return data
+
+    with pytest.raises(ValueError) as ei:
+        OpSchema.from_fn("fake", fake_op, {"alhpa": {"low": 0.0}})
+    assert "alhpa" in str(ei.value)
+
+
+def test_validate_does_not_mutate_input():
+    op = registry.get("Dropout")
+    kwargs = {"p": "0.5"}
+    out = op.schema.validate(kwargs)
+    assert kwargs == {"p": "0.5"} and out["p"] == 0.5
+
+
+# ------------------------------------------------------ JSON round trip -----
+
+def test_tojson_load_verify_roundtrip():
+    """Acceptance: save -> load -> verify() stays clean, and a corrupted
+    attr in the JSON is caught at load (compose-time validation) while a
+    corrupted wiring is caught by verify()."""
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                              pad=(1, 1), name="conv")
+    bn = mx.sym.BatchNorm(conv, name="bn")
+    act = mx.sym.Activation(bn[0] if len(bn) > 1 else bn,
+                            act_type="relu", name="act")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="pool")
+    js = pool.tojson()
+    loaded = mx.sym.load_json(js)
+    assert loaded.verify(data=(2, 3, 8, 8)) == []
+    assert loaded.list_arguments() == pool.list_arguments()
+    # shapes agree through the round trip
+    s1 = pool.infer_shape(data=(2, 3, 8, 8))[1]
+    s2 = loaded.infer_shape(data=(2, 3, 8, 8))[1]
+    assert s1 == s2
+
+    # corrupt a hyper-parameter value: structured error at load
+    bad = js.replace('"pool_type": "max"', '"pool_type": "mox"')
+    with pytest.raises(OpParamError) as ei:
+        mx.sym.load_json(bad)
+    assert "'Pooling'" in str(ei.value) and "'mox'" in str(ei.value)
+
+    # corrupt the wiring: verify() reports it with the node name
+    import json as _json
+
+    graph = _json.loads(js)
+    for node in graph["nodes"]:
+        if node["name"] == "act":
+            node["inputs"][0][1] = 5  # bogus output index
+    mangled = mx.sym.load_json(_json.dumps(graph))
+    issues = mangled.verify(raise_on_error=False)
+    assert any(i.code == "dangling-input" and i.node == "act"
+               for i in issues if i.is_error)

@@ -88,6 +88,84 @@ def test_rec2idx_matches_writer(tmp_path):
     assert r.read_idx(7) == payloads[7]
 
 
+@pytest.mark.lint
+def test_mxlint_self_run_clean():
+    """CI gate: the repo must lint clean against the committed baseline —
+    new violations of the framework rules (docs/ANALYSIS.md) fail here.
+    Addressable alone via `pytest -m lint`."""
+    import mxlint
+
+    rc = mxlint.main(["mxnet_tpu"])
+    assert rc == 0, "new mxlint violations vs tools/mxlint_baseline.txt"
+
+
+@pytest.mark.lint
+def test_mxlint_catches_planted_violations(tmp_path):
+    """The linter actually fires on each rule it claims to enforce."""
+    import mxlint
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"                                    # unused-import
+        "import numpy as np\n"
+        "from jax.experimental import enable_x64\n"      # raw-jax-compat
+        "from mxnet_tpu.ops.registry import register\n"
+        "def f(x, y=[]):\n"                              # mutable-default
+        "    try:\n"
+        "        v = x.asnumpy()\n"                      # host-sync
+        "    except:\n"                                  # bare-except
+        "        v = np.random.uniform()\n"              # unseeded-random
+        "    return v\n"
+        "@register('badop')\n"
+        "def badop(data):\n"                             # no-schema-doc
+        "    return data\n")
+    findings = mxlint.run([str(bad)], root=str(tmp_path))
+    rules = {f.rule for f in findings}
+    assert rules == {"unused-import", "raw-jax-compat", "mutable-default",
+                     "host-sync", "bare-except", "unseeded-random",
+                     "no-schema-doc"}
+    # noqa suppression works, per-rule
+    ok = tmp_path / "ok.py"
+    ok.write_text("v = x.asnumpy()  # noqa: host-sync\n")
+    assert mxlint.run([str(ok)], root=str(tmp_path)) == []
+
+
+@pytest.mark.lint
+def test_mxlint_baseline_gate_blocks_regressions(tmp_path):
+    """Baseline semantics: within-count passes, one extra finding fails."""
+    import mxlint
+
+    f = tmp_path / "m.py"
+    f.write_text("a = x.asnumpy()\n")
+    base = tmp_path / "base.txt"
+    base.write_text("host-sync m.py 1  # tolerated legacy sync\n")
+    assert mxlint.main([str(f), "--root", str(tmp_path),
+                        "--baseline", str(base)]) == 0
+    f.write_text("a = x.asnumpy()\nb = y.asnumpy()\n")
+    assert mxlint.main([str(f), "--root", str(tmp_path),
+                        "--baseline", str(base)]) == 1
+
+
+def test_verifier_smoke_every_model_zoo_symbol():
+    """Every model-zoo network traces to a Symbol that passes the graph
+    verifier with only an input-shape hint (deferred-init parameter shapes
+    resolve abstractly — no forward pass, no device compile)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    checked = 0
+    for name in vision.__all__:
+        if name == "get_model":
+            continue
+        net = getattr(vision, name)(classes=10)
+        net.initialize()
+        sym = net._trace_symbol()
+        issues = sym.verify(raise_on_error=False, data=(1, 3, 224, 224))
+        errors = [i for i in issues if i.is_error]
+        assert not errors, f"{name}: {errors[:3]}"
+        checked += 1
+    assert checked >= 30  # the whole zoo, not a sample
+
+
 def test_chaos_smoke_recovers(tmp_path):
     """tools/chaos_smoke.py: 2-epoch toy fit under the canned fault
     schedule — NaN guard absorbs a poisoned batch, checkpoint-write

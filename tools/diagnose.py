@@ -79,6 +79,25 @@ def check_environment():
             print(f"{k}={v}")
 
 
+def check_analysis():
+    """The static-analysis knobs (docs/ANALYSIS.md) with effective state."""
+    print("---------Analysis Knobs--------")
+    verify = os.environ.get("MXNET_TPU_VERIFY", "<unset>")
+    sanitize = os.environ.get("MXNET_TPU_SANITIZE", "<unset>")
+    print(f"MXNET_TPU_VERIFY={verify}  "
+          "(graph verifier inside simple_bind; on unless 0)")
+    print(f"MXNET_TPU_SANITIZE={sanitize}  "
+          "(sync-hazard sanitizer; off unless 1)")
+    try:
+        from mxnet_tpu.analysis import sanitize as _san
+        from mxnet_tpu.analysis.verify import verify_enabled
+
+        print("effective     : verify=%s sanitize=%s"
+              % (verify_enabled(), _san.ACTIVE))
+    except ImportError as e:
+        print("analysis import failed:", e)
+
+
 def main():
     check_python()
     check_pip()
@@ -86,6 +105,7 @@ def main():
     check_deps()
     check_hardware()
     check_environment()
+    check_analysis()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""mxlint — framework-aware AST lint for the mxnet_tpu library itself.
+
+The third leg of the analysis subsystem (graph verifier / sync-hazard
+sanitizer / source linter): rules that encode *this framework's* contracts,
+which generic linters cannot know about.
+
+Rules
+-----
+bare-except       ``except:`` swallows KeyboardInterrupt/SystemExit and
+                  every deferred engine error — name the exception type.
+host-sync         ``.asnumpy()`` / ``.asscalar()`` / ``.item()`` in library
+                  code — each is a device round-trip and splits any live
+                  bulk segment; hot paths must stay async.
+raw-jax-compat    ``shard_map`` / ``enable_x64`` / ``pcast`` taken from jax
+                  directly: their home moved across jax versions, so call
+                  sites must go through ``mxnet_tpu._jax_compat``.
+unseeded-random   module-level ``np.random.*`` draws bypass the seeded
+                  stream (``mxnet_tpu.random`` / an explicit RandomState):
+                  nondeterminism ``mx.random.seed`` cannot control.
+no-schema-doc     an op registered via ``@register(...)`` without a
+                  docstring — the reflected schema dump (``op_schemas``,
+                  opperf arg synthesis, doc generation) has nothing to show.
+unused-import     module-level import never referenced in the file.
+mutable-default   ``def f(x=[] / {} / set())`` — shared-state bug class.
+
+Baseline workflow
+-----------------
+Existing findings live in ``tools/mxlint_baseline.txt`` as
+``<rule> <path> <count>  # justification`` lines; a run fails ONLY when a
+(rule, file) pair exceeds its baselined count, so CI is green on legacy
+debt but red on new violations. Shrink the baseline as debt burns down
+(`--write-baseline` regenerates it; stale surplus entries are reported).
+
+Suppression: a ``# noqa`` or ``# noqa: <rule>`` comment on the offending
+line, for violations that are deliberate (e.g. the one blessed host sync
+inside ``asnumpy`` itself).
+
+Usage
+-----
+    python tools/mxlint.py mxnet_tpu                # gate vs baseline
+    python tools/mxlint.py --no-baseline mxnet_tpu  # every finding
+    python tools/mxlint.py --write-baseline mxnet_tpu
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from collections import Counter
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "mxlint_baseline.txt")
+
+RULES = ("bare-except", "host-sync", "raw-jax-compat", "unseeded-random",
+         "no-schema-doc", "unused-import", "mutable-default")
+
+_SYNC_METHODS = {"asnumpy", "asscalar"}
+_COMPAT_NAMES = {"shard_map", "enable_x64", "pcast"}
+_NP_RANDOM_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "ranf", "sample",
+    "uniform", "normal", "standard_normal", "choice", "shuffle",
+    "permutation", "beta", "binomial", "exponential", "gamma", "poisson",
+    "multinomial", "bytes",
+}
+_NP_ALIASES = {"np", "_np", "onp", "_onp", "numpy"}
+
+
+class Finding:
+    __slots__ = ("path", "line", "col", "rule", "message")
+
+    def __init__(self, path, line, col, rule, message):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"{self.rule}: {self.message}"
+
+
+def _dotted(node):
+    """'jax.experimental.shard_map' for a nested Attribute/Name chain, or
+    None when the chain has non-name parts (calls, subscripts)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path, rel, source):
+        self.path = path
+        self.rel = rel
+        self.findings = []
+        self.lines = source.splitlines()
+        self.is_init = os.path.basename(path) == "__init__.py"
+        self.is_compat = os.path.basename(path) == "_jax_compat.py"
+        # module-level import bookkeeping for unused-import
+        self.imports = {}   # local name -> (lineno, col, "import x" repr)
+        self.used = set()
+        self.dunder_all = set()
+
+    # ------------------------------------------------------------ helpers --
+    def add(self, node, rule, message):
+        line = getattr(node, "lineno", 1)
+        text = self.lines[line - 1] if line <= len(self.lines) else ""
+        if "# noqa" in text:
+            tail = text.split("# noqa", 1)[1]
+            if not tail.startswith(":") or rule in tail:
+                return
+        self.findings.append(Finding(
+            self.rel, line, getattr(node, "col_offset", 0), rule, message))
+
+    # ------------------------------------------------------------- visits --
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.add(node, "bare-except",
+                     "bare 'except:' also catches KeyboardInterrupt/"
+                     "SystemExit and deferred engine errors; name the "
+                     "exception type")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_METHODS and not node.args \
+                    and not node.keywords:
+                self.add(node, "host-sync",
+                         f".{func.attr}() is a blocking device->host "
+                         "round-trip (and splits any live bulk segment); "
+                         "library hot paths must stay async")
+            chain = _dotted(func)
+            if chain is not None:
+                self._check_np_random(node, chain)
+        self.generic_visit(node)
+
+    def _check_np_random(self, node, chain):
+        parts = chain.split(".")
+        if len(parts) == 3 and parts[0] in _NP_ALIASES \
+                and parts[1] == "random" and parts[2] in _NP_RANDOM_FNS:
+            self.add(node, "unseeded-random",
+                     f"{chain}() draws from numpy's global unseeded stream; "
+                     "use mxnet_tpu.random (device ops) or a RandomState/"
+                     "default_rng threaded from a seed (host-side shuffles)")
+
+    def visit_Attribute(self, node):
+        if not self.is_compat and node.attr in _COMPAT_NAMES:
+            chain = _dotted(node)
+            if chain is not None and chain.split(".")[0] == "jax":
+                self.add(node, "raw-jax-compat",
+                         f"{chain} moved across jax versions; route through "
+                         "mxnet_tpu._jax_compat")
+        self._mark_used(node)
+        # do NOT generic_visit: _mark_used consumed the name chain
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def _mark_used(self, node):
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        if isinstance(node, ast.Name):
+            self.used.add(node.id)
+        else:
+            self.generic_visit(node)
+
+    def visit_Import(self, node):
+        self._collect_import(node,
+                             ((a.asname or a.name.split(".")[0], a.name)
+                              for a in node.names))
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if mod == "__future__":
+            return
+        if not self.is_compat and mod.split(".")[0] == "jax":
+            for a in node.names:
+                if a.name in _COMPAT_NAMES:
+                    self.add(node, "raw-jax-compat",
+                             f"'from {mod} import {a.name}' moved across "
+                             "jax versions; route through "
+                             "mxnet_tpu._jax_compat")
+        self._collect_import(node, ((a.asname or a.name, a.name)
+                                    for a in node.names))
+
+    def _collect_import(self, node, names):
+        if node.col_offset != 0 or self.is_init:
+            # only module-level imports outside __init__ re-export files
+            return
+        for local, orig in names:
+            if local == "*":
+                continue
+            self.imports.setdefault(local, (node, orig))
+
+    def visit_FunctionDef(self, node, _async=False):
+        self._check_register_doc(node)
+        self._check_mutable_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.visit_FunctionDef(node, _async=True)
+
+    def _check_register_doc(self, node):
+        for deco in node.decorator_list:
+            call = deco if isinstance(deco, ast.Call) else None
+            target = call.func if call else deco
+            name = target.attr if isinstance(target, ast.Attribute) \
+                else getattr(target, "id", None)
+            if name == "register" and ast.get_docstring(node) is None:
+                self.add(node, "no-schema-doc",
+                         f"op function {node.name!r} is registered without "
+                         "a docstring; the reflected schema dump "
+                         "(op_schemas/opperf/docs) has nothing to show")
+
+    def _check_mutable_defaults(self, node):
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set")):
+                self.add(d, "mutable-default",
+                         "mutable default argument is shared across calls; "
+                         "default to None (or a tuple) instead")
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "__all__" \
+                    and isinstance(node.value, (ast.List, ast.Tuple)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        self.dunder_all.add(elt.value)
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------- finish --
+    def finish(self, tree):
+        # names used in nested strings (getattr-style) are not tracked —
+        # unused-import stays conservative: report only plain never-seen
+        # names, skipping noqa'd lines via add()
+        for local, (node, orig) in self.imports.items():
+            if local in self.used or local in self.dunder_all:
+                continue
+            self.add(node, "unused-import",
+                     f"imported name {local!r} "
+                     f"({orig}) is never used in this module")
+        return self.findings
+
+
+def lint_file(path, rel):
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as exc:
+        return [Finding(rel, 1, 0, "bare-except", f"unreadable: {exc}")]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(rel, exc.lineno or 1, 0, "bare-except",
+                        f"syntax error: {exc.msg}")]
+    linter = _Linter(path, rel, source)
+    linter.visit(tree)
+    return linter.finish(tree)
+
+
+def iter_py_files(targets, root):
+    for target in targets:
+        target = os.path.join(root, target) if not os.path.isabs(target) \
+            else target
+        if os.path.isfile(target):
+            yield target
+            continue
+        for dirpath, dirnames, filenames in os.walk(target):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run(targets, root=None):
+    """Lint `targets` (files/dirs); returns findings with root-relative
+    paths."""
+    root = root or os.getcwd()
+    findings = []
+    for path in iter_py_files(targets, root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        findings.extend(lint_file(path, rel))
+    return findings
+
+
+# ------------------------------------------------------------- baseline ----
+
+def load_baseline(path):
+    """{(rule, relpath): allowed_count} from the checked-in baseline."""
+    allowed = {}
+    if not os.path.exists(path):
+        return allowed
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            try:
+                rule, rel, count = line.split()
+                allowed[(rule, rel)] = int(count)
+            except ValueError:
+                print(f"mxlint: malformed baseline line ignored: {raw!r}",
+                      file=sys.stderr)
+    return allowed
+
+
+def write_baseline(path, findings):
+    counts = Counter((f.rule, f.path) for f in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# mxlint baseline — legacy findings tolerated by the CI "
+                "gate.\n# Format: <rule> <path> <count>  [# justification]"
+                "\n# Regenerate: python tools/mxlint.py --write-baseline "
+                "mxnet_tpu\n")
+        for (rule, rel), n in sorted(counts.items()):
+            f.write(f"{rule} {rel} {n}\n")
+
+
+def compare(findings, allowed):
+    """(new, fixed): findings beyond baseline counts, and baseline surplus
+    that can now be shrunk."""
+    counts = Counter((f.rule, f.path) for f in findings)
+    new = []
+    for key, n in sorted(counts.items()):
+        extra = n - allowed.get(key, 0)
+        if extra > 0:
+            rule, rel = key
+            culprits = [f for f in findings if (f.rule, f.path) == key]
+            new.append((rule, rel, extra, culprits))
+    fixed = [(rule, rel, allowed[(rule, rel)] - counts.get((rule, rel), 0))
+             for (rule, rel) in sorted(allowed)
+             if allowed[(rule, rel)] > counts.get((rule, rel), 0)]
+    return new, fixed
+
+
+# ------------------------------------------------------------------ main ---
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description="framework-aware lint for mxnet_tpu")
+    ap.add_argument("targets", nargs="+", help="files or directories")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths (default: cwd, or "
+                         "the repo containing this script)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/mxlint_baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; exit 1 if any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings")
+    ap.add_argument("--rule", action="append", choices=RULES,
+                    help="restrict to specific rule(s)")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    findings = run(args.targets, root=root)
+    if args.rule:
+        findings = [f for f in findings if f.rule in args.rule]
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"mxlint: baseline written to {args.baseline} "
+              f"({len(findings)} findings)")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f)
+        print(f"mxlint: {len(findings)} finding"
+              f"{'s' if len(findings) != 1 else ''}")
+        return 1 if findings else 0
+
+    allowed = load_baseline(args.baseline)
+    new, fixed = compare(findings, allowed)
+    for rule, rel, extra, culprits in new:
+        print(f"mxlint: {rel}: {extra} new {rule} violation"
+              f"{'s' if extra != 1 else ''} "
+              f"(baseline {allowed.get((rule, rel), 0)}, "
+              f"now {len(culprits)}):")
+        for f in culprits:
+            print(f"  {f}")
+    for rule, rel, surplus in fixed:
+        print(f"mxlint: note: baseline for ({rule}, {rel}) can shrink by "
+              f"{surplus} — run --write-baseline to lock in the burn-down")
+    if new:
+        print("mxlint: FAIL — fix the new violations, add '# noqa: <rule>' "
+              "with cause, or (last resort) re-baseline with a "
+              "justification comment")
+        return 1
+    print(f"mxlint: OK ({len(findings)} findings, all within baseline)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
