@@ -43,7 +43,7 @@ from . import profiler as _profiler
 from .analysis import sanitize as _sanitize
 
 __all__ = ["LazyRef", "BulkSegment", "record", "flush", "active",
-           "pending_ops"]
+           "pending_ops", "live_segments"]
 
 _tls = threading.local()
 
@@ -51,6 +51,23 @@ _tls = threading.local()
 # jax.jit's own signature cache keys shapes/dtypes below these.
 _FUSED_CACHE = {}
 _VJP_CACHE = {}
+
+# every not-yet-successfully-executed segment, across threads — the
+# watchdog's crash bundles report this as the "live bulk-segment state"
+# (a wedged flush shows exactly which fused op sequence was in flight)
+_LIVE = weakref.WeakSet()
+_live_lock = threading.Lock()
+
+
+def live_segments():
+    """Snapshot of pending/failed segments as plain dicts (crash bundles,
+    diagnose tooling). Successful runs remove themselves."""
+    with _live_lock:
+        segs = list(_LIVE)
+    return [{"n_ops": len(s.plan), "ops": [p[0] for p in s.plan],
+             "recording": s.recording,
+             "error": repr(s.error) if s.error is not None else None}
+            for s in segs if s.plan]
 
 _Tracer = None  # lazily bound jax.core.Tracer (keep jax import off cold path)
 
@@ -99,7 +116,7 @@ class BulkSegment:
     """An open sequence of recorded op calls awaiting fused execution."""
 
     __slots__ = ("recording", "steps", "plan", "ext_raws", "ext_handles",
-                 "ext_index", "refs", "handles", "error")
+                 "ext_index", "refs", "handles", "error", "__weakref__")
 
     def __init__(self, recording):
         self.recording = recording  # autograd state the segment was opened in
@@ -111,6 +128,12 @@ class BulkSegment:
         self.refs = []         # flat LazyRef list across all steps
         self.handles = []      # weakrefs to the wrapped output NDArrays
         self.error = None
+        with _live_lock:
+            _LIVE.add(self)
+
+    def _retire(self):
+        with _live_lock:
+            _LIVE.discard(self)
 
     # ----------------------------------------------------------- execute ---
     def run(self):
@@ -128,14 +151,17 @@ class BulkSegment:
         if self.error is not None:
             raise self.error
         if not self.plan:
+            self._retire()
             return
         live = [i for i, wh in enumerate(self.handles)
                 if wh() is not None]
         if not live:
+            self._retire()
             return
         import jax
 
         from . import faults as _faults
+        from . import watchdog as _watchdog
 
         prof = _profiler._REC_IMPERATIVE
         t0 = _profiler._now_us() if prof else None
@@ -145,13 +171,21 @@ class BulkSegment:
         if fused is None:
             fused = _FUSED_CACHE[plan_key] = jax.jit(
                 _build_fused(self.steps, live_t))
-        try:
+
+        def _execute():
             # 'engine.flush' injection point: an injected failure behaves
             # exactly like an op failing inside the fused segment — it
             # surfaces HERE, at the sync point, and stays sticky on the
             # segment (the deferred-exception contract under test)
             _faults.point("engine.flush")
-            outs = fused(*self.ext_raws)
+            return fused(*self.ext_raws)
+
+        try:
+            # deadline-bounded when an 'engine.flush' watchdog deadline is
+            # armed — a wedged flush raises StallError at the sync point
+            # (sticky, like any other deferred engine error)
+            outs = _watchdog.sync("engine.flush", _execute,
+                                  label=f"bulk[{len(self.plan)}]")
         except Exception as exc:
             self.error = exc
             raise
@@ -160,6 +194,7 @@ class BulkSegment:
             _sanitize.check_segment(self.plan, self.refs, live, outs)
         for i, val in zip(live, outs):
             self.refs[i]._value = val
+        self._retire()  # executed: no longer "live" for crash bundles
         if self.recording:
             taped_idx = tuple(i for i in live if self.refs[i].taped)
             if taped_idx:

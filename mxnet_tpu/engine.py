@@ -50,6 +50,7 @@ def wait_all() -> None:
     """
     from . import bulk as _bulk
     from . import faults as _faults
+    from . import watchdog as _watchdog
     from .analysis import sanitize as _sanitize
     import jax
 
@@ -57,13 +58,19 @@ def wait_all() -> None:
         # explicit barrier — recorded (with any open segment it truncates)
         _sanitize.record_sync("wait_all")
     _bulk.flush()  # pending bulk segments execute before the barrier
-    # 'engine.flush' injection point: deferred engine failures surface at
-    # the sync point (a pending segment hits the same point inside its own
-    # flush above, so a wait_all that flushes work counts twice — once per
-    # sync layer)
-    _faults.point("engine.flush")
-    # effects_barrier drains all dispatched computations on all backends.
-    jax.effects_barrier()
+
+    def _barrier():
+        # 'engine.flush' injection point: deferred engine failures surface
+        # at the sync point (a pending segment hits the same point inside
+        # its own flush above, so a wait_all that flushes work counts
+        # twice — once per sync layer)
+        _faults.point("engine.flush")
+        # effects_barrier drains all dispatched computations everywhere.
+        jax.effects_barrier()
+
+    # deadline-bounded when an 'engine.flush' watchdog deadline is armed:
+    # a wedged barrier surfaces as StallError instead of blocking forever
+    _watchdog.sync("engine.flush", _barrier, label="wait_all")
 
 
 def maybe_sync(arrays) -> None:

@@ -25,7 +25,7 @@ Spec grammar (semicolon-separated entries)::
 
     <point>:<mode>[@<trigger>][:<arg>]
 
-    mode     raise | delay | corrupt | nan | kill
+    mode     raise | delay | corrupt | nan | kill | hang
     trigger  N        fire on the N-th invocation only (1-based)
              N+       fire on every invocation from the N-th onward
              N,M,...  fire on the listed invocations
@@ -33,6 +33,7 @@ Spec grammar (semicolon-separated entries)::
              pP       fire with probability P per invocation (seeded)
              (default: 1 — fire on the first invocation)
     arg      delay: sleep seconds (default 0.05)
+             hang: wedge seconds (default 3600 — "forever" at test scale)
              raise/corrupt/nan/kill: unused
 
 Examples::
@@ -54,6 +55,10 @@ Modes at a point ``faults.point(name, payload=None)``:
     kill     SIGKILL the process — the "preempted mid-step" scenario for
              kill-and-resume tests (no atexit, no cleanup, exactly like a
              TPU preemption)
+    hang     block the calling thread for `arg` seconds (default 3600) —
+             the "stuck collective / wedged fetch" scenario the watchdog
+             (mxnet_tpu.watchdog) exists to detect; every watchdog path
+             is deterministically testable with it
 
 :func:`retry` is the reusable exponential-backoff wrapper used by the io
 decode path and the model-zoo fetch path; injected faults are retryable
@@ -126,7 +131,7 @@ def _parse(spec, seed):
             mode, trig_tok = mode_tok.split("@", 1)
         else:
             mode, trig_tok = mode_tok, "1"
-        if mode not in ("raise", "delay", "corrupt", "nan", "kill"):
+        if mode not in ("raise", "delay", "corrupt", "nan", "kill", "hang"):
             raise ValueError(f"unknown fault mode {mode!r} in {entry!r}")
         # per-point sub-seed keeps streams independent yet reproducible
         out[name] = _PointSpec(mode, _parse_trigger(trig_tok),
@@ -230,6 +235,14 @@ def point(name, payload=None):
     if spec.mode == "delay":
         time.sleep(float(spec.arg) if spec.arg else 0.05)
         return payload
+    if spec.mode == "hang":
+        # chunked so signals (per-test SIGALRM) still interrupt promptly
+        end = time.monotonic() + (float(spec.arg) if spec.arg else 3600.0)
+        while True:
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return payload
+            time.sleep(min(0.25, remaining))
     if spec.mode == "kill":
         import signal
 
@@ -244,7 +257,7 @@ def point(name, payload=None):
 
 # ----------------------------------------------------------------- retry ---
 
-def retry(fn=None, *, retries=3, backoff=0.05, jitter=0.0,
+def retry(fn=None, *, retries=3, backoff=0.05, jitter=0.0, deadline=None,
           retry_on=(Exception,), on_retry=None):
     """Exponential-backoff retry decorator/wrapper.
 
@@ -260,6 +273,11 @@ def retry(fn=None, *, retries=3, backoff=0.05, jitter=0.0,
     jitter  : fraction of the sleep drawn uniformly at random and added
         (0.0 = fully deterministic — the default, so tests and seeded
         chaos runs replay exactly).
+    deadline : total-elapsed-time cap in seconds across ALL attempts and
+        backoff sleeps; once starting the next backoff would cross it the
+        last exception propagates instead. Bounds retry storms so a
+        persistently failing call cannot itself become a hang (the
+        attempt-count cap alone grows exponentially in wall-clock).
     retry_on : exception classes that trigger a retry; anything else
         propagates immediately.
     on_retry : optional callback ``(attempt, exc)`` per failed attempt
@@ -273,17 +291,21 @@ def retry(fn=None, *, retries=3, backoff=0.05, jitter=0.0,
         @functools.wraps(func)
         def wrapper(*args, **kwargs):
             delay = backoff
+            start = time.monotonic()
             for attempt in range(retries + 1):
                 try:
                     return func(*args, **kwargs)
                 except retry_on as exc:
                     if attempt == retries:
                         raise
-                    if on_retry is not None:
-                        on_retry(attempt + 1, exc)
                     sleep = delay
                     if jitter:
                         sleep += delay * jitter * _pyrandom.random()
+                    if deadline is not None and \
+                            time.monotonic() - start + sleep >= deadline:
+                        raise  # the next attempt would bust the time cap
+                    if on_retry is not None:
+                        on_retry(attempt + 1, exc)
                     if sleep > 0:
                         time.sleep(sleep)
                     delay *= 2

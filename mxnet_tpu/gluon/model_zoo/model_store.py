@@ -75,8 +75,10 @@ def get_model_file(name, root=None, repo=None, sha1_hash=None):
         if os.path.exists(src):
             os.makedirs(root, exist_ok=True)
             # parity: download(..., retries=5) — transient IO errors are
-            # retried with exponential backoff, then surface
-            _faults.retry(_fetch, retries=4, backoff=0.1,
+            # retried with exponential backoff, then surface; the deadline
+            # caps the total retry storm so a dead source fails in bounded
+            # time instead of hanging the model build
+            _faults.retry(_fetch, retries=4, backoff=0.1, deadline=60.0,
                           retry_on=(OSError,))(src, path, sha1_hash)
             return path
     raise FileNotFoundError(

@@ -272,7 +272,22 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetcher (parity: io.py:PrefetchingIter /
-    `src/io/iter_prefetcher.h` double buffering)."""
+    `src/io/iter_prefetcher.h` double buffering).
+
+    Robustness contract:
+
+    * fetch workers are **daemon** threads — a hung fetch can never block
+      interpreter exit;
+    * a deferred worker error (or a watchdog StallError from a wedged
+      fetch) is **sticky**: every subsequent ``next()``/``iter_next()``
+      re-raises it until :meth:`reset`, which abandons any wedged
+      workers, resets the underlying iterators and cleanly restages the
+      prefetch;
+    * with an ``io.fetch`` watchdog deadline armed
+      (:mod:`mxnet_tpu.watchdog`) the join on the fetch threads is
+      deadline-bounded, so a wedged data source surfaces as a catchable
+      StallError + crash bundle instead of a silent stall.
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -287,6 +302,7 @@ class PrefetchingIter(DataIter):
         self._lock = threading.Lock()
         self._next_batches = [None] * self.n_iter
         self._started = False
+        self._error = None  # sticky deferred error, cleared by reset()
 
     @property
     def provide_data(self):
@@ -307,48 +323,103 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def _fetch(self):
-        def worker(i):
-            try:
-                self._next_batches[i] = self.iters[i].next()
-            except StopIteration:
-                self._next_batches[i] = None
-            except BaseException as e:  # surface at next sync, don't hang
-                self._next_batches[i] = e
+        from .. import faults as _faults
+        from .. import watchdog as _watchdog
 
-        threads = [threading.Thread(target=worker, args=(i,))
+        # a fresh slot list per staging round: a worker abandoned after a
+        # stall (daemon, still wedged in next()) can only ever write into
+        # ITS round's list, never clobber a restaged batch
+        slots = self._next_batches = [None] * self.n_iter
+
+        def worker(i, out):
+            try:
+                # 'io.fetch' injection point: raise = flaky source, hang =
+                # wedged source (the watchdog-detection scenario)
+                _faults.point("io.fetch")
+                out[i] = self.iters[i].next()
+                _watchdog.beat("io.fetch", f"worker {i} staged")
+            except StopIteration:
+                out[i] = None
+            except BaseException as e:  # surface at next sync, don't hang
+                out[i] = e
+
+        # daemon: a hung fetch must never block interpreter exit
+        threads = [threading.Thread(target=worker, args=(i, slots),
+                                    daemon=True,
+                                    name=f"mxtpu-prefetch-{i}")
                    for i in range(self.n_iter)]
         for t in threads:
             t.start()
         self._threads = threads
 
     def _join(self):
-        for t in getattr(self, "_threads", []):
-            t.join()
+        from .. import watchdog as _watchdog
+
+        threads = getattr(self, "_threads", [])
+        if not threads:
+            return
+
+        def join_all():
+            for t in threads:
+                t.join()  # noqa: unbounded-sync — bounded by the enclosing watchdog.sync
+
+        try:
+            # deadline-bounded when an 'io.fetch' watchdog deadline is
+            # armed; a stall abandons the (daemon) workers
+            _watchdog.sync("io.fetch", join_all, label="prefetch join")
+        except _watchdog.StallError:
+            self._threads = []
+            raise
+        self._threads = []
 
     def reset(self):
-        self._join()
+        """Recover cleanly: clear any sticky error, abandon wedged
+        workers, reset the sources and restage the prefetch."""
+        from .. import watchdog as _watchdog
+
+        stalled = isinstance(self._error, _watchdog.StallError)
+        self._error = None
+        if stalled:
+            self._threads = []  # daemons still wedged in next(); abandon
+        else:
+            try:
+                self._join()
+            except BaseException:
+                self._threads = []
+                raise
         for it in self.iters:
             it.reset()
         self._fetch()
         self._started = True
 
     def _advance(self):
-        """Collect the staged batch and stage the next one, or None at end."""
-        if not self._started:
-            self._fetch()
-            self._started = True
-        self._join()
-        batches = list(self._next_batches)
-        for b in batches:
-            if isinstance(b, BaseException):
-                # deferred worker error (parity: engine exceptions surface
-                # at the next sync point)
-                raise b
-        if any(b is None for b in batches):
-            assert all(b is None for b in batches), \
-                "Number of batches mismatch between iterators"
-            return None
-        self._fetch()  # stage the next batch while caller computes
+        """Collect the staged batch and stage the next one, or None at end.
+        Any error raised here is sticky until reset() — the staged state
+        is torn, so continuing without a reset would hand out stale or
+        duplicate batches."""
+        if self._error is not None:
+            raise self._error
+        try:
+            if not self._started:
+                self._fetch()
+                self._started = True
+            self._join()
+            batches = list(self._next_batches)
+            for b in batches:
+                if isinstance(b, BaseException):
+                    # deferred worker error (parity: engine exceptions
+                    # surface at the next sync point)
+                    raise b
+            if any(b is None for b in batches):
+                assert all(b is None for b in batches), \
+                    "Number of batches mismatch between iterators"
+                return None
+            self._fetch()  # stage the next batch while caller computes
+        except StopIteration:
+            raise
+        except BaseException as e:
+            self._error = e
+            raise
         if self.n_iter == 1:
             return batches[0]
         return DataBatch(
@@ -649,9 +720,13 @@ class ImageRecordIter(DataIter):
                 # zero-filling the slot; only records that exhaust the
                 # retries (genuinely corrupt) keep the graceful zero-fill
                 # + warning (reference logs and continues too).
+                # deadline caps the whole retry storm per record — a
+                # persistently failing decode zero-fills instead of
+                # stalling the fetch (watchdog-friendly: the io.fetch
+                # deadline never races an unbounded retry loop)
                 decode_one = _faults.retry(
                     lambda buf: self._decode_batch_py([buf], dh, dw)[0],
-                    retries=2, backoff=0.01)
+                    retries=2, backoff=0.01, deadline=5.0)
                 still_bad = []
                 for i in bad:
                     try:

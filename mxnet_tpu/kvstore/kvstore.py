@@ -81,7 +81,9 @@ class KVStore(KVStoreBase):
         """Aggregate value(s) into the per-key merge buffer (parity:
         KVStoreLocal::PushImpl + CommDevice::Reduce)."""
         from .. import faults as _faults
+        from .. import watchdog as _watchdog
 
+        _watchdog.beat("kvstore.push")  # liveness for hang diagnostics
         _faults.point("kvstore.push")  # flaky-gradient-sync injection
         keys, values = self._canonical_push(key, value)
         for k, vals in zip(keys, values):
@@ -98,6 +100,9 @@ class KVStore(KVStoreBase):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """parity: KVStoreLocal::PullImpl — copy current value into out."""
+        from .. import watchdog as _watchdog
+
+        _watchdog.beat("kvstore.pull")  # liveness for hang diagnostics
         keys, outs = self._canonical(key, out)
         for k, o in zip(keys, outs):
             src = self._value_for_pull(k)
@@ -275,7 +280,9 @@ class _DistKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         from .. import faults as _faults
+        from .. import watchdog as _watchdog
 
+        _watchdog.beat("kvstore.push")  # liveness across the collective
         _faults.point("kvstore.push")  # flaky-gradient-sync injection
         keys, values = self._canonical_push(key, value)
         for k, vals in zip(keys, values):

@@ -26,7 +26,9 @@ import numpy as _np
 
 from .. import _amp_core, autograd, engine
 from .. import bulk as _bulk
+from .. import faults as _faults
 from .. import profiler as _profiler
+from .. import watchdog as _watchdog
 from ..analysis import sanitize as _sanitize
 from ..base import MXNetError, canonical_dtype
 from ..context import Context, current_context
@@ -202,12 +204,26 @@ class NDArray:
     def wait_to_read(self):
         if _sanitize.ACTIVE:
             with _sanitize.synced("wait_to_read"):
-                self._data.block_until_ready()
+                self._bounded_block("wait_to_read")
                 return
-        self._data.block_until_ready()
+        self._bounded_block("wait_to_read")
 
     def wait_to_write(self):
-        self._data.block_until_ready()
+        self._bounded_block("wait_to_write")
+
+    def _bounded_block(self, label):
+        """Block until this buffer is ready — under a watchdog deadline
+        when a 'host.sync' one is armed, so no library host sync can
+        block unboundedly (a wedge raises a catchable StallError)."""
+        buf = self._data  # forces a lazy segment first (engine.flush span)
+
+        def _block():
+            # 'host.sync' injection point: a hang here is the "device
+            # round-trip that never returns" scenario under watchdog test
+            _faults.point("host.sync")
+            buf.block_until_ready()  # noqa: unbounded-sync — this IS the watchdog wrapper for host syncs
+
+        _watchdog.sync("host.sync", _block, label=label)
 
     # ------------------------------------------------------ autograd -------
     def attach_grad(self, grad_req="write", stype=None):

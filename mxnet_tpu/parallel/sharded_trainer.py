@@ -469,7 +469,21 @@ class ShardedTrainer:
         are non-finite leaves params/optimizer/aux untouched; after
         ``max_consecutive_skips`` such steps in a row a RuntimeError is
         raised (the step counter still advances on skipped steps — the
-        step was attempted)."""
+        step was attempted).
+
+        With a ``trainer.step`` watchdog deadline armed
+        (:mod:`mxnet_tpu.watchdog`) the whole step — dispatch, compile,
+        and the nan_guard host read — is deadline-bounded: a wedged step
+        writes a crash bundle and raises a catchable StallError (or
+        checkpoints and aborts under ``action:abort``). NOTE the first
+        step includes XLA compilation; size the deadline for it."""
+        from .. import watchdog as _watchdog
+
+        return _watchdog.sync("trainer.step",
+                              lambda: self._step_impl(x, y),
+                              label=f"step {self._t + 1}")
+
+    def _step_impl(self, x, y):
         import jax
 
         from .. import faults as _faults

@@ -23,7 +23,7 @@ import time
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
            "dumps", "reset", "Domain", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope", "record_skip_step"]
+           "Marker", "scope", "record_skip_step", "record_stall"]
 
 _lock = threading.Lock()
 _RECORDING = False       # master flag: a session is active and not paused
@@ -179,6 +179,24 @@ def record_skip_step(total, consecutive):
     record_instant("trainer.skip_step", cat="trainer",
                    args={"total": total, "consecutive": consecutive})
     record_counter("trainer.skipped_steps", total)
+
+
+_stall_count = 0
+
+
+def record_stall(point, elapsed_s, bundle):
+    """Watchdog stall: an instrumented point blew its deadline and a crash
+    bundle was written (mxnet_tpu.watchdog). Recorded as an instant marker
+    plus a running counter track so hangs line up with the op timeline in
+    the trace. No-op unless a profiling session is recording."""
+    global _stall_count
+    _stall_count += 1
+    if not _RECORDING:
+        return
+    record_instant("watchdog.stall", cat="watchdog",
+                   args={"point": point, "elapsed_s": round(elapsed_s, 3),
+                         "bundle": bundle})
+    record_counter("watchdog.stalls", _stall_count)
 
 
 def record_instant(name, cat="instant", args=None):

@@ -29,6 +29,17 @@ if os.environ.get("MXNET_TEST_DEVICE", "cpu").startswith("cpu"):
                                    + " --xla_force_host_platform_device_count=8")
 
 
+# ------------------------------------------------- watchdog (observe mode) --
+# CI hang diagnostics: a generous observe-mode deadline BELOW pytest's
+# faulthandler_timeout (570s, pytest.ini) so a wedged test writes a crash
+# bundle (all-thread tracebacks + last-N heartbeats) before faulthandler's
+# stack dump fires — observe mode never interrupts anything and spawns no
+# waiter threads. setdefault: an explicit MXNET_TPU_WATCHDOG wins. Tests
+# that exercise the watchdog configure their own deadlines and restore the
+# ambient config via watchdog.configure_from_env().
+os.environ.setdefault("MXNET_TPU_WATCHDOG",
+                      "*:540,action:observe,interval:60")
+
 import numpy as _onp
 import pytest as _pytest
 

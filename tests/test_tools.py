@@ -63,6 +63,8 @@ def test_diagnose_runs(capsys):
     out = capsys.readouterr().out
     assert "Framework Info" in out and "Version" in out
     assert "jax" in out
+    # watchdog knobs + most-recent-crash-bundle report (docs/ROBUSTNESS.md)
+    assert "Watchdog Knobs" in out and "MXNET_TPU_WATCHDOG" in out
 
 
 def test_rec2idx_matches_writer(tmp_path):

@@ -1,0 +1,390 @@
+"""Watchdog subsystem: hang detection, deadline-bounded syncs, crash
+bundles (mxnet_tpu/watchdog.py + the `hang` fault mode).
+
+Acceptance (ISSUE 4): a deterministically injected hang at each of the
+four instrumented point classes — data fetch (io.fetch), engine flush
+(engine.flush), trainer step (trainer.step), host sync (host.sync) — is
+detected within the configured deadline, writes a crash bundle containing
+all-thread tracebacks plus the last-N heartbeats, and surfaces as a
+catchable StallError (or checkpoint-then-abort when configured).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, watchdog
+
+# hang long enough that only the watchdog can end the wait inside the
+# deadline, short enough that abandoned daemon waiters drain quickly
+HANG = 3.0
+DEADLINE = 0.5
+
+
+@pytest.fixture(autouse=True)
+def _restore():
+    """Every test leaves the ambient (conftest observe-mode) config and a
+    clean fault schedule behind."""
+    yield
+    faults.reset()
+    watchdog.configure_from_env()
+
+
+def _configure(tmp_path, point, deadline=DEADLINE, **opts):
+    watchdog.configure({point: deadline}, crash_dir=str(tmp_path),
+                       interval=0.05, **opts)
+
+
+def _check_bundle(path):
+    """Bundle completeness: all-thread tracebacks + heartbeats + report."""
+    assert path and os.path.isdir(path)
+    names = set(os.listdir(path))
+    assert {"threads.txt", "heartbeats.json", "report.json",
+            "sanitize.json"} <= names
+    tb = open(os.path.join(path, "threads.txt")).read()
+    assert "Thread" in tb and "File" in tb  # faulthandler all-thread dump
+    beats = json.load(open(os.path.join(path, "heartbeats.json")))
+    assert beats, "bundle must carry the last-N heartbeats"
+    assert all({"t_mono", "point", "thread"} <= set(b) for b in beats)
+    rep = json.load(open(os.path.join(path, "report.json")))
+    assert rep["deadline_s"] == pytest.approx(DEADLINE)
+    assert rep["elapsed_s"] >= DEADLINE
+    assert "faults" in rep and "live_bulk_segments" in rep
+    return rep
+
+
+# ------------------------------------------------------------- grammar ----
+
+def test_grammar_parsing():
+    cfg = watchdog._parse("trainer.step:120,io.fetch:30;*:600,"
+                          "action:abort,warn:0.25,interval:2,"
+                          "dir:/tmp/x,beats:64")
+    assert cfg.deadlines == {"trainer.step": 120.0, "io.fetch": 30.0}
+    assert cfg.default == 600.0
+    assert cfg.action == "abort"
+    assert cfg.warn_fraction == 0.25
+    assert cfg.interval == 2.0
+    assert cfg.crash_dir == "/tmp/x"
+    assert cfg.beats == 64
+    assert cfg.deadline_for("trainer.step") == 120.0
+    assert cfg.deadline_for("anything.else") == 600.0
+
+
+@pytest.mark.parametrize("bad", ["trainer.step", "action:bogus", "x:,",
+                                 "action:raise"])
+def test_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        watchdog._parse(bad)
+
+
+def test_configure_dict_and_options(tmp_path):
+    watchdog.configure({"host.sync": 9}, action="observe",
+                       crash_dir=str(tmp_path))
+    d = watchdog.describe()
+    assert d["enabled"] and d["deadlines"] == {"host.sync": 9.0}
+    assert d["action"] == "observe" and d["crash_dir"] == str(tmp_path)
+    watchdog.configure(None)
+    assert watchdog.describe() == {"enabled": False}
+
+
+def test_disabled_sync_is_transparent():
+    watchdog.configure(None)
+    assert watchdog.sync("host.sync", lambda: 41) == 41
+    with pytest.raises(KeyError):
+        watchdog.sync("host.sync", lambda: {}["missing"])
+    assert not watchdog.enabled()
+
+
+# ------------------------------------------- the four hang point classes ---
+
+def test_hang_host_sync_detected(tmp_path):
+    _configure(tmp_path, "host.sync")
+    faults.configure(f"host.sync:hang@1:{HANG}")
+    a = mx.nd.ones((2, 2))
+    t0 = time.monotonic()
+    with pytest.raises(watchdog.StallError) as ei:
+        a.wait_to_read()
+    elapsed = time.monotonic() - t0
+    assert elapsed < HANG, "the watchdog, not the hang, ended the wait"
+    assert elapsed < DEADLINE * 3
+    err = ei.value
+    assert err.point == "host.sync" and err.deadline == DEADLINE
+    rep = _check_bundle(err.bundle)
+    assert rep["point"] == "host.sync"
+    # the stalled span shows up in the bundle's active-span snapshot
+    assert any(s["point"] == "host.sync" for s in rep["active_spans"])
+
+
+def test_hang_engine_flush_detected(tmp_path):
+    _configure(tmp_path, "engine.flush")
+    faults.configure(f"engine.flush:hang@1:{HANG}")
+    t0 = time.monotonic()
+    with pytest.raises(watchdog.StallError) as ei:
+        mx.nd.waitall()
+    assert time.monotonic() - t0 < HANG
+    _check_bundle(ei.value.bundle)
+
+
+def test_hang_bulk_segment_flush_detected(tmp_path):
+    """A hang inside a fused bulk-segment flush stalls at the sync point
+    and stays sticky on the segment (deferred-exception contract)."""
+    _configure(tmp_path, "engine.flush")
+    faults.configure(f"engine.flush:hang@1:{HANG}")
+    with mx.engine.bulk(8):
+        a = mx.nd.ones((4,))
+        b = a + 1
+        c = b * 2
+        with pytest.raises(watchdog.StallError) as ei:
+            c.asnumpy()  # forces the segment
+        _check_bundle(ei.value.bundle)
+        # sticky: a second force re-raises without re-executing
+        with pytest.raises(watchdog.StallError):
+            c.asnumpy()
+
+
+def test_hang_io_fetch_detected(tmp_path):
+    _configure(tmp_path, "io.fetch")
+    faults.configure(f"io.fetch:hang@1:{HANG}")
+    base = mx.io.NDArrayIter(np.arange(48, dtype=np.float32).reshape(12, 4),
+                             np.arange(12, dtype=np.float32), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    t0 = time.monotonic()
+    with pytest.raises(watchdog.StallError) as ei:
+        it.next()
+    assert time.monotonic() - t0 < HANG
+    rep = _check_bundle(ei.value.bundle)
+    assert rep["point"] == "io.fetch"
+    # sticky until reset(): the staged state is torn
+    with pytest.raises(watchdog.StallError):
+        it.next()
+    # reset() abandons the wedged daemon worker and recovers cleanly
+    it.reset()
+    batch = it.next()
+    assert batch.data[0].shape == (4, 4)
+
+
+def test_hang_trainer_step_detected(tmp_path):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import ShardedTrainer
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(1).randn(8, 2).astype(np.float32))
+    net(x)
+    trainer = ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             {"learning_rate": 0.1})
+    trainer.step(x, y)  # compile OUTSIDE the deadline window
+    _configure(tmp_path, "trainer.step")
+    faults.configure(f"trainer.step:hang@1:{HANG}")
+    t0 = time.monotonic()
+    with pytest.raises(watchdog.StallError) as ei:
+        trainer.step(x, y)
+    assert time.monotonic() - t0 < HANG
+    rep = _check_bundle(ei.value.bundle)
+    assert rep["point"] == "trainer.step"
+    # the abandoned waiter finishes in the background; drain it before
+    # touching the trainer again, then training continues
+    faults.reset()
+    watchdog.configure(None)
+    time.sleep(HANG + 0.5)
+    loss = trainer.step(x, y)
+    assert np.isfinite(loss.asnumpy()).all()
+
+
+# ----------------------------------------------------- escalation ladder ---
+
+def test_injected_fault_propagates_through_bounded_sync(tmp_path):
+    """A raise-mode fault inside a bounded sync surfaces as InjectedFault,
+    not StallError — the waiter relays the real error."""
+    _configure(tmp_path, "engine.flush")
+    faults.configure("engine.flush:raise@1")
+    with pytest.raises(faults.InjectedFault):
+        mx.nd.waitall()
+    mx.nd.waitall()  # schedule consumed; clean barrier works
+
+
+def test_observe_mode_bundles_without_raising(tmp_path):
+    """action:observe — the monitor writes the bundle; nothing raises and
+    the caller's result survives (the CI conftest configuration)."""
+    _configure(tmp_path, "engine.flush", action="observe")
+    faults.configure(f"engine.flush:delay@1:{DEADLINE * 2.5}")
+    mx.nd.waitall()  # blocks past the deadline but completes normally
+    bundle = watchdog.latest_bundle(str(tmp_path))
+    assert bundle is not None
+    rep = json.load(open(os.path.join(bundle, "report.json")))
+    assert rep["point"] == "engine.flush"
+
+
+def test_warning_fires_before_stall(tmp_path, caplog):
+    import logging
+
+    _configure(tmp_path, "host.sync")
+    faults.configure(f"host.sync:hang@1:{HANG}")
+    a = mx.nd.ones((2,))
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.watchdog"):
+        with pytest.raises(watchdog.StallError):
+            a.wait_to_read()
+    msgs = [r.message for r in caplog.records]
+    assert any("has been blocking" in m for m in msgs), msgs
+    assert any("crash bundle written" in m for m in msgs), msgs
+
+
+def test_abort_action_runs_last_resort_checkpoint(tmp_path, monkeypatch):
+    """action:abort — last-resort checkpoint hook runs, then the process
+    exit hook fires with the watchdog's dedicated code."""
+    exits = []
+    monkeypatch.setattr(watchdog, "_exit_fn",
+                        lambda code: exits.append(code))
+    saved = []
+    watchdog.set_last_resort(lambda: saved.append(True))
+    try:
+        _configure(tmp_path, "host.sync", action="abort")
+        faults.configure(f"host.sync:hang@1:{HANG}")
+        a = mx.nd.ones((2,))
+        # the stubbed exit returns, so sync falls through to StallError —
+        # in production os._exit(86) never returns
+        with pytest.raises(watchdog.StallError):
+            a.wait_to_read()
+    finally:
+        watchdog.set_last_resort(None)
+    assert saved == [True], "final checkpoint hook must run before abort"
+    assert exits == [watchdog.ABORT_EXIT_CODE]
+    assert watchdog.latest_bundle(str(tmp_path)) is not None
+
+
+# ------------------------------------------------------------ heartbeats ---
+
+def test_heartbeats_recorded_with_labels(tmp_path):
+    _configure(tmp_path, "engine.flush", deadline=30)
+    mx.nd.waitall()
+    beats = watchdog.heartbeats()
+    points = {b["point"] for b in beats}
+    assert "engine.flush" in points
+    labels = {b["label"] for b in beats if b["point"] == "engine.flush"}
+    assert any(lb and "wait_all" in lb for lb in labels)
+    assert all(b["t_mono"] <= time.monotonic() for b in beats)
+
+
+def test_kvstore_points_report_liveness(tmp_path):
+    _configure(tmp_path, "engine.flush", deadline=30)  # enables beats
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((3,)))
+    kv.push("w", mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    points = {b["point"] for b in watchdog.heartbeats()}
+    assert {"kvstore.push", "kvstore.pull"} <= points
+
+
+# --------------------------------------------- PrefetchingIter recovery ----
+
+def test_prefetch_error_sticky_until_reset():
+    """Satellite: a deferred worker error is sticky until reset(), and
+    reset() restages the fetch cleanly."""
+    faults.configure("io.fetch:raise@2")
+    base = mx.io.NDArrayIter(np.arange(32, dtype=np.float32).reshape(8, 4),
+                             np.arange(8, dtype=np.float32), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    it.next()  # batch 1 ok (fetch 2 staged in background -> fires)
+    with pytest.raises(faults.InjectedFault):
+        it.next()
+    # sticky: no restaged fetch, same error again — not a stale batch
+    with pytest.raises(faults.InjectedFault):
+        it.next()
+    faults.reset()
+    it.reset()
+    batch = it.next()
+    assert batch.data[0].shape == (4, 4)
+
+
+def test_prefetch_workers_are_daemons():
+    base = mx.io.NDArrayIter(np.arange(32, dtype=np.float32).reshape(8, 4),
+                             np.arange(8, dtype=np.float32), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    it.iter_next()
+    assert it._threads, "a fetch must be staged"
+    assert all(t.daemon for t in it._threads), \
+        "hung fetch threads must never block interpreter exit"
+
+
+# ------------------------------------------------------- retry deadline ----
+
+def test_retry_deadline_caps_total_elapsed():
+    """Satellite: retry() stops on the elapsed-time cap, not only on the
+    attempt cap — a retry storm cannot itself become a hang."""
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise OSError("flaky")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        faults.retry(always_fails, retries=1000, backoff=0.02,
+                     deadline=0.25)()
+    assert time.monotonic() - t0 < 1.0
+    assert 1 < len(calls) < 20, "deadline, not attempt count, must stop it"
+
+
+def test_retry_deadline_none_keeps_attempt_semantics():
+    calls = []
+
+    def fails_twice():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("flaky")
+        return "ok"
+
+    assert faults.retry(fails_twice, retries=3, backoff=0.001)() == "ok"
+    assert len(calls) == 3
+
+
+# -------------------------------------------------------------- tooling ----
+
+def test_latest_bundle_and_crash_dir(tmp_path):
+    assert watchdog.latest_bundle(str(tmp_path / "nope")) is None
+    _configure(tmp_path, "host.sync")
+    faults.configure(f"host.sync:hang@1:{HANG}")
+    a = mx.nd.ones((2,))
+    with pytest.raises(watchdog.StallError) as ei:
+        a.wait_to_read()
+    assert watchdog.latest_bundle(str(tmp_path)) == ei.value.bundle
+    assert watchdog.crash_dir() == str(tmp_path)
+
+
+def test_hang_fault_mode_without_watchdog_just_delays():
+    """`hang` with a short arg and no watchdog behaves like a long delay —
+    the library is wedged exactly as a real stall would be."""
+    watchdog.configure(None)
+    faults.configure("host.sync:hang@1:0.3")
+    a = mx.nd.ones((2,))
+    t0 = time.monotonic()
+    a.wait_to_read()
+    assert time.monotonic() - t0 >= 0.25
+
+
+def test_profiler_counts_stalls(tmp_path):
+    from mxnet_tpu import profiler
+
+    profiler.set_config(filename=str(tmp_path / "prof.json"))
+    profiler.set_state("run")
+    try:
+        _configure(tmp_path, "host.sync")
+        faults.configure(f"host.sync:hang@1:{HANG}")
+        a = mx.nd.ones((2,))
+        with pytest.raises(watchdog.StallError):
+            a.wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    trace = json.load(open(str(tmp_path / "prof.json")))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "watchdog.stall" in names and "watchdog.stalls" in names
+    profiler.reset()

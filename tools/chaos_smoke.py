@@ -8,6 +8,9 @@ Proves the fault-tolerance stack end to end on one machine, fast:
   * checkpoint-every-epoch through CheckpointManager (atomic writes,
     CRC manifest) with an injected write failure retried,
   * an injected mid-epoch crash, then resume from the manifest,
+  * an injected HANG in the train step, detected by the watchdog within
+    its deadline, surfaced as a catchable StallError with a crash bundle
+    written — then training continues unimpeded,
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -24,6 +27,7 @@ import argparse
 import os
 import sys
 import tempfile
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -126,6 +130,32 @@ def main(argv=None):
             x, y = batch_for(epoch, s, args.seed)
             trainer2.step(x, y)
         trainer2.save_checkpoint(manager, epoch)
+
+    # phase 3: wedge a step; the watchdog must convert the hang into a
+    # StallError + crash bundle within the deadline, then training
+    # continues cleanly once the fault schedule is cleared
+    from mxnet_tpu import watchdog
+
+    hang_secs = 2.0
+    watchdog.configure({"trainer.step": 0.8},
+                       crash_dir=os.path.join(ckpt_dir, "crash"),
+                       interval=0.1)
+    faults.configure(f"trainer.step:hang@1:{hang_secs}", seed=args.seed)
+    x, y = batch_for(1, 0, args.seed)
+    try:
+        trainer2.step(x, y)
+        print("FAIL: the injected hang was not detected")
+        return 1
+    except watchdog.StallError as e:
+        print(f"  watchdog caught the hang: {e}")
+        if not (e.bundle and os.path.isdir(e.bundle)):
+            print("FAIL: no crash bundle written for the stall")
+            return 1
+    faults.reset()
+    watchdog.configure(None)
+    # drain the abandoned waiter (daemon) before mutating the trainer again
+    time.sleep(hang_secs + 0.5)
+    trainer2.step(x, y)
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

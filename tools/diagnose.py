@@ -11,6 +11,10 @@ import platform
 import sys
 import time
 
+# `python tools/diagnose.py` puts tools/ (not the repo root) on sys.path;
+# the framework checks need the package importable either way
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def check_python():
     print("----------Python Info----------")
@@ -98,6 +102,40 @@ def check_analysis():
         print("analysis import failed:", e)
 
 
+def check_watchdog():
+    """Watchdog knobs + the most recent crash bundle, if one exists
+    (docs/ROBUSTNESS.md) — the first thing to read after a wedged run."""
+    print("---------Watchdog Knobs--------")
+    print(f"MXNET_TPU_WATCHDOG={os.environ.get('MXNET_TPU_WATCHDOG', '<unset>')}  "
+          "(hang deadlines; off unless set)")
+    print(f"MXNET_TPU_CRASH_DIR={os.environ.get('MXNET_TPU_CRASH_DIR', '<unset>')}  "
+          "(crash-bundle dir; default <tmpdir>/mxtpu_crash)")
+    try:
+        from mxnet_tpu import watchdog
+
+        cfg = watchdog.describe()
+        print("effective     :", cfg)
+        bundle = watchdog.latest_bundle()
+        if bundle is None:
+            print("crash bundles : none found in", watchdog.crash_dir())
+            return
+        print("latest bundle :", bundle)
+        import json
+
+        try:
+            with open(os.path.join(bundle, "report.json")) as f:
+                rep = json.load(f)
+            print("  stalled at  : %s (%s) after %.1fs (deadline %gs)"
+                  % (rep.get("point"), rep.get("label") or "-",
+                     rep.get("elapsed_s", 0.0), rep.get("deadline_s", 0.0)))
+            print("  written     :", rep.get("time"))
+            print("  files       :", ", ".join(sorted(os.listdir(bundle))))
+        except (OSError, ValueError) as e:
+            print("  (report.json unreadable:", e, ")")
+    except ImportError as e:
+        print("watchdog import failed:", e)
+
+
 def main():
     check_python()
     check_pip()
@@ -106,6 +144,7 @@ def main():
     check_hardware()
     check_environment()
     check_analysis()
+    check_watchdog()
 
 
 if __name__ == "__main__":

@@ -23,6 +23,12 @@ no-schema-doc     an op registered via ``@register(...)`` without a
                   opperf arg synthesis, doc generation) has nothing to show.
 unused-import     module-level import never referenced in the file.
 mutable-default   ``def f(x=[] / {} / set())`` — shared-state bug class.
+unbounded-sync    a bare ``.join()`` / ``.block_until_ready()`` in library
+                  code — an unbounded blocking wait that bypasses the
+                  watchdog wrappers (``mxnet_tpu.watchdog.sync``); a wedge
+                  behind it stalls the process forever with no crash
+                  bundle. ``watchdog.py`` itself is exempt (it IS the
+                  wrapper home).
 
 Baseline workflow
 -----------------
@@ -54,7 +60,8 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "mxlint_baseline.txt")
 
 RULES = ("bare-except", "host-sync", "raw-jax-compat", "unseeded-random",
-         "no-schema-doc", "unused-import", "mutable-default")
+         "no-schema-doc", "unused-import", "mutable-default",
+         "unbounded-sync")
 
 _SYNC_METHODS = {"asnumpy", "asscalar"}
 _COMPAT_NAMES = {"shard_map", "enable_x64", "pcast"}
@@ -103,6 +110,7 @@ class _Linter(ast.NodeVisitor):
         self.lines = source.splitlines()
         self.is_init = os.path.basename(path) == "__init__.py"
         self.is_compat = os.path.basename(path) == "_jax_compat.py"
+        self.is_watchdog = os.path.basename(path) == "watchdog.py"
         # module-level import bookkeeping for unused-import
         self.imports = {}   # local name -> (lineno, col, "import x" repr)
         self.used = set()
@@ -137,6 +145,17 @@ class _Linter(ast.NodeVisitor):
                          f".{func.attr}() is a blocking device->host "
                          "round-trip (and splits any live bulk segment); "
                          "library hot paths must stay async")
+            if not self.is_watchdog:
+                # thread.join() takes no args; str.join always takes one —
+                # the zero-arg form is the unbounded-wait shape
+                if (func.attr == "block_until_ready"
+                        or (func.attr == "join" and not node.args
+                            and not node.keywords)):
+                    self.add(node, "unbounded-sync",
+                             f".{func.attr}() blocks unboundedly and "
+                             "bypasses the watchdog — route through "
+                             "mxnet_tpu.watchdog.sync so a wedge raises "
+                             "StallError with a crash bundle")
             chain = _dotted(func)
             if chain is not None:
                 self._check_np_random(node, chain)
