@@ -39,9 +39,31 @@ import zlib
 from . import faults as _faults
 
 __all__ = ["CheckpointManager", "atomic_write", "crc32_file",
-           "MANIFEST_NAME"]
+           "MANIFEST_NAME", "host_metadata"]
 
 MANIFEST_NAME = "MANIFEST.json"
+
+
+def host_metadata():
+    """jax/device metadata recorded in MANIFEST ``topology`` entries so a
+    resume on different software/hardware can be diagnosed (and resharded)
+    instead of failing obscurely. JSON-able; best-effort — a host without
+    an initialisable backend still checkpoints."""
+    meta = {}
+    try:
+        import jax
+
+        meta["jax"] = jax.__version__
+        devs = jax.devices()
+        meta["device_count"] = len(devs)
+        meta["process_count"] = jax.process_count()
+        if devs:
+            meta["backend"] = devs[0].platform
+            meta["device_kind"] = getattr(devs[0], "device_kind",
+                                          devs[0].platform)
+    except Exception as e:  # backend probe failure must not block a save
+        meta["error"] = f"{type(e).__name__}: {e}"
+    return meta
 
 
 def crc32_file(path, chunk=1 << 20):

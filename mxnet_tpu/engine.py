@@ -75,14 +75,19 @@ def wait_all() -> None:
 
 def maybe_sync(arrays) -> None:
     """NaiveEngine hook: block on freshly produced arrays when synchronous
-    debugging mode is requested."""
+    debugging mode is requested. The per-op wait is routed through
+    ``watchdog.sync`` so even naive-mode debugging cannot wedge
+    unboundedly when a ``host.sync`` deadline is armed."""
     if not is_naive():
         return
     import jax
 
+    from . import watchdog as _watchdog
+
     for a in arrays:
         if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer):
-            a.block_until_ready()
+            _watchdog.sync("host.sync", a.block_until_ready,
+                           label="naive per-op sync")
 
 
 # -- bulking knobs (parity: MXEngineSetBulkSize / mx.engine.bulk) ------------

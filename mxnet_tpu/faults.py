@@ -25,7 +25,7 @@ Spec grammar (semicolon-separated entries)::
 
     <point>:<mode>[@<trigger>][:<arg>]
 
-    mode     raise | delay | corrupt | nan | kill | hang
+    mode     raise | delay | corrupt | nan | kill | hang | preempt
     trigger  N        fire on the N-th invocation only (1-based)
              N+       fire on every invocation from the N-th onward
              N,M,...  fire on the listed invocations
@@ -42,6 +42,7 @@ Examples::
     MXNET_TPU_FAULTS="io.decode:delay@*:0.01"      # every decode +10ms
     MXNET_TPU_FAULTS="trainer.step:nan@3+"         # NaN grads from step 3
     MXNET_TPU_FAULTS="trainer.step:kill@5"         # SIGKILL on 5th step
+    MXNET_TPU_FAULTS="trainer.step:preempt@6"      # SIGTERM on 6th step
 
 Modes at a point ``faults.point(name, payload=None)``:
 
@@ -52,9 +53,14 @@ Modes at a point ``faults.point(name, payload=None)``:
              fall back to ``nan``
     nan      payload is a numpy/jax array -> a NaN-poisoned copy is
              returned (callers use the return value)
-    kill     SIGKILL the process — the "preempted mid-step" scenario for
-             kill-and-resume tests (no atexit, no cleanup, exactly like a
-             TPU preemption)
+    kill     SIGKILL the process — the "hard-preempted mid-step" scenario
+             for kill-and-resume tests (no atexit, no cleanup, exactly
+             like a TPU preemption whose grace window has expired)
+    preempt  deliver SIGTERM to the process and CONTINUE — the *planned*
+             preemption (30s-grace SIGTERM). With the mxnet_tpu.preempt
+             handlers installed the in-flight step finishes and the run
+             drains gracefully; without them the process dies like a real
+             unhandled SIGTERM — both paths deterministically testable
     hang     block the calling thread for `arg` seconds (default 3600) —
              the "stuck collective / wedged fetch" scenario the watchdog
              (mxnet_tpu.watchdog) exists to detect; every watchdog path
@@ -131,7 +137,8 @@ def _parse(spec, seed):
             mode, trig_tok = mode_tok.split("@", 1)
         else:
             mode, trig_tok = mode_tok, "1"
-        if mode not in ("raise", "delay", "corrupt", "nan", "kill", "hang"):
+        if mode not in ("raise", "delay", "corrupt", "nan", "kill", "hang",
+                        "preempt"):
             raise ValueError(f"unknown fault mode {mode!r} in {entry!r}")
         # per-point sub-seed keeps streams independent yet reproducible
         out[name] = _PointSpec(mode, _parse_trigger(trig_tok),
@@ -247,6 +254,15 @@ def point(name, payload=None):
         import signal
 
         os.kill(os.getpid(), signal.SIGKILL)  # no return
+    if spec.mode == "preempt":
+        import signal
+
+        # SIGTERM to self: with preempt.install()'ed handlers this only
+        # raises the drain flag (execution continues and the step
+        # finishes); without them the interpreter dies like a real
+        # unhandled preemption
+        os.kill(os.getpid(), signal.SIGTERM)
+        return payload
     if spec.mode == "corrupt" and isinstance(payload, (bytes, bytearray)):
         return _corrupt_bytes(payload, spec.rng)
     if payload is not None:  # corrupt (non-bytes) and nan both poison

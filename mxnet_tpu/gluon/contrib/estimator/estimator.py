@@ -96,7 +96,17 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=None,
             event_handlers=None, batches=None, batch_axis=0):
-        """parity: estimator.py:326."""
+        """parity: estimator.py:326.
+
+        Preemption-aware: with the :mod:`mxnet_tpu.preempt` handlers
+        installed (explicitly or via ``MXNET_TPU_PREEMPT``), a SIGTERM
+        lets the in-flight batch finish, writes a final mid-epoch
+        checkpoint through every :class:`CheckpointHandler` among the
+        event handlers, and exits with the reschedule code (default 75).
+        """
+        from .... import preempt as _preempt
+
+        _preempt.maybe_install_from_env()
         if epochs is None and batches is None:
             epochs = 1
         handlers = self._prepare_handlers(epochs, batches, event_handlers)
@@ -119,6 +129,8 @@ class Estimator:
                     if isinstance(h, BatchEnd):
                         h.batch_end(self, batch=batch,
                                     batch_size=data.shape[0])
+                if _preempt.requested():
+                    self._drain(handlers, _preempt)
                 if self.stop_training:
                     break
             if hasattr(train_data, "reset"):
@@ -131,6 +143,22 @@ class Estimator:
         for h in handlers:
             if isinstance(h, TrainEnd):
                 h.train_end(self)
+
+    def _drain(self, handlers, _preempt):
+        """Graceful preemption drain: save a final mid-epoch checkpoint
+        through every handler that supports it, then exit for reschedule
+        (SystemExit with preempt.exit_code(), default 75)."""
+        self.logger.warning(
+            "preemption drain requested (%s): writing final checkpoint "
+            "and exiting for reschedule",
+            (_preempt.event() or {}).get("signal") or "api")
+        saved = False
+        for h in handlers:
+            if hasattr(h, "drain_save"):
+                h.drain_save(self)
+                saved = True
+        # saved=True: the handlers checkpointed; skip the last-resort hook
+        _preempt.drain(save=False if saved else None)
 
     def _prepare_handlers(self, epochs, batches, event_handlers):
         handlers = list(event_handlers or [])

@@ -178,6 +178,21 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                 atomic_write(f"{prefix}-best.params",
                              estimator.net.save_parameters)
 
+    def drain_save(self, estimator):
+        """Preemption-drain save (Estimator._drain): one final MID-epoch
+        checkpoint at ``current_epoch + 1`` with ``meta.drain`` carrying
+        the drain event, so a resumed run can tell a partial epoch from a
+        completed one. Atomic + CRC-manifested like every other save."""
+        from .... import preempt as _preempt
+
+        meta = {"drain": _preempt.event() or True}
+        if self.best is not None:
+            meta["best"] = self.best
+        self._manager.save(
+            self.current_epoch + 1,
+            {"params": estimator.net.save_parameters},
+            meta=meta)
+
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
     """Stop when the monitored metric stops improving (parity:

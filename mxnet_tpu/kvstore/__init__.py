@@ -1,5 +1,5 @@
 """KVStore (parity: python/mxnet/kvstore/ + src/kvstore/)."""
 from .base import KVStoreBase
-from .kvstore import KVStore, create
+from .kvstore import KVStore, PeerLostError, create
 
-__all__ = ["KVStore", "KVStoreBase", "create"]
+__all__ = ["KVStore", "KVStoreBase", "PeerLostError", "create"]

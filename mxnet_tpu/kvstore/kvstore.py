@@ -29,9 +29,35 @@ from __future__ import annotations
 
 from .. import optimizer as opt_mod
 from ..ndarray import NDArray
+from ..watchdog import StallError
 from .base import KVStoreBase
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "PeerLostError", "create"]
+
+
+class PeerLostError(StallError):
+    """A cross-host kvstore collective (barrier / all-reduce) missed its
+    watchdog deadline — a peer process is presumed dead or wedged.
+
+    Subclasses :class:`~mxnet_tpu.watchdog.StallError` (same
+    ``point``/``label``/``elapsed``/``deadline``/``bundle`` attributes —
+    the crash bundle is already written when this raises) and adds the
+    gang coordinates: ``op`` (the collective), ``rank``, ``num_workers``.
+    A gang supervisor catching this can tear down and restart the group
+    elastically instead of letting every survivor wedge forever.
+    """
+
+    def __init__(self, op, rank, num_workers, stall):
+        super().__init__(stall.point, stall.label, stall.elapsed,
+                         stall.deadline, stall.bundle)
+        self.op = op
+        self.rank = rank
+        self.num_workers = num_workers
+        self.args = (
+            f"kvstore {op!r}: peer lost — rank {rank}/{num_workers} "
+            f"waited {stall.elapsed:.1f}s (deadline {stall.deadline:g}s) "
+            "for the group; a peer process is presumed dead or wedged"
+            + (f"; crash bundle: {stall.bundle}" if stall.bundle else ""),)
 
 
 def _to_list(x):
@@ -362,27 +388,46 @@ class _DistKVStore(KVStore):
     def _cross_host_sum(self, value):
         """All-reduce across hosts as ONE XLA reduction over a global
         process mesh — O(size) transfer (reduce-scatter/all-gather chosen
-        by XLA over DCN/ICI), not the O(N*size) of an allgather+sum."""
-        import jax.numpy as jnp
+        by XLA over DCN/ICI), not the O(N*size) of an allgather+sum.
 
-        raw = value._data
+        Deadline-bounded: the whole collective runs under the
+        ``kvstore.sync`` watchdog point, so a dead peer surfaces as a
+        structured :class:`PeerLostError` (crash bundle attached) instead
+        of wedging this worker forever."""
+        from .. import faults as _faults
+        from .. import watchdog as _watchdog
+
+        def _reduce():
+            import jax.numpy as jnp
+
+            # injectable ('kvstore.sync' hang == a peer stopped reducing)
+            _faults.point("kvstore.sync")
+            raw = value._data
+            try:
+                from jax.experimental import multihost_utils
+                from jax.sharding import PartitionSpec
+
+                mesh = self._proc_mesh()
+                stacked = multihost_utils.host_local_array_to_global_array(
+                    raw[None], mesh, PartitionSpec("proc"))
+                summed = self._sum_exe(mesh)(stacked)
+                return NDArray(
+                    multihost_utils.global_array_to_host_local_array(
+                        summed, mesh, PartitionSpec()))
+            except (ValueError, RuntimeError, TypeError):
+                # fallback: allgather + local sum (still correct, more bytes)
+                from jax.experimental.multihost_utils import process_allgather
+
+                gathered = process_allgather(raw)
+                return NDArray(jnp.sum(gathered, axis=0))
+
         try:
-            from jax.experimental import multihost_utils
-            from jax.sharding import PartitionSpec
-
-            mesh = self._proc_mesh()
-            stacked = multihost_utils.host_local_array_to_global_array(
-                raw[None], mesh, PartitionSpec("proc"))
-            summed = self._sum_exe(mesh)(stacked)
-            return NDArray(
-                multihost_utils.global_array_to_host_local_array(
-                    summed, mesh, PartitionSpec()))
-        except (ValueError, RuntimeError, TypeError):
-            # fallback: allgather + local sum (still correct, more bytes)
-            from jax.experimental.multihost_utils import process_allgather
-
-            gathered = process_allgather(raw)
-            return NDArray(jnp.sum(gathered, axis=0))
+            return _watchdog.sync(
+                "kvstore.sync", _reduce,
+                label=f"cross_host_sum rank {self._rank}/{self._procs}")
+        except StallError as e:
+            raise PeerLostError("cross_host_sum", self._rank, self._procs,
+                                e) from e
 
     def _compressed_cross_host_sum(self, key, value):
         """2-bit gradient compression with error feedback (parity:
@@ -404,12 +449,29 @@ class _DistKVStore(KVStore):
         return NDArray(summed.astype(raw.dtype) * thr)
 
     def barrier(self):
-        import jax
+        """Cross-host rendezvous, deadline-bounded via the
+        ``kvstore.sync`` watchdog point: a peer that never arrives turns
+        the wait into :class:`PeerLostError` (with crash bundle) instead
+        of an unbounded wedge, so a gang supervisor can restart the group
+        elastically."""
+        from .. import faults as _faults
+        from .. import watchdog as _watchdog
 
-        if self._procs > 1:
-            from jax.experimental import multihost_utils
+        def _rendezvous():
+            # injectable ('kvstore.sync' hang == a peer died pre-barrier)
+            _faults.point("kvstore.sync")
+            if self._procs > 1:
+                from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("kvstore_barrier")
+                multihost_utils.sync_global_devices("kvstore_barrier")
+
+        try:
+            _watchdog.sync(
+                "kvstore.sync", _rendezvous,
+                label=f"barrier rank {self._rank}/{self._procs}")
+        except StallError as e:
+            raise PeerLostError("barrier", self._rank, self._procs,
+                                e) from e
         super().barrier()
 
 

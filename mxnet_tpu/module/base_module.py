@@ -143,9 +143,19 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """The reference training loop (parity: base_module.py:409)."""
+        """The reference training loop (parity: base_module.py:409).
+
+        Preemption-aware: with the :mod:`mxnet_tpu.preempt` handlers
+        installed (explicitly or via ``MXNET_TPU_PREEMPT``), a SIGTERM
+        lets the in-flight batch finish, runs the ``epoch_end_callback``
+        chain once for the current (partial) epoch — that is where
+        ``mx.callback.do_checkpoint`` saves — and exits with the
+        reschedule code (default 75)."""
         assert num_epoch is not None, "please specify number of epochs"
         from .. import initializer as init_mod
+        from .. import preempt as _preempt
+
+        _preempt.maybe_install_from_env()
 
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
@@ -182,6 +192,17 @@ class BaseModule:
                 for cb in _as_list(batch_end_callback):
                     cb(BatchEndParam(epoch, nbatch, eval_metric, locals()))
                 nbatch += 1
+                if _preempt.requested():
+                    self.logger.warning(
+                        "Epoch[%d] Batch[%d]: preemption drain requested; "
+                        "checkpointing and exiting for reschedule",
+                        epoch, nbatch)
+                    arg_p, aux_p = self.get_params()
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_p, aux_p)
+                    # the callback chain just checkpointed: skip the
+                    # last-resort hook, only record + exit
+                    _preempt.drain(save=False)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -142,7 +142,12 @@ class RowSparseNDArray(NDArray):
 
     def wait_to_read(self):
         if self._rs_stale:
-            self._dense_cache.block_until_ready()
+            from .. import watchdog as _watchdog
+
+            # deadline-bounded like every other host sync: a wedged dense
+            # cache rebuild surfaces as StallError, not an unbounded wait
+            _watchdog.sync("host.sync", self._dense_cache.block_until_ready,
+                           label="row_sparse dense cache")
         else:
             self._rs_data.wait_to_read()
 

@@ -80,6 +80,15 @@ class DeviceMesh:
         return jax.sharding.NamedSharding(self._jax_mesh,
                                           jax.sharding.PartitionSpec())
 
+    def describe(self):
+        """JSON-able topology descriptor — axis sizes + device census —
+        recorded in checkpoint MANIFESTs (``meta.topology.mesh``) so a
+        resume can detect, name, and reshard across topology changes."""
+        return {"axes": dict(self.axis_sizes),
+                "num_devices": self.num_devices,
+                "process_indices": sorted({getattr(d, "process_index", 0)
+                                           for d in self.devices})}
+
     @property
     def is_multiprocess(self) -> bool:
         """True when this mesh spans devices of other processes

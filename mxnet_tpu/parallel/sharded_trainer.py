@@ -194,7 +194,15 @@ class ShardedTrainer:
         self._opt_raws = self._init_opt_state()
         self._step_fn = None
         self._t = 0
+        # elasticity plumbing: the manager/epoch of the newest checkpoint,
+        # so a preemption drain (or watchdog abort) can write a final one
+        self._ckpt_manager = None
+        self._ckpt_epoch = 0
         self._place_params()
+        # one env var (MXNET_TPU_PREEMPT) arms graceful SIGTERM drains
+        from .. import preempt as _preempt
+
+        _preempt.maybe_install_from_env()
 
     # ------------------------------------------------------------ set-up ---
 
@@ -476,9 +484,20 @@ class ShardedTrainer:
         and the nan_guard host read — is deadline-bounded: a wedged step
         writes a crash bundle and raises a catchable StallError (or
         checkpoints and aborts under ``action:abort``). NOTE the first
-        step includes XLA compilation; size the deadline for it."""
+        step includes XLA compilation; size the deadline for it.
+
+        Once a preemption drain has been requested
+        (:mod:`mxnet_tpu.preempt` — SIGTERM received, or the ``preempt``
+        fault mode fired) no NEW step may start: step raises
+        :class:`~mxnet_tpu.preempt.DrainRequested` *before* dispatching,
+        so the in-flight step is always the last one. Loops that poll
+        ``preempt.requested()`` after each step drain before ever seeing
+        the exception."""
+        from .. import preempt as _preempt
         from .. import watchdog as _watchdog
 
+        if _preempt.requested():
+            raise _preempt.DrainRequested(_preempt.event())
         return _watchdog.sync("trainer.step",
                               lambda: self._step_impl(x, y),
                               label=f"step {self._t + 1}")
@@ -668,32 +687,151 @@ class ShardedTrainer:
         if self._is_writer_rank():
             atomic_write(fname, lambda tmp: nd_utils.save(tmp, payload))
 
+    def topology_meta(self):
+        """JSON-able topology record written into every checkpoint's
+        MANIFEST entry (``meta.topology``): mesh shape, per-array
+        sharding specs, and jax/device metadata. Arrays themselves are
+        saved in CANONICAL HOST LAYOUT (full, gathered, C-order — see
+        ``_host_copy``), so this record is *descriptive*: resume uses it
+        to detect a topology change and reshard on load, never to
+        interpret the bytes."""
+        from .. import checkpoint as _ckpt
+
+        return {
+            "format": "canonical-host-v1",
+            "mesh": self._mesh.describe(),
+            "param_sharding": {n: list(self._rules.get(n, ()))
+                               for n in self._param_names},
+            "zero": self._zero,
+            "host": _ckpt.host_metadata(),
+        }
+
+    def _remember_manager(self, manager, epoch):
+        """Track the newest manager/epoch and (re-)register the shared
+        final-checkpoint hook (``watchdog.set_last_resort``) that both a
+        watchdog ``action:abort`` and a preemption drain invoke. A hook
+        the USER installed explicitly is never clobbered — only ours
+        (tagged) is replaced as training advances."""
+        from .. import watchdog as _watchdog
+
+        self._ckpt_manager = manager
+        self._ckpt_epoch = int(epoch)
+        prev = _watchdog.last_resort()
+        if prev is None or getattr(prev, "_mxtpu_trainer_hook", False):
+            hook = self._final_checkpoint
+            try:
+                hook.__func__._mxtpu_trainer_hook = True
+            except AttributeError:
+                pass
+            _watchdog.set_last_resort(hook)
+
+    def _final_checkpoint(self):
+        """Last-resort/drain save: one more checkpoint through the
+        remembered manager at epoch ``last+1`` with ``meta.drain`` set —
+        the entry's ``step`` records the exact global step, which is the
+        resume position for mid-epoch drains (data-position restore)."""
+        mgr = self._ckpt_manager
+        if mgr is None:
+            return None
+        from .. import preempt as _preempt
+
+        meta = {"drain": _preempt.event() or True}
+        return self.save_checkpoint(mgr, self._ckpt_epoch + 1, meta=meta)
+
     def save_checkpoint(self, manager, epoch, meta=None):
         """Write trainer state through a :class:`~mxnet_tpu.checkpoint.
         CheckpointManager` — atomic write, CRC-checksummed manifest entry,
-        keep-N rotation. Collective across processes; only the writer
-        rank touches disk. Returns the manager's {name: path} map (None
-        on non-writer ranks)."""
+        keep-N rotation, and a ``meta.topology`` record (mesh shape,
+        per-array sharding specs, jax/device metadata) making the
+        checkpoint topology-portable. Collective across processes; only
+        the writer rank touches disk. Also registers this manager as the
+        preemption-drain/last-resort target. Returns the manager's
+        {name: path} map (None on non-writer ranks)."""
         from ..ndarray import utils as nd_utils
 
         payload = self._state_payload()
+        meta = dict(meta or {})
+        meta.setdefault("topology", self.topology_meta())
+        self._remember_manager(manager, epoch)
         if not self._is_writer_rank():
             return None
         return manager.save(
             epoch, {"states": lambda tmp: nd_utils.save(tmp, payload)},
             step=self._t, meta=meta)
 
-    def resume(self, manager):
+    @staticmethod
+    def _topology_changed(saved, current):
+        """Human-readable mismatch list between two topology records
+        (empty = bit-exact-resume territory)."""
+        diffs = []
+        sm, cm = saved.get("mesh") or {}, current.get("mesh") or {}
+        if sm.get("axes") != cm.get("axes"):
+            diffs.append(f"mesh axes {sm.get('axes')} -> {cm.get('axes')}")
+        if sm.get("num_devices") != cm.get("num_devices"):
+            diffs.append(f"device count {sm.get('num_devices')} -> "
+                         f"{cm.get('num_devices')}")
+        sh, ch = saved.get("host") or {}, current.get("host") or {}
+        if sh.get("process_count") != ch.get("process_count"):
+            diffs.append(f"process count {sh.get('process_count')} -> "
+                         f"{ch.get('process_count')}")
+        return diffs
+
+    def resume(self, manager, reshard=None):
         """Restore the latest good checkpoint recorded by `manager`
         (corrupt files are detected by checksum and skipped in favour of
         the previous good epoch). Returns the manifest entry — epoch,
         step, meta — or None when the manager records no checkpoint yet
-        (fresh start)."""
+        (fresh start).
+
+        Topology portability: the entry's ``meta.topology`` is compared
+        against this trainer's mesh. On a MATCH the restore is bit-exact
+        (same arrays, same layout, same RNG stream). On a MISMATCH the
+        checkpoint — stored in canonical host layout — is **resharded on
+        load**: every array (params, aux, and sharded/ZeRO optimizer
+        state) is re-placed through THIS mesh's sharding rules, the RNG
+        stream continues from the saved position (keys are host-side and
+        fold in step/param indices, never device ids, so the sample
+        stream is device-count independent), and the entry's ``step`` is
+        the data position to resume from. Numerics then match the
+        uninterrupted run up to XLA reduction-order differences — not
+        bit-exact. Pass ``reshard=False`` (or set
+        ``MXNET_TPU_PREEMPT_RESHARD=0``) to forbid cross-topology resume;
+        a mismatch then raises a mesh-naming ValueError."""
+        import os as _os
+
         res = manager.resume()
         if res is None:
             return None
         entry, paths = res
+        saved_topo = (entry.get("meta") or {}).get("topology")
+        if saved_topo:
+            current = self.topology_meta()
+            diffs = self._topology_changed(saved_topo, current)
+            if diffs:
+                if reshard is None:
+                    reshard = _os.environ.get(
+                        "MXNET_TPU_PREEMPT_RESHARD", "1") != "0"
+                saved_mesh = (saved_topo.get("mesh") or {}).get("axes")
+                if not reshard:
+                    raise ValueError(
+                        f"checkpoint epoch {entry['epoch']} was written on "
+                        f"DeviceMesh({saved_mesh}) but this trainer runs on "
+                        f"{self._mesh!r} ({'; '.join(diffs)}) and resharding "
+                        "is disabled — resume on the original topology, or "
+                        "allow resharding (reshard=True / unset "
+                        "MXNET_TPU_PREEMPT_RESHARD=0) to re-place the "
+                        "canonical-layout arrays on the new mesh")
+                import warnings
+
+                warnings.warn(
+                    f"resuming checkpoint epoch {entry['epoch']} across a "
+                    f"topology change ({'; '.join(diffs)}): arrays reshard "
+                    f"from DeviceMesh({saved_mesh}) onto {self._mesh!r}; "
+                    "numerics match the original trajectory up to XLA "
+                    "reduction order (bit-exact only on the saved "
+                    "topology)", stacklevel=2)
         self.load_states(paths["states"])
+        self._remember_manager(manager, entry["epoch"])
         return entry
 
     def load_states(self, fname):

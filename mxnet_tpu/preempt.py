@@ -1,0 +1,329 @@
+"""Graceful preemption drain: SIGTERM -> finish the step, checkpoint, exit.
+
+Preemptible TPU fleets deliver SIGTERM with a short grace window (~30s)
+before pulling the machine. Without a handler the process dies mid-step
+with nothing written; with this module the run *drains*:
+
+1. :func:`install` hooks SIGTERM/SIGINT. The handler only sets a **drain
+   flag** and records the event — nothing is interrupted, so the in-flight
+   step always finishes (a second signal skips the grace and exits
+   immediately with the reschedule code).
+2. The training loops check :func:`requested` after every step/batch —
+   ``ShardedTrainer.step`` raises :class:`DrainRequested` rather than
+   start a NEW step once the flag is up, and the estimator/module fit
+   loops drain themselves.
+3. :func:`drain` writes the final checkpoint (an explicit ``save``
+   callable, or the hook installed with ``watchdog.set_last_resort`` —
+   ``ShardedTrainer.save_checkpoint``/``resume`` register one
+   automatically), records a **drain event** JSON next to the crash
+   bundles, and exits with :func:`exit_code` (default 75, ``EX_TEMPFAIL``)
+   so gang supervisors / wrappers know to *reschedule*, not fail the job.
+
+Exit codes, so wrappers can tell the failure modes apart::
+
+    75   graceful preemption drain (this module; reschedule + resume)
+    86   watchdog stall abort (mxnet_tpu.watchdog.ABORT_EXIT_CODE)
+    137  SIGKILL — a hard preemption with no grace; resume from the last
+         periodic checkpoint (CheckpointManager falls back past torn files)
+
+Environment knobs (all optional; see ``tools/diagnose.py``)::
+
+    MXNET_TPU_PREEMPT            auto-install at first trainer/fit use:
+                                 "1" (SIGTERM+SIGINT), "sigterm",
+                                 "sigterm,sigint"; "0"/unset = manual
+    MXNET_TPU_PREEMPT_EXIT_CODE  drain exit code (default 75)
+    MXNET_TPU_PREEMPT_DIR        drain-event directory (default: the
+                                 watchdog crash dir)
+    MXNET_TPU_PREEMPT_RESHARD    "0" forbids resuming a checkpoint on a
+                                 different topology (ShardedTrainer.resume)
+
+Every path is deterministically testable: the ``preempt`` fault mode
+(:mod:`mxnet_tpu.faults`) delivers SIGTERM to the process at a named
+injection point, e.g. ``MXNET_TPU_FAULTS="trainer.step:preempt@6"``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import threading
+import time
+
+from . import log as _log
+
+__all__ = ["DrainRequested", "DRAIN_EXIT_CODE", "install", "installed",
+           "uninstall", "maybe_install_from_env", "requested", "request",
+           "clear", "event", "drain", "exit_code", "drain_dir",
+           "last_drain", "describe"]
+
+DRAIN_EXIT_CODE = 75  # EX_TEMPFAIL: transient failure, please reschedule
+
+_logger = _log.get_logger("mxnet_tpu.preempt")
+
+_lock = threading.Lock()
+_installed: dict[int, object] = {}   # signum -> previous handler
+_requested = False
+_event: dict | None = None
+_exit_fn = os._exit  # test seam for the second-signal fast path
+
+_SIGNALS = {"sigterm": _signal.SIGTERM, "sigint": _signal.SIGINT}
+
+
+class DrainRequested(RuntimeError):
+    """A preemption drain is pending: no new step may start.
+
+    Raised by ``ShardedTrainer.step`` when the drain flag is up — the
+    *previous* step has completed, so the caller should write its final
+    checkpoint (or just call :func:`drain`) and exit for reschedule.
+    """
+
+    def __init__(self, ev=None):
+        self.event = dict(ev or {})
+        why = self.event.get("signal") or self.event.get("reason") or "?"
+        super().__init__(
+            f"preemption drain requested ({why}); finish up, write a "
+            "final checkpoint (preempt.drain()) and exit for reschedule")
+
+
+def exit_code() -> int:
+    """The drain exit code (MXNET_TPU_PREEMPT_EXIT_CODE, default 75)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_PREEMPT_EXIT_CODE",
+                                  DRAIN_EXIT_CODE))
+    except ValueError:
+        return DRAIN_EXIT_CODE
+
+
+def _signal_name(signum):
+    try:
+        return _signal.Signals(signum).name
+    except ValueError:
+        return f"signal {signum}"
+
+
+def _handler(signum, frame):
+    global _requested
+    if _requested:
+        # the platform is out of patience (second delivery): exit NOW with
+        # the reschedule code — a half-written checkpoint is protected by
+        # the manager's atomic writes
+        _logger.error("preempt: second %s during drain; exiting %d "
+                      "immediately", _signal_name(signum), exit_code())
+        _exit_fn(exit_code())
+        return  # only reachable through the test seam
+    _requested = True
+    globals()["_event"] = {"signal": _signal_name(signum),
+                           "signum": int(signum),
+                           "t_wall": time.time(),
+                           "t_mono": time.monotonic(),
+                           "pid": os.getpid()}
+    _logger.warning(
+        "preempt: received %s — draining (the in-flight step finishes, "
+        "then a final checkpoint is written and the process exits %d)",
+        _signal_name(signum), exit_code())
+
+
+def _parse_signals(spec):
+    if spec is None or spec.strip() in ("", "1", "true", "yes"):
+        return (_signal.SIGTERM, _signal.SIGINT)
+    out = []
+    for tok in spec.replace(";", ",").split(","):
+        tok = tok.strip().lower()
+        if not tok:
+            continue
+        if tok not in _SIGNALS:
+            raise ValueError(f"unknown MXNET_TPU_PREEMPT signal {tok!r}; "
+                             f"expected one of {sorted(_SIGNALS)}")
+        out.append(_SIGNALS[tok])
+    return tuple(out) or (_signal.SIGTERM, _signal.SIGINT)
+
+
+def install(signals=None):
+    """Install the drain handlers (idempotent; main thread only).
+
+    signals : iterable of signal numbers, or None for SIGTERM+SIGINT.
+    Returns True when handlers are (now) installed, False when running on
+    a non-main thread where Python forbids signal.signal.
+    """
+    if signals is None:
+        signals = (_signal.SIGTERM, _signal.SIGINT)
+    with _lock:
+        try:
+            for s in signals:
+                if s not in _installed:
+                    _installed[s] = _signal.signal(s, _handler)
+        except ValueError:
+            # signal.signal outside the main thread; the caller keeps
+            # polling requested(), which a main-thread install would set
+            _logger.warning("preempt: cannot install signal handlers "
+                            "outside the main thread")
+            return False
+    return True
+
+
+def installed() -> bool:
+    return bool(_installed)
+
+
+def uninstall():
+    """Restore the previous handlers and clear the drain state (tests)."""
+    with _lock:
+        for s, prev in list(_installed.items()):
+            try:
+                _signal.signal(s, prev)
+            except (ValueError, TypeError):
+                pass
+        _installed.clear()
+    clear()
+
+
+def maybe_install_from_env():
+    """Install per ``MXNET_TPU_PREEMPT`` (no-op when unset/"0"). Called by
+    ShardedTrainer and the estimator/module fit loops so one env var arms
+    the whole stack; explicit :func:`install` always works too."""
+    spec = os.environ.get("MXNET_TPU_PREEMPT", "")
+    if not spec or spec.strip() in ("0", "false", "no"):
+        return False
+    if _installed:
+        return True
+    try:
+        return install(_parse_signals(spec))
+    except ValueError as e:
+        _logger.warning("ignoring invalid MXNET_TPU_PREEMPT: %s", e)
+        return False
+
+
+def requested() -> bool:
+    """True once a drain has been requested (signal or :func:`request`).
+    Cheap — one module-global read — so loops can poll it per batch."""
+    return _requested
+
+
+def request(reason="api"):
+    """Programmatic drain request (same flag the signal handler sets)."""
+    global _requested
+    with _lock:
+        if not _requested:
+            _requested = True
+            globals()["_event"] = {"reason": str(reason),
+                                   "t_wall": time.time(),
+                                   "t_mono": time.monotonic(),
+                                   "pid": os.getpid()}
+    return _event
+
+
+def clear():
+    """Reset the drain flag/event (after a handled drain, or in tests)."""
+    global _requested, _event
+    with _lock:
+        _requested = False
+        _event = None
+
+
+def event():
+    """The pending drain-request event dict, or None."""
+    return dict(_event) if _event else None
+
+
+# ------------------------------------------------------------- the drain ---
+
+def drain_dir():
+    """Where drain-event records go: MXNET_TPU_PREEMPT_DIR, else the
+    watchdog crash dir (one place to look after any kind of death)."""
+    d = os.environ.get("MXNET_TPU_PREEMPT_DIR")
+    if d:
+        return d
+    from . import watchdog as _watchdog
+
+    return _watchdog.crash_dir()
+
+
+def _write_event(ev, directory=None):
+    from .checkpoint import atomic_write
+
+    root = directory or drain_dir()
+    try:
+        os.makedirs(root, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(root, f"drain-{stamp}-p{os.getpid()}.json")
+        payload = json.dumps(ev, indent=1, sort_keys=True, default=repr)
+
+        def writer(tmp):
+            with open(tmp, "w") as f:
+                f.write(payload)
+
+        atomic_write(path, writer)
+        return path
+    except OSError as e:
+        _logger.error("preempt: failed to record drain event: %s", e)
+        return None
+
+
+def last_drain(directory=None):
+    """Parse the newest ``drain-*.json`` record (diagnose.py), or None."""
+    root = directory or drain_dir()
+    try:
+        cands = [os.path.join(root, n) for n in os.listdir(root)
+                 if n.startswith("drain-") and n.endswith(".json")]
+    except OSError:
+        return None
+    for path in sorted(cands, key=os.path.getmtime, reverse=True):
+        try:
+            with open(path) as f:
+                ev = json.load(f)
+            ev["path"] = path
+            return ev
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def drain(save=None, exit=True, code=None, directory=None):
+    """The drain terminal: final checkpoint + drain record + exit.
+
+    save : callable writing the final checkpoint; None uses the hook
+        installed with ``watchdog.set_last_resort`` (ShardedTrainer
+        registers one on every ``save_checkpoint``/``resume``); False
+        skips the save (the caller already checkpointed).
+    exit : raise ``SystemExit(code)`` after recording (the graceful twin
+        of the watchdog's ``os._exit(86)`` — atexit and buffers flush).
+        Pass False to keep running (in-process drills, tests).
+    Returns the drain-event dict (when ``exit=False``).
+    """
+    ev = event() or {"reason": "drain() without a pending request",
+                     "t_wall": time.time(), "pid": os.getpid()}
+    ev["exit_code"] = int(code if code is not None else exit_code())
+    hook = save
+    if hook is None:
+        from . import watchdog as _watchdog
+
+        hook = _watchdog.last_resort()
+    if hook is False or hook is None:
+        ev["final_checkpoint"] = ("skipped" if hook is False else
+                                  "no hook installed")
+    else:
+        try:
+            result = hook()
+            ev["final_checkpoint"] = "written"
+            if isinstance(result, dict):  # manager {name: path} map
+                ev["checkpoint_files"] = {k: str(v)
+                                          for k, v in result.items()}
+        except Exception as e:  # a failed save must not mask the drain
+            _logger.error("preempt: final checkpoint failed: %s", e)
+            ev["final_checkpoint"] = f"failed: {type(e).__name__}: {e}"
+    ev["recorded"] = _write_event(ev, directory)
+    _logger.warning("preempt: drained (%s); final checkpoint: %s; "
+                    "exiting %d for reschedule",
+                    ev.get("signal") or ev.get("reason"),
+                    ev["final_checkpoint"], ev["exit_code"])
+    if exit:
+        raise SystemExit(ev["exit_code"])
+    return ev
+
+
+def describe():
+    """Effective knobs + state as a plain dict (diagnose.py)."""
+    return {"installed": sorted(_signal_name(s) for s in _installed),
+            "requested": _requested, "event": event(),
+            "exit_code": exit_code(), "drain_dir": drain_dir(),
+            "env": os.environ.get("MXNET_TPU_PREEMPT", "<unset>")}

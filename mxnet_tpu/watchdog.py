@@ -10,6 +10,9 @@ this library that can block:
     ``host.sync``      NDArray.wait_to_read / waitall block_until_ready
     ``trainer.step``   the whole compiled ShardedTrainer.step call
     ``io.fetch``       PrefetchingIter background-fetch join (io/io.py)
+    ``kvstore.sync``   cross-host kvstore barrier / all-reduce
+                       (kvstore/kvstore.py) — a deadline here surfaces as a
+                       structured PeerLostError naming the lost gang
     ``kvstore.push`` / ``kvstore.pull``   liveness heartbeats only (the
                        aggregation itself is eager NDArray math; deadlines
                        apply to the blocking spans above)
@@ -79,8 +82,8 @@ import time
 from . import log as _log
 
 __all__ = ["StallError", "configure", "configure_from_env", "enabled",
-           "sync", "beat", "heartbeats", "set_last_resort", "crash_dir",
-           "latest_bundle", "describe", "ABORT_EXIT_CODE"]
+           "sync", "beat", "heartbeats", "set_last_resort", "last_resort",
+           "crash_dir", "latest_bundle", "describe", "ABORT_EXIT_CODE"]
 
 ABORT_EXIT_CODE = 86  # distinct from the interpreter's 1 and SIGKILL's 137
 
@@ -286,10 +289,19 @@ def describe():
 def set_last_resort(fn):
     """Install the final-checkpoint hook run by ``action:abort`` after the
     bundle is written — typically ``lambda: trainer.save_checkpoint(
-    manager, epoch)``. Returns the previous hook. Pass None to clear."""
+    manager, epoch)``. The SAME hook serves the graceful preemption drain
+    (:func:`mxnet_tpu.preempt.drain`); ``ShardedTrainer.save_checkpoint``/
+    ``resume`` register one automatically. Returns the previous hook.
+    Pass None to clear."""
     global _last_resort
     prev, _last_resort = _last_resort, fn
     return prev
+
+
+def last_resort():
+    """The currently installed final-checkpoint hook (or None). Shared
+    plumbing between ``action:abort`` and the preemption drain."""
+    return _last_resort
 
 
 # -------------------------------------------------------------- heartbeats --

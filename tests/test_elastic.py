@@ -1,0 +1,446 @@
+"""Elastic preemption-tolerant training (ISSUE 5).
+
+The headline contracts:
+
+* a SIGTERM (planned preemption) DRAINS the run — the in-flight step
+  finishes, a final checkpoint lands through CheckpointManager (atomic,
+  CRC-verified), and the process exits 75 so wrappers reschedule;
+* checkpoints are topology-portable — written in canonical host layout
+  with a MANIFEST ``meta.topology`` record, so a drained run resumes
+  bit-exact on the SAME mesh and *resharded* on a different device count
+  (matching the uninterrupted trajectory within tolerance), while
+  resharding-disabled resume fails with a mesh-naming error;
+* a lost peer turns a kvstore collective into a structured
+  ``PeerLostError`` (with crash bundle) instead of an unbounded wedge.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, gluon, preempt, watchdog
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.kvstore import PeerLostError
+from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Every test starts and ends with no armed faults, no preempt
+    handlers/flag, and the ambient watchdog config."""
+    faults.reset()
+    preempt.uninstall()
+    yield
+    faults.reset()
+    preempt.uninstall()
+    watchdog.configure_from_env()
+
+
+def _batch(epoch, step):
+    rs = np.random.RandomState(1000 * epoch + step)
+    x = rs.randn(8, 6).astype(np.float32)
+    y = (x @ rs.randn(6, 4) * 0.5).astype(np.float32)
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+def _make_trainer(seed=7, mesh=None, **kw):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(_batch(1, 0)[0])
+    return net, ShardedTrainer(net, gluon.loss.L2Loss(), "adam",
+                               {"learning_rate": 0.05},
+                               mesh=mesh or DeviceMesh({"dp": 8}), **kw)
+
+
+def _params_of(net):
+    return {k: p.data().asnumpy().copy()
+            for k, p in net.collect_params().items()}
+
+
+# ------------------------------------------------------------ preempt.py ---
+
+def test_sigterm_sets_drain_flag_and_uninstall_restores():
+    prev = signal.getsignal(signal.SIGTERM)
+    assert preempt.install()
+    assert preempt.installed()
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(0.05)
+    assert preempt.requested()
+    ev = preempt.event()
+    assert ev["signal"] == "SIGTERM" and ev["pid"] == os.getpid()
+    preempt.uninstall()
+    assert not preempt.installed() and not preempt.requested()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_second_signal_exits_immediately(monkeypatch):
+    codes = []
+    monkeypatch.setattr(preempt, "_exit_fn", codes.append)
+    preempt.install()
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(0.05)
+    assert preempt.requested() and not codes
+    os.kill(os.getpid(), signal.SIGTERM)  # grace expired: exit NOW
+    time.sleep(0.05)
+    assert codes == [preempt.DRAIN_EXIT_CODE]
+
+
+def test_faults_preempt_mode_delivers_sigterm_and_continues():
+    preempt.install()
+    faults.configure("p:preempt@2")
+    faults.point("p")
+    assert not preempt.requested()
+    out = faults.point("p", "payload")  # SIGTERM to self, then CONTINUES
+    time.sleep(0.05)
+    assert out == "payload"
+    assert preempt.requested()
+    assert preempt.event()["signal"] == "SIGTERM"
+
+
+def test_env_auto_install(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PREEMPT", "sigterm")
+    assert preempt.maybe_install_from_env()
+    assert preempt.installed()
+    preempt.uninstall()
+    monkeypatch.setenv("MXNET_TPU_PREEMPT", "0")
+    assert not preempt.maybe_install_from_env()
+    assert not preempt.installed()
+
+
+def test_step_refuses_new_work_once_draining():
+    net, tr = _make_trainer()
+    tr.step(*_batch(1, 0))
+    before = _params_of(net)
+    preempt.request("test")
+    with pytest.raises(preempt.DrainRequested, match="drain requested"):
+        tr.step(*_batch(1, 1))
+    # the refused step mutated nothing
+    for k, v in _params_of(net).items():
+        np.testing.assert_array_equal(before[k], v)
+    preempt.clear()
+    tr.step(*_batch(1, 1))  # cleared: training continues
+
+
+def test_drain_writes_final_checkpoint_event_and_exit_code(tmp_path):
+    net, tr = _make_trainer()
+    mgr = CheckpointManager(tmp_path, prefix="el")
+    for s in range(4):
+        tr.step(*_batch(1, s))
+    tr.save_checkpoint(mgr, 1)
+    preempt.request("drill")
+    with pytest.raises(SystemExit) as exc:
+        preempt.drain(directory=str(tmp_path))
+    assert exc.value.code == preempt.DRAIN_EXIT_CODE == 75
+    # drained checkpoint: epoch last+1, exact step, drain meta, CRC-good
+    entry, paths = mgr.load()
+    assert entry["epoch"] == 2 and entry["step"] == 4
+    assert entry["meta"]["drain"]["reason"] == "drill"
+    assert mgr.verify(entry)
+    # drain event recorded for diagnose.py
+    ev = preempt.last_drain(str(tmp_path))
+    assert ev is not None
+    assert ev["final_checkpoint"] == "written"
+    assert ev["exit_code"] == 75
+
+
+def test_drain_without_hook_still_exits_with_code(tmp_path):
+    saved = watchdog.set_last_resort(None)
+    try:
+        preempt.request("no-hook")
+        with pytest.raises(SystemExit) as exc:
+            preempt.drain(directory=str(tmp_path))
+        assert exc.value.code == 75
+        assert preempt.last_drain(
+            str(tmp_path))["final_checkpoint"] == "no hook installed"
+    finally:
+        watchdog.set_last_resort(saved)
+
+
+# -------------------------------------------------- topology portability ---
+
+def test_manifest_records_topology(tmp_path):
+    net, tr = _make_trainer()
+    mgr = CheckpointManager(tmp_path, prefix="el")
+    tr.step(*_batch(1, 0))
+    tr.save_checkpoint(mgr, 1)
+    manifest = json.loads((tmp_path / "MANIFEST.json").read_text())
+    topo = manifest["checkpoints"][-1]["meta"]["topology"]
+    assert topo["format"] == "canonical-host-v1"
+    assert topo["mesh"]["axes"] == {"dp": 8}
+    assert topo["mesh"]["num_devices"] == 8
+    assert "jax" in topo["host"] and "device_count" in topo["host"]
+    # one spec per trainable param, JSON-able (None -> null round trip)
+    assert set(topo["param_sharding"]) == set(tr._param_names)
+
+
+def test_resume_topology_mismatch_raises_when_reshard_disabled(tmp_path):
+    net, tr = _make_trainer()
+    mgr = CheckpointManager(tmp_path, prefix="el")
+    tr.step(*_batch(1, 0))
+    tr.save_checkpoint(mgr, 1)
+    net2, tr2 = _make_trainer(seed=999, mesh=DeviceMesh({"dp": 4}))
+    with pytest.raises(ValueError) as exc:
+        tr2.resume(mgr, reshard=False)
+    msg = str(exc.value)
+    # a clear, mesh-naming error: both topologies and the way out
+    assert "DeviceMesh({'dp': 8})" in msg
+    assert "DeviceMesh({'dp': 4})" in msg
+    assert "reshard" in msg
+
+
+def test_resume_topology_mismatch_env_knob(tmp_path, monkeypatch):
+    net, tr = _make_trainer()
+    mgr = CheckpointManager(tmp_path, prefix="el")
+    tr.step(*_batch(1, 0))
+    tr.save_checkpoint(mgr, 1)
+    net2, tr2 = _make_trainer(seed=999, mesh=DeviceMesh({"dp": 2}))
+    monkeypatch.setenv("MXNET_TPU_PREEMPT_RESHARD", "0")
+    with pytest.raises(ValueError, match="resharding"):
+        tr2.resume(mgr)
+
+
+def test_resharded_resume_matches_same_mesh_resume(tmp_path):
+    """Drain on dp:8, resume on dp:4 AND on dp:8: the resharded trainer
+    must match the same-topology one — bit-exact at load, and within
+    reduction-order tolerance after further training."""
+    steps = 6
+    net_a, tr_a = _make_trainer()
+    mgr = CheckpointManager(tmp_path, prefix="el")
+    for s in range(steps):
+        tr_a.step(*_batch(1, s))
+    tr_a.save_checkpoint(mgr, 1)
+
+    net_same, tr_same = _make_trainer(seed=999)  # same mesh: bit-exact
+    entry = tr_same.resume(mgr)
+    assert entry["epoch"] == 1 and entry["step"] == steps
+    for (ka, va), (kb, vb) in zip(_params_of(net_a).items(),
+                                  _params_of(net_same).items()):
+        np.testing.assert_array_equal(va, vb, err_msg=f"{ka} vs {kb}")
+
+    net_half, tr_half = _make_trainer(seed=555, mesh=DeviceMesh({"dp": 4}))
+    with pytest.warns(UserWarning, match="topology change"):
+        entry = tr_half.resume(mgr)
+    assert entry["step"] == steps and tr_half._t == steps
+    # canonical-layout arrays re-placed on the new mesh: values identical
+    for (ka, va), (kb, vb) in zip(_params_of(net_same).items(),
+                                  _params_of(net_half).items()):
+        np.testing.assert_array_equal(va, vb, err_msg=f"{ka} vs {kb}")
+    # continued training tracks the same-topology run within tolerance
+    for s in range(3):
+        la = tr_same.step(*_batch(2, s)).asscalar()
+        lb = tr_half.step(*_batch(2, s)).asscalar()
+        np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-5)
+    for (ka, va), (kb, vb) in zip(_params_of(net_same).items(),
+                                  _params_of(net_half).items()):
+        np.testing.assert_allclose(va, vb, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"{ka} vs {kb}")
+
+
+def test_resharded_resume_with_zero_optimizer_state(tmp_path):
+    """ZeRO-1 shards optimizer state over dp; the state is still saved in
+    canonical host layout, so resume onto a different dp size reshards it
+    too (the hard half of topology portability)."""
+    net_a, tr_a = _make_trainer(zero=True)
+    mgr = CheckpointManager(tmp_path, prefix="z")
+    for s in range(4):
+        tr_a.step(*_batch(1, s))
+    tr_a.save_checkpoint(mgr, 1)
+    ref = [[np.asarray(s) for s in per] for per in tr_a._opt_raws]
+
+    net_b, tr_b = _make_trainer(seed=999, zero=True,
+                                mesh=DeviceMesh({"dp": 2}))
+    with pytest.warns(UserWarning, match="topology change"):
+        tr_b.resume(mgr)
+    for per_a, per_b in zip(ref, tr_b._opt_raws):
+        for sa, sb in zip(per_a, per_b):
+            np.testing.assert_array_equal(sa, np.asarray(sb))
+    tr_b.step(*_batch(2, 0))  # the resharded state actually steps
+
+
+# --------------------------------------------------------- fit-loop drain --
+
+def test_estimator_fit_drains_with_final_checkpoint(tmp_path):
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                                   Estimator)
+
+    mx.random.seed(3)
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((4, 5)))
+    rs = np.random.RandomState(0)
+    data = [(mx.nd.array(rs.randn(4, 5).astype(np.float32)),
+             mx.nd.array(rs.randint(0, 3, 4).astype(np.float32)))
+            for _ in range(4)]
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(), context=mx.cpu(),
+                    trainer=Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05}))
+    handler = CheckpointHandler(str(tmp_path), model_prefix="m",
+                                max_checkpoints=3)
+    preempt.request("estimator-drill")
+    with pytest.raises(SystemExit) as exc:
+        est.fit(data, epochs=3, event_handlers=[handler])
+    assert exc.value.code == 75
+    manifest = json.loads((tmp_path / "MANIFEST.json").read_text())
+    entry = manifest["checkpoints"][-1]
+    assert entry["epoch"] == 1  # mid-epoch-1 drain
+    assert entry["meta"]["drain"]["reason"] == "estimator-drill"
+    assert (tmp_path / "m-0001.params").exists()
+
+
+def test_module_fit_drains_through_epoch_end_callbacks(tmp_path):
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    sym = mx.sym.SoftmaxOutput(fc, mx.sym.var("softmax_label"),
+                               name="softmax")
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 5).astype(np.float32)
+    Y = rs.randint(0, 3, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(sym)
+    saved = []
+    preempt.request("module-drill")
+    with pytest.raises(SystemExit) as exc:
+        mod.fit(it, num_epoch=4,
+                epoch_end_callback=lambda e, s, a, x: saved.append(e))
+    assert exc.value.code == 75
+    assert saved == [0]  # the drain ran the checkpoint callbacks once
+
+
+# ------------------------------------------------------ peer-loss (gang) ---
+
+def test_kvstore_barrier_raises_peer_lost_with_bundle(tmp_path):
+    kv = mx.kv.create("dist_sync")  # 1-worker group without a tracker
+    kv.init("w", mx.nd.zeros((3,)))
+    watchdog.configure({"kvstore.sync": 0.4},
+                       crash_dir=str(tmp_path), interval=0.05)
+    faults.configure("kvstore.sync:hang@1:3")  # the dead-peer wedge
+    with pytest.raises(PeerLostError, match="peer lost") as exc:
+        kv.barrier()
+    e = exc.value
+    assert isinstance(e, watchdog.StallError)  # stall handlers still catch
+    assert e.op == "barrier" and e.rank == 0 and e.num_workers == 1
+    assert e.bundle and os.path.isdir(e.bundle)
+    assert "threads.txt" in os.listdir(e.bundle)
+    watchdog.configure_from_env()
+    time.sleep(3.2)  # drain the abandoned daemon waiter
+
+
+def test_kvstore_cross_host_sum_raises_peer_lost(tmp_path):
+    kv = mx.kv.create("dist_sync")
+    watchdog.configure({"kvstore.sync": 0.4},
+                       crash_dir=str(tmp_path), interval=0.05)
+    faults.configure("kvstore.sync:hang@1:3")
+    with pytest.raises(PeerLostError, match="cross_host_sum"):
+        kv._cross_host_sum(mx.nd.ones((4,)))
+    watchdog.configure_from_env()
+    time.sleep(3.2)
+
+
+def test_kvstore_barrier_unbounded_without_deadline_still_works():
+    kv = mx.kv.create("dist_sync")
+    kv.barrier()  # no kvstore.sync deadline armed: plain inline barrier
+
+
+# --------------------------------------------- subprocess drain + resume ---
+
+CHILD = os.path.join(REPO, "tests", "_elastic_child.py")
+
+
+def _run_child(ckpt_dir, out=None, devices=4, extra=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "EL_CKPT_DIR": str(ckpt_dir), "EL_TOTAL": "12", "EL_EPOCH": "4",
+           "EL_DEVICES": str(devices)}
+    env.pop("MXNET_TPU_FAULTS", None)
+    env.pop("XLA_FLAGS", None)  # the child sets its own device count
+    if out is not None:
+        env["EL_OUT"] = str(out)
+    env.update(extra or {})
+    return subprocess.run([sys.executable, CHILD], env=env,
+                          capture_output=True, text=True, timeout=240)
+
+
+@pytest.mark.skipif(not hasattr(os, "kill"), reason="needs POSIX signals")
+def test_sigterm_drain_then_same_topology_resume_bit_exact(tmp_path):
+    """SIGTERM mid-epoch (fault mode 'preempt' at global step 6 of 12):
+    the child drains — finishes step 6, writes a final checkpoint, exits
+    75 — and a same-topology restart resumes from the EXACT step to
+    bit-exact final params vs the uninterrupted run."""
+    ref_out = tmp_path / "ref.npz"
+    proc = _run_child(tmp_path / "ref", ref_out)
+    assert proc.returncode == 0, proc.stderr
+
+    drain_dir = tmp_path / "drain"
+    proc = _run_child(drain_dir, tmp_path / "never.npz",
+                      extra={"MXNET_TPU_FAULTS": "trainer.step:preempt@6"})
+    assert proc.returncode == 75, (proc.returncode, proc.stderr)
+    assert not (tmp_path / "never.npz").exists()
+    manifest = json.loads((drain_dir / "MANIFEST.json").read_text())
+    entry = manifest["checkpoints"][-1]
+    assert entry["step"] == 6  # drained AFTER the in-flight step finished
+    assert entry["meta"]["drain"]["signal"] == "SIGTERM"
+    assert [f for f in os.listdir(drain_dir)
+            if f.startswith("drain-")], "drain event record missing"
+
+    res_out = tmp_path / "resumed.npz"
+    proc = _run_child(drain_dir, res_out, extra={"EL_RESUME": "1"})
+    assert proc.returncode == 0, proc.stderr
+    ref, got = dict(np.load(ref_out)), dict(np.load(res_out))
+    assert ref.keys() == got.keys()
+    for k in ref:
+        if k == "__losses__":
+            continue  # per-run loss logs cover different step ranges
+        np.testing.assert_array_equal(ref[k], got[k]), k
+    # the resumed run replayed exactly the post-drain losses
+    np.testing.assert_array_equal(ref["__losses__"][6:], got["__losses__"])
+
+
+@pytest.mark.skipif(not hasattr(os, "kill"), reason="needs POSIX signals")
+def test_sigterm_drain_then_resharded_resume_across_device_counts(tmp_path):
+    """The acceptance headline: drain on N=4 simulated devices, resume on
+    M=2 — the resharded run must reach the uninterrupted 4-device run's
+    loss trajectory and final params within tolerance; and with
+    resharding disabled the mismatch fails loudly, naming both meshes."""
+    ref_out = tmp_path / "ref.npz"
+    proc = _run_child(tmp_path / "ref", ref_out, devices=4)
+    assert proc.returncode == 0, proc.stderr
+
+    drain_dir = tmp_path / "drain"
+    proc = _run_child(drain_dir, devices=4,
+                      extra={"MXNET_TPU_FAULTS": "trainer.step:preempt@6"})
+    assert proc.returncode == 75, (proc.returncode, proc.stderr)
+
+    # resharding disabled: loud, mesh-naming failure
+    proc = _run_child(drain_dir, devices=2,
+                      extra={"EL_RESUME": "1", "EL_RESHARD": "0"})
+    assert proc.returncode != 0 and proc.returncode != 75
+    assert "DeviceMesh({'dp': 4})" in proc.stderr
+    assert "DeviceMesh({'dp': 2})" in proc.stderr
+
+    # resharding on (the default): N=4 -> M=2 resume completes and tracks
+    res_out = tmp_path / "resumed.npz"
+    proc = _run_child(drain_dir, res_out, devices=2,
+                      extra={"EL_RESUME": "1"})
+    assert proc.returncode == 0, proc.stderr
+    assert "devices=2" in proc.stdout
+    ref, got = dict(np.load(ref_out)), dict(np.load(res_out))
+    np.testing.assert_allclose(ref["__losses__"][6:], got["__losses__"],
+                               rtol=1e-4, atol=1e-5)
+    for k in ref:
+        if k == "__losses__":
+            continue
+        np.testing.assert_allclose(ref[k], got[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)

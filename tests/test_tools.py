@@ -171,11 +171,14 @@ def test_verifier_smoke_every_model_zoo_symbol():
 def test_chaos_smoke_recovers(tmp_path):
     """tools/chaos_smoke.py: 2-epoch toy fit under the canned fault
     schedule — NaN guard absorbs a poisoned batch, checkpoint-write
-    retry absorbs an injected write failure, and an injected crash is
-    recovered via CheckpointManager resume — exit code 0."""
+    retry absorbs an injected write failure, an injected crash is
+    recovered via CheckpointManager resume, an injected hang surfaces as
+    a StallError + bundle, and an injected SIGTERM preemption drains
+    gracefully and resumes resharded on half the simulated devices —
+    exit code 0."""
     import chaos_smoke
 
-    from mxnet_tpu import faults
+    from mxnet_tpu import faults, preempt
 
     faults.reset()
     try:
@@ -183,5 +186,8 @@ def test_chaos_smoke_recovers(tmp_path):
                                "--dir", str(tmp_path)])
     finally:
         faults.reset()
+        preempt.uninstall()
     assert rc == 0
     assert (tmp_path / "MANIFEST.json").exists()
+    # phase 4 left a drain-event record next to the checkpoints
+    assert any(f.startswith("drain-") for f in os.listdir(tmp_path))

@@ -11,6 +11,11 @@ Proves the fault-tolerance stack end to end on one machine, fast:
   * an injected HANG in the train step, detected by the watchdog within
     its deadline, surfaced as a catchable StallError with a crash bundle
     written — then training continues unimpeded,
+  * an injected SIGTERM preemption mid-epoch: the run DRAINS (in-flight
+    step finishes, final CRC-verified checkpoint written, drain event
+    recorded, exit code 75 reserved), then a fresh trainer on a
+    DIFFERENT simulated device count reshards the checkpoint on load
+    and finishes cleanly,
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -43,7 +48,7 @@ def batch_for(epoch, step, seed):
     return mx.nd.array(x), mx.nd.array(y)
 
 
-def build(seed):
+def build(seed, mesh=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
@@ -56,7 +61,8 @@ def build(seed):
     net(batch_for(1, 0, seed)[0])
     trainer = ShardedTrainer(net, gluon.loss.L2Loss(), "adam",
                              {"learning_rate": 0.02},
-                             mesh=DeviceMesh(), max_consecutive_skips=4)
+                             mesh=mesh or DeviceMesh(),
+                             max_consecutive_skips=4)
     return net, trainer
 
 
@@ -156,6 +162,61 @@ def main(argv=None):
     # drain the abandoned waiter (daemon) before mutating the trainer again
     time.sleep(hang_secs + 0.5)
     trainer2.step(x, y)
+
+    # phase 4: preempt mid-epoch with SIGTERM (the 'preempt' fault mode
+    # delivers it to this process at the trainer.step injection point);
+    # the drain flag lets the in-flight step finish, a final checkpoint
+    # lands, a drain event is recorded — then a FRESH trainer on a
+    # different simulated device count reshards the checkpoint on load
+    # and finishes cleanly
+    import jax
+
+    from mxnet_tpu import preempt
+    from mxnet_tpu.parallel import DeviceMesh
+
+    if not preempt.install():
+        print("FAIL: could not install preemption handlers")
+        return 1
+    faults.configure("trainer.step:preempt@2", seed=args.seed)
+    drained = None
+    for s in range(args.steps):
+        x, y = batch_for(1, s, args.seed)
+        trainer2.step(x, y)
+        if preempt.requested():
+            # exit=False: this smoke keeps running where a real job would
+            # now exit preempt.exit_code() (75) for its wrapper
+            drained = preempt.drain(exit=False, directory=ckpt_dir)
+            break
+    faults.reset()
+    if drained is None:
+        print("FAIL: the injected SIGTERM never requested a drain")
+        return 1
+    if drained["final_checkpoint"] != "written":
+        print(f"FAIL: drain checkpoint not written: {drained}")
+        return 1
+    print(f"  drained on {drained.get('signal')} (would exit "
+          f"{drained['exit_code']}); event: {drained['recorded']}")
+    entry, _ = manager.load()
+    if not (entry["meta"].get("drain") and manager.verify(entry)):
+        print("FAIL: drained checkpoint missing drain meta or CRC-bad")
+        return 1
+    preempt.uninstall()
+
+    n = jax.device_count()
+    resume_mesh = DeviceMesh({"dp": max(1, n // 2)})
+    net3, trainer3 = build(args.seed + 2, mesh=resume_mesh)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the reshard notice, if n > 1
+        entry3 = trainer3.resume(manager)
+    print(f"  resharded resume onto {resume_mesh!r} (from {n} devices) "
+          f"at step {entry3['step']}")
+    for s in range(args.steps):
+        x, y = batch_for(2, s, args.seed)
+        trainer3.step(x, y)
+    trainer3.save_checkpoint(manager, entry3["epoch"] + 1)
+    net2 = net3  # the integrity pass below checks the resumed net
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

@@ -136,6 +136,38 @@ def check_watchdog():
         print("watchdog import failed:", e)
 
 
+def check_preempt():
+    """Preemption-drain knobs + the most recent drain event
+    (docs/ROBUSTNESS.md "Preemption & elasticity") — how the last run
+    ended matters for how to restart it."""
+    print("---------Preempt Knobs---------")
+    print(f"MXNET_TPU_PREEMPT={os.environ.get('MXNET_TPU_PREEMPT', '<unset>')}  "
+          "(auto-install SIGTERM/SIGINT drain handlers; off unless set)")
+    print(f"MXNET_TPU_PREEMPT_EXIT_CODE="
+          f"{os.environ.get('MXNET_TPU_PREEMPT_EXIT_CODE', '<unset>')}  "
+          "(drain exit code; default 75 = reschedule me)")
+    print(f"MXNET_TPU_PREEMPT_DIR="
+          f"{os.environ.get('MXNET_TPU_PREEMPT_DIR', '<unset>')}  "
+          "(drain-event dir; default: the crash dir)")
+    print(f"MXNET_TPU_PREEMPT_RESHARD="
+          f"{os.environ.get('MXNET_TPU_PREEMPT_RESHARD', '<unset>')}  "
+          "(0 forbids resuming checkpoints on a different topology)")
+    try:
+        from mxnet_tpu import preempt
+
+        print("effective     :", preempt.describe())
+        ev = preempt.last_drain()
+        if ev is None:
+            print("drain events  : none found in", preempt.drain_dir())
+            return
+        print("last drain    :", ev.get("path"))
+        print("  cause       :", ev.get("signal") or ev.get("reason"))
+        print("  checkpoint  :", ev.get("final_checkpoint"))
+        print("  exit code   :", ev.get("exit_code"))
+    except ImportError as e:
+        print("preempt import failed:", e)
+
+
 def main():
     check_python()
     check_pip()
@@ -145,6 +177,7 @@ def main():
     check_environment()
     check_analysis()
     check_watchdog()
+    check_preempt()
 
 
 if __name__ == "__main__":
