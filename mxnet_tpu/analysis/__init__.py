@@ -8,19 +8,25 @@ The NNVM-pass analogue for this reproduction, TPU-flavored:
   automatic inside ``simple_bind`` (``MXNET_TPU_VERIFY=0`` opts out).
 * :mod:`~mxnet_tpu.analysis.sanitize` — runtime sync-hazard sanitizer
   layered on the bulking engine (``MXNET_TPU_SANITIZE=1``).
+* :mod:`~mxnet_tpu.analysis.distcheck` — distributed-correctness analyzer
+  over the parallel layer (sharding verifier, collective-order deadlock
+  detector, donation-safety checker, recompile-churn detector). The module
+  is callable: ``analysis.distcheck(...)``; auto-run by ``ShardedTrainer``
+  before compile unless ``MXNET_TPU_DISTCHECK=0``.
 
 The companion source-level checker lives in ``tools/mxlint.py``.
 
-``sanitize`` is imported eagerly (NDArray sync points read its ``ACTIVE``
-flag); the verifier — which pulls in the symbol/registry layers — loads on
-first use.
+``sanitize`` and ``distcheck`` are imported eagerly (NDArray sync points
+and the dispatch/compile caches read their ``ACTIVE``/``DONATED``/
+``CACHE_TRACK`` flags inline); the verifier — which pulls in the
+symbol/registry layers — loads on first use.
 """
 from __future__ import annotations
 
-from . import sanitize
+from . import distcheck, sanitize
 
-__all__ = ["sanitize", "verify", "verify_graph", "GraphVerifyError",
-           "Issue", "raise_if_errors", "verify_enabled"]
+__all__ = ["sanitize", "distcheck", "verify", "verify_graph",
+           "GraphVerifyError", "Issue", "raise_if_errors", "verify_enabled"]
 
 _VERIFY_NAMES = ("verify_graph", "GraphVerifyError", "Issue",
                  "raise_if_errors", "verify_enabled", "node_failure_message")

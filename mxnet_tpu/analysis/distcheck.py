@@ -1,0 +1,671 @@
+"""Distributed-correctness static analyzer: the parallel-layer verifier.
+
+PR 3 grew the single-process analysis layer (graph verifier, sync-hazard
+sanitizer, mxlint); this module extends it to the *parallel* layer — the
+class of silent distributed bugs that otherwise surface as XLA error
+spelunking, a wedged gang, or a 100x-slower run:
+
+* **Pass 1 — sharding verifier** (:func:`check_sharding`): propagate
+  per-parameter PartitionSpecs against the :class:`DeviceMesh` axes.
+  Undefined/duplicated axis names (with difflib did-you-mean, mirroring the
+  OpSchema hints), spec rank vs array rank, dims not divisible by the axis
+  size, and large parameters silently fully replicated while the mesh has a
+  model axis.
+* **Pass 2 — collective-order deadlock detector**
+  (:func:`collective_schedule` / :class:`ScheduleRecorder` /
+  :func:`cross_check_schedule`): extract each rank's static collective
+  schedule (from compiled HLO, or recorded live at the kvstore collectives),
+  fingerprint it, and cross-check the fingerprints through the kvstore
+  barrier — two ranks issuing collectives in different orders raise a
+  structured :class:`CollectiveOrderError` *at the barrier*, pre-empting the
+  wedge that ``PeerLostError`` can only report after the deadline.
+* **Pass 3 — donation-safety checker** (:func:`mark_donated` /
+  :func:`check_live`): donated buffers (``ShardedTrainer``'s in-place
+  parameter update) are poisoned in a registry; any later use of a stale
+  alias — eager dispatch, the bulking recorder, a ``CachedOp`` call, or
+  forcing a poisoned :class:`~mxnet_tpu.bulk.LazyRef` — raises a
+  :class:`DonatedBufferError` naming the parameter and the donating step,
+  instead of jax's anonymous "Array has been deleted".
+* **Pass 4 — recompile-churn detector** (:func:`cache_event` /
+  :func:`check_churn`): the dispatch/compile caches (``ops/registry.py``
+  jit cache, ``bulk.py`` fused-segment cache, ``cached_op.py`` signature
+  cache) report every lookup here; per-call-site distinct-key counts expose
+  unstable keys — per-step shape/dtype drift that recompiles every step.
+
+Findings are reported through the same structured
+:class:`~mxnet_tpu.analysis.verify.Issue` list the graph verifier uses;
+errors raise :class:`DistCheckError` (a ``GraphVerifyError`` subclass, so
+``.issues`` carries the full list).
+
+``ShardedTrainer`` auto-runs :func:`check_trainer` before compiling its
+step executable; ``MXNET_TPU_DISTCHECK=0`` opts out of the auto-run, the
+donation poisoning, and the cache tracking in one knob. The module itself
+is callable — ``mxnet_tpu.analysis.distcheck(...)`` is :func:`run`.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+import types
+import weakref
+from collections import deque
+
+from ..base import MXNetError, did_you_mean
+
+__all__ = ["enabled", "run", "DistCheckError", "DistCheckWarning",
+           "DonatedBufferError", "CollectiveOrderError",
+           "check_sharding", "check_trainer",
+           "collective_schedule", "schedule_from_hlo",
+           "schedule_fingerprint", "compare_schedules", "ScheduleRecorder",
+           "cross_check_schedule",
+           "mark_donated", "check_live", "donated_count", "clear_donated",
+           "cache_event", "cache_stats", "check_churn", "reset_cache_stats",
+           "track_caches"]
+
+ENV = "MXNET_TPU_DISTCHECK"
+
+# canonical mesh-axis vocabulary lives in parallel/mesh.py (AXIS_ORDER);
+# duplicated by tools/mxlint.py's partition-spec-literal rule.
+
+_LARGE_PARAM_ELEMS = int(os.environ.get("MXNET_TPU_DISTCHECK_LARGE",
+                                        str(1 << 20)))
+
+
+def enabled() -> bool:
+    """The ``MXNET_TPU_DISTCHECK`` gate (on unless explicitly disabled):
+    controls the ShardedTrainer auto-run, donation poisoning, and
+    compile-cache tracking."""
+    return os.environ.get(ENV, "1").lower() not in ("0", "false", "off")
+
+
+class DistCheckWarning(UserWarning):
+    """A warning-severity distcheck finding (e.g. a large parameter left
+    fully replicated on a mesh with a model axis)."""
+
+
+def _issue(severity, code, node, op, message):
+    # verify.py pulls in the symbol/registry layers; load it on first
+    # finding, not at import (this module must stay import-light — the
+    # dispatch hot paths read module attributes here)
+    from .verify import Issue
+
+    return Issue(severity, code, node, op, message)
+
+
+def _realise_error_class():
+    """``DistCheckError`` subclasses ``GraphVerifyError`` (same structured
+    ``.issues`` payload), but verify.py pulls in the registry layers — so
+    the class is created on first access (module ``__getattr__`` below),
+    keeping this module import-light for the dispatch hot paths."""
+    from .verify import GraphVerifyError
+
+    class DistCheckError(GraphVerifyError):
+        """Distributed-correctness verification failed; ``.issues``
+        carries the structured finding list (errors + warnings)."""
+
+    DistCheckError.__module__ = __name__
+    return DistCheckError
+
+
+def _raise_if_errors(issues, warn=True):
+    import warnings
+
+    if warn:
+        for i in issues:
+            if not i.is_error:
+                warnings.warn(str(i), DistCheckWarning, stacklevel=3)
+    if any(i.is_error for i in issues):
+        raise sys.modules[__name__].DistCheckError(issues)
+    return issues
+
+
+# ====================================================================== #
+# Pass 1 — sharding verifier                                             #
+# ====================================================================== #
+
+def check_sharding(rules, shapes, mesh, batch_shape=None,
+                   large_param_elems=None):
+    """Propagate PartitionSpecs against the mesh; returns the Issue list.
+
+    Parameters
+    ----------
+    rules : {param_name: PartitionSpec tuple} — axis names / None entries.
+    shapes : {param_name: shape tuple} for every parameter in `rules`.
+    mesh : DeviceMesh whose ``axis_names``/``axis_sizes`` the specs must
+        resolve against.
+    batch_shape : optional data-batch shape checked for dp divisibility.
+    large_param_elems : threshold (elements) above which a fully
+        replicated parameter on a mesh with a >1 model axis is flagged
+        (default 2**20; ``MXNET_TPU_DISTCHECK_LARGE`` overrides).
+    """
+    if large_param_elems is None:
+        large_param_elems = _LARGE_PARAM_ELEMS
+    axes = tuple(mesh.axis_names)
+    issues = []
+    model_axes = [a for a in axes
+                  if a != "dp" and mesh.axis_sizes.get(a, 1) > 1]
+    for name, spec in rules.items():
+        spec = tuple(spec or ())
+        shape = shapes.get(name)
+        if shapes and shape is None:
+            issues.append(_issue(
+                "warning", "unknown-param", name, None,
+                "sharding rule names no known parameter"
+                + did_you_mean(name, shapes) + " — the rule is dead"))
+        seen = set()
+        for i, ax in enumerate(spec):
+            if ax is None:
+                continue
+            for ax_name in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+                if ax_name is None:
+                    continue
+                if ax_name not in axes:
+                    issues.append(_issue(
+                        "error", "undefined-axis", name, None,
+                        f"PartitionSpec {spec} on {mesh!r}: "
+                        + mesh.axis_error(ax_name)
+                        + " — jax would silently replicate this "
+                        "dimension instead of sharding it"))
+                    continue
+                if ax_name in seen:
+                    issues.append(_issue(
+                        "error", "duplicate-axis", name, None,
+                        f"PartitionSpec {spec} uses mesh axis "
+                        f"{ax_name!r} for more than one dimension; an "
+                        "axis may shard at most one dimension of an "
+                        "array"))
+                    continue
+                seen.add(ax_name)
+                if shape is not None and i < len(shape):
+                    size = mesh.axis_sizes.get(ax_name, 1)
+                    if size > 1 and int(shape[i]) % size != 0:
+                        issues.append(_issue(
+                            "error", "indivisible-dim", name, None,
+                            f"dimension {i} (size {shape[i]}) of shape "
+                            f"{tuple(shape)} is sharded over axis "
+                            f"{ax_name!r} of size {size} but is not "
+                            f"divisible by it — XLA would pad every "
+                            "shard; fix the rule or the mesh"))
+        if shape is not None and len(spec) > len(shape):
+            issues.append(_issue(
+                "error", "spec-rank", name, None,
+                f"PartitionSpec {spec} has {len(spec)} entries for an "
+                f"array of rank {len(shape)} (shape {tuple(shape)}); a "
+                "spec may not be longer than the array rank"))
+        if shape is not None and model_axes and not any(
+                s is not None for s in spec):
+            elems = 1
+            for d in shape:
+                elems *= int(d)
+            if elems >= large_param_elems:
+                issues.append(_issue(
+                    "warning", "replicated-large-param", name, None,
+                    f"parameter of shape {tuple(shape)} ({elems:,} "
+                    "elements) is fully replicated although the mesh "
+                    f"has model axes {model_axes} — every device holds "
+                    "a full copy; consider a sharding rule"))
+    if batch_shape is not None and "dp" in axes:
+        dp = mesh.axis_sizes.get("dp", 1)
+        if dp > 1 and (not batch_shape or int(batch_shape[0]) % dp != 0):
+            issues.append(_issue(
+                "error", "batch-indivisible", "<data batch>", None,
+                f"batch shape {tuple(batch_shape)} is sharded over the "
+                f"'dp' axis of size {dp} but its leading dimension is "
+                "not divisible by it — feed a batch divisible by the "
+                "dp size (or shrink the dp axis)"))
+    return issues
+
+
+def check_trainer(trainer, x_raw=None, y_raw=None, raise_on_error=True):
+    """The ShardedTrainer auto-run: sharding-verify its rules (params +
+    ZeRO/optimizer state layouts) against its mesh, plus data-batch dp
+    divisibility when a batch is given. Called before the step executable
+    compiles; ``MXNET_TPU_DISTCHECK=0`` opts out."""
+    mesh = trainer._mesh
+    rules = {}
+    shapes = {}
+    handles = list(zip(trainer._param_names, trainer._train_handles)) \
+        + list(zip(trainer._aux_names, trainer._aux_handles))
+    for name, h in handles:
+        rules[name] = tuple(trainer._rules.get(name, ()))
+        shapes[name] = tuple(h._data.shape)
+    for name, spec in trainer._rules.items():
+        rules.setdefault(name, tuple(spec or ()))  # dead-rule typo check
+    # ZeRO state layouts are derived (divisible by construction) but user
+    # rule overrides flow into them — validate the param rules trimmed to
+    # each state slot's rank, mirroring _state_spec_for
+    for name, per in zip(trainer._param_names, trainer._opt_raws):
+        base = tuple(trainer._rules.get(name, ()))
+        for j, s in enumerate(per):
+            key = f"{name} (optimizer state {j})"
+            rules[key] = base[:len(s.shape)]
+            shapes[key] = tuple(s.shape)
+    batch_shape = tuple(x_raw.shape) if x_raw is not None else None
+    issues = check_sharding(rules, shapes, mesh, batch_shape=batch_shape)
+    if raise_on_error:
+        return _raise_if_errors(issues)
+    return issues
+
+
+# ====================================================================== #
+# Pass 2 — collective-order deadlock detector                            #
+# ====================================================================== #
+
+_HLO_COLLECTIVES = re.compile(
+    r"\b(all-reduce(?:-start)?|all-gather(?:-start)?|"
+    r"reduce-scatter|collective-permute(?:-start)?|all-to-all)\b")
+_HLO_SHAPE = re.compile(r"=\s*(\([^)]*\)|[a-z0-9\[\],]+)\s")
+_HLO_GROUPS = re.compile(r"replica_groups=(\{[^}]*\}|\[[^\]]*\][^,)]*)")
+
+
+def schedule_from_hlo(hlo_text):
+    """Extract the static collective schedule from compiled HLO text: an
+    ordered list of ``(kind, result_type, replica_groups)`` entries, one
+    per collective op, in program order."""
+    sched = []
+    for line in hlo_text.splitlines():
+        m = _HLO_COLLECTIVES.search(line)
+        if m is None or "=" not in line:
+            continue
+        kind = m.group(1).replace("-start", "")
+        shape = _HLO_SHAPE.search(line)
+        groups = _HLO_GROUPS.search(line)
+        sched.append((kind,
+                      shape.group(1) if shape else "?",
+                      groups.group(1) if groups else "?"))
+    return sched
+
+
+def collective_schedule(fn, *avals, in_shardings=None, out_shardings=None,
+                        donate_argnums=()):
+    """Compile ``fn`` abstractly and return its static collective schedule
+    (:func:`schedule_from_hlo` of the optimized HLO). ``fn`` may already be
+    jitted; otherwise it is wrapped with the given shardings. No device
+    buffers are touched — inputs are ``jax.ShapeDtypeStruct``s."""
+    import jax
+
+    if hasattr(fn, "lower"):
+        jf = fn
+    else:
+        kw = {}
+        if in_shardings is not None:
+            kw["in_shardings"] = in_shardings
+        if out_shardings is not None:
+            kw["out_shardings"] = out_shardings
+        if donate_argnums:
+            kw["donate_argnums"] = donate_argnums
+        jf = jax.jit(fn, **kw)
+    compiled = jf.lower(*avals).compile()
+    return schedule_from_hlo(compiled.as_text())
+
+
+def schedule_fingerprint(schedule):
+    """Stable hex fingerprint of a collective schedule (count-prefixed
+    sha1) — small enough to allgather and compare across ranks."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for entry in schedule:
+        h.update(repr(entry).encode())
+    return f"{len(schedule)}:{h.hexdigest()[:16]}"
+
+
+def compare_schedules(schedules):
+    """Cross-rank schedule comparison: ``schedules`` is ``{rank: [entry,
+    ...]}``. Returns Issues — empty when every rank's schedule matches,
+    otherwise one ``collective-order`` error naming the first divergent
+    position and what each rank issues there (the deadlock shape: each
+    rank blocks in a different collective)."""
+    ranks = sorted(schedules)
+    if len(ranks) < 2:
+        return []
+    ref_rank = ranks[0]
+    ref = list(schedules[ref_rank])
+    issues = []
+    for rank in ranks[1:]:
+        sched = list(schedules[rank])
+        if sched == ref:
+            continue
+        pos = next((i for i, (a, b) in enumerate(zip(ref, sched))
+                    if a != b), min(len(ref), len(sched)))
+        a = ref[pos] if pos < len(ref) else "<end of schedule>"
+        b = sched[pos] if pos < len(sched) else "<end of schedule>"
+        issues.append(_issue(
+            "error", "collective-order", f"collective #{pos}", None,
+            f"rank {ref_rank} and rank {rank} issue different "
+            f"collective schedules: at position {pos} rank {ref_rank} "
+            f"issues {a!r} but rank {rank} issues {b!r} "
+            f"({len(ref)} vs {len(sched)} collectives total) — "
+            "mismatched schedules deadlock the gang; make every rank "
+            "run the same collective sequence (same keys, same order)"))
+    return issues
+
+
+class ScheduleRecorder:
+    """Constant-memory live recorder of one rank's collective schedule.
+
+    The dist kvstore notes every collective here (``push``/``allreduce``/
+    ``barrier`` with the key involved); a running sha1 plus a bounded tail
+    of recent entries gives a fingerprint that every rank can compare at
+    the next barrier without unbounded growth."""
+
+    __slots__ = ("count", "_hash", "tail", "_lock")
+
+    def __init__(self, tail=64):
+        import hashlib
+
+        self.count = 0
+        self._hash = hashlib.sha1()
+        self.tail = deque(maxlen=tail)
+        self._lock = threading.Lock()
+
+    def note(self, op, detail=""):
+        with self._lock:
+            self.count += 1
+            entry = (op, str(detail))
+            self._hash.update(repr(entry).encode())
+            self.tail.append(entry)
+
+    def fingerprint(self):
+        with self._lock:
+            return f"{self.count}:{self._hash.hexdigest()[:16]}"
+
+    def digest_words(self):
+        """The fingerprint as 3 int64 words (count + 16 hash hex chars)
+        — the allgather payload for the cross-rank check."""
+        with self._lock:
+            d = int(self._hash.hexdigest()[:16], 16)
+        return [self.count, d >> 32, d & 0xFFFFFFFF]
+
+
+class CollectiveOrderError(MXNetError):
+    """Ranks recorded divergent collective schedules — raised at the
+    kvstore barrier, before the divergence can wedge a real collective.
+    Carries ``rank``, ``fingerprints`` (per-rank), and ``tail`` (this
+    rank's recent schedule entries) for the post-mortem."""
+
+    def __init__(self, rank, fingerprints, tail):
+        self.rank = rank
+        self.fingerprints = dict(fingerprints)
+        self.tail = list(tail)
+        lines = ", ".join(f"rank {r}: {fp}"
+                          for r, fp in sorted(self.fingerprints.items()))
+        recent = "; ".join(f"{op}({d})" for op, d in self.tail[-8:])
+        super().__init__(
+            f"collective-order divergence detected at the kvstore barrier "
+            f"(rank {rank}): schedule fingerprints differ across ranks "
+            f"[{lines}] — a deadlock was imminent. This rank's recent "
+            f"collectives: [{recent}]. Make every rank push/pull the same "
+            "keys in the same order.")
+
+
+def cross_check_schedule(recorder, kv=None, allgather=None):
+    """Cross-rank fingerprint check: allgather every rank's schedule
+    digest and raise :class:`CollectiveOrderError` on divergence.
+
+    ``allgather`` is ``fn(list[int]) -> per-rank rows`` (dependency
+    injection for tests); by default ``jax.experimental.multihost_utils.
+    process_allgather`` is used. With one worker this is a no-op. The
+    allgather itself is symmetric (fixed shape on every rank), so it
+    cannot deadlock even when the recorded schedules already diverged."""
+    import jax
+
+    if allgather is None:
+        if jax.process_count() < 2:
+            return
+        from jax.experimental.multihost_utils import process_allgather
+
+        import numpy as _np
+
+        def allgather(words):
+            return process_allgather(_np.asarray(words, _np.int64))
+
+    rank = kv.rank if kv is not None else jax.process_index()
+    rows = allgather(recorder.digest_words())
+    fps = {}
+    rows = [list(map(int, r)) for r in rows]
+    for r, row in enumerate(rows):
+        fps[r] = f"{row[0]}:{(row[1] << 32 | row[2]):016x}"
+    if len(set(fps.values())) > 1:
+        raise CollectiveOrderError(rank, fps, recorder.tail)
+
+
+# ====================================================================== #
+# Pass 3 — donation-safety checker                                       #
+# ====================================================================== #
+
+# id(raw jax.Array) -> (param_name, origin, step, weakref keeping the id
+# valid). Non-empty DONATED is the one-word gate the dispatch paths check;
+# weakref callbacks prune entries as the stale buffers are collected, so
+# the registry tracks only donated buffers that still have live aliases.
+DONATED = {}
+_donated_lock = threading.Lock()
+
+
+class DonatedBufferError(MXNetError):
+    """A buffer donated to a compiled step was used afterwards. Carries
+    ``name`` (the parameter), ``origin`` (who donated), ``step``, and
+    ``where`` (the use site class)."""
+
+    def __init__(self, name, origin, step, where):
+        self.name = name
+        self.origin = origin
+        self.step = step
+        self.where = where
+        super().__init__(
+            f"use-after-donate: buffer of {name!r} was donated to "
+            f"{origin}" + (f" at step {step}" if step is not None else "")
+            + f" and its memory no longer exists, but {where} is reading "
+            "it. Re-read the parameter through its handle "
+            "(e.g. param.data()) after each step instead of holding a "
+            "stale alias, or construct the trainer with donate=False.")
+
+
+def mark_donated(buf, name, origin, step=None):
+    """Poison one donated buffer. ``buf`` may be a raw jax array, an
+    NDArray handle (its buffer is poisoned; a pending LazyRef is poisoned
+    in place so forcing it raises), or a LazyRef."""
+    from ..bulk import LazyRef
+
+    ref = getattr(buf, "_buf", buf)  # NDArray -> its buffer slot
+    record = (name, origin, step)
+    if type(ref) is LazyRef:
+        ref.donated = record
+        if ref._value is None:
+            return
+        ref = ref._value
+    key = id(ref)
+
+    def _expire(_wr, _key=key):
+        with _donated_lock:
+            DONATED.pop(_key, None)
+
+    try:
+        wr = weakref.ref(ref, _expire)
+    except TypeError:
+        wr = None
+    with _donated_lock:
+        DONATED[key] = (name, origin, step, wr)
+        if len(DONATED) > 65536:  # belt-and-braces against callback loss
+            for k in list(DONATED)[:32768]:
+                DONATED.pop(k, None)
+
+
+def donated_count():
+    return len(DONATED)
+
+
+def clear_donated():
+    with _donated_lock:
+        DONATED.clear()
+
+
+def check_live(raws, where):
+    """Raise :class:`DonatedBufferError` if any of ``raws`` is a poisoned
+    (donated) buffer. Call sites gate on the truthiness of
+    :data:`DONATED` so the disabled cost is one dict check. A hit is
+    confirmed via ``is_deleted()`` where available, so id reuse can never
+    produce a false positive."""
+    for raw in raws:
+        rec = DONATED.get(id(raw))
+        if rec is None:
+            continue
+        name, origin, step, wr = rec
+        if wr is not None and wr() is not raw:
+            with _donated_lock:  # stale id (buffer was collected, id reused)
+                DONATED.pop(id(raw), None)
+            continue
+        deleted = getattr(raw, "is_deleted", None)
+        if deleted is not None and not deleted():
+            continue  # donation did not actually consume it (backend quirk)
+        raise DonatedBufferError(name, origin, step, where)
+
+
+# ====================================================================== #
+# Pass 4 — recompile-churn detector                                      #
+# ====================================================================== #
+
+# (kind, site) -> [hits, misses, key-set, last_key, drift_samples]
+_CACHE_SITES = {}
+_KEY_CAP = 256
+
+CACHE_TRACK = enabled()
+
+
+def track_caches(on=True):
+    """Toggle compile-cache tracking at runtime (set from the env gate at
+    import). The dispatch hot paths read :data:`CACHE_TRACK` directly."""
+    global CACHE_TRACK
+    CACHE_TRACK = bool(on)
+
+
+def cache_event(kind, site, key, hit):
+    """One dispatch/compile cache lookup. ``kind`` is the cache family
+    (``dispatch``/``bulk``/``cachedop``), ``site`` the call site (op name,
+    CachedOp identity), ``key`` the cache key. Hot-path cheap: a hit is a
+    dict lookup + an increment."""
+    rec = _CACHE_SITES.get((kind, site))
+    if rec is None:
+        rec = _CACHE_SITES[(kind, site)] = [0, 0, set(), None, []]
+    if hit:
+        rec[0] += 1
+        return
+    rec[1] += 1
+    try:
+        if len(rec[2]) < _KEY_CAP:
+            rec[2].add(key)
+        prev = rec[3]
+        rec[3] = key
+        if prev is not None and prev != key and len(rec[4]) < 8:
+            rec[4].append((prev, key))
+    except TypeError:
+        pass  # unhashable key — counted, not remembered
+    from .. import profiler as _profiler
+
+    if _profiler._RECORDING:
+        _profiler.record_cache(kind, rec[0], rec[1])
+
+
+def cache_stats():
+    """Per-site compile-cache statistics: ``{(kind, site): {hits, misses,
+    distinct_keys}}`` — the measurement seam for the unified compile
+    service (ROADMAP item 5) and the ``tools/diagnose.py`` report."""
+    out = {}
+    for (kind, site), rec in sorted(_CACHE_SITES.items()):
+        out[(kind, site)] = {"hits": rec[0], "misses": rec[1],
+                             "distinct_keys": len(rec[2])}
+    return out
+
+
+def reset_cache_stats():
+    _CACHE_SITES.clear()
+
+
+def _describe_drift(prev, new, path=()):
+    """First structural difference between two cache keys, as a
+    human-readable component path (shape/dtype drift usually)."""
+    if type(prev) is tuple and type(new) is tuple and len(prev) == len(new):
+        for i, (a, b) in enumerate(zip(prev, new)):
+            if a != b:
+                return _describe_drift(a, b, path + (i,))
+        return "?"
+    loc = "".join(f"[{i}]" for i in path) or "key"
+    return f"{loc}: {prev!r} -> {new!r}"
+
+
+def check_churn(min_misses=4, max_hit_ratio=0.5):
+    """Flag call sites whose compile-cache keys churn: at least
+    ``min_misses`` distinct compilations with a hit ratio at or below
+    ``max_hit_ratio`` (per-step shape/dtype drift compiles a fresh
+    executable every call). Returns warning Issues naming the site and
+    the drifting key component."""
+    issues = []
+    for (kind, site), rec in sorted(_CACHE_SITES.items()):
+        hits, misses, keys, _last, drift = rec
+        calls = hits + misses
+        if misses < min_misses or calls == 0:
+            continue
+        if hits / calls > max_hit_ratio:
+            continue
+        detail = ""
+        if drift:
+            detail = ("; drifting key component: "
+                      + _describe_drift(*drift[-1]))
+        issues.append(_issue(
+            "warning", "cache-churn", site, kind,
+            f"{misses} compile-cache misses in {calls} calls "
+            f"({len(keys)} distinct keys seen) — the cache key is "
+            f"unstable, so this site recompiles instead of reusing an "
+            f"executable{detail}. Pad/bucket the inputs to stable "
+            "shapes, or hoist the varying value into a traced argument"))
+    return issues
+
+
+# ====================================================================== #
+# Orchestrator                                                           #
+# ====================================================================== #
+
+def run(trainer=None, *, rules=None, shapes=None, mesh=None,
+        batch_shape=None, schedules=None, churn=True, raise_on_error=True):
+    """Run every applicable pass; returns the combined Issue list.
+
+    ``analysis.distcheck(...)`` resolves here (the module is callable).
+    Pass a ``trainer`` (ShardedTrainer) for its full sharding surface, or
+    raw ``rules``/``shapes``/``mesh`` (+ optional ``batch_shape``). Pass
+    ``schedules`` ({rank: schedule}) for the cross-rank comparison and
+    leave ``churn`` on to sweep the compile-cache statistics."""
+    issues = []
+    if trainer is not None:
+        issues += check_trainer(trainer, raise_on_error=False)
+    if rules is not None and mesh is not None:
+        issues += check_sharding(rules, shapes or {}, mesh,
+                                 batch_shape=batch_shape)
+    if schedules:
+        issues += compare_schedules(schedules)
+    if churn:
+        issues += check_churn()
+    if raise_on_error:
+        return _raise_if_errors(issues)
+    return issues
+
+
+class _CallableModule(types.ModuleType):
+    """``analysis.distcheck(...)`` — the module is its own entry point.
+    ``DistCheckError`` materialises on first access (verify.py stays off
+    the import path of the dispatch hot paths)."""
+
+    def __call__(self, *args, **kwargs):
+        return run(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name == "DistCheckError":
+            cls = _realise_error_class()
+            self.DistCheckError = cls
+            return cls
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+
+
+sys.modules[__name__].__class__ = _CallableModule

@@ -11,11 +11,29 @@ import threading
 
 import numpy as _np
 
-__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "did_you_mean"]
 
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: dmlc error -> MXNetError)."""
+
+
+def did_you_mean(name, candidates, n=1):
+    """A ``" (did you mean ...?)"`` suffix for a near-miss name, or ``""``.
+
+    The one difflib helper shared by every naming-error site — OpSchema
+    kwargs, the operator registry, DeviceMesh axis names, and the distcheck
+    sharding verifier — so all of them hint the same way."""
+    import difflib
+
+    close = difflib.get_close_matches(str(name),
+                                      [str(c) for c in candidates], n=n)
+    if not close:
+        return ""
+    if len(close) == 1:
+        return f" (did you mean {close[0]!r}?)"
+    return f" (did you mean one of {close}?)"
 
 
 string_types = (str,)

@@ -40,6 +40,7 @@ import weakref
 
 from . import autograd
 from . import profiler as _profiler
+from .analysis import distcheck as _distcheck
 from .analysis import sanitize as _sanitize
 
 __all__ = ["LazyRef", "BulkSegment", "record", "flush", "active",
@@ -78,7 +79,8 @@ class LazyRef:
     Shape/dtype are known statically (eval_shape), so metadata queries on a
     lazy NDArray never force execution; only value reads do."""
 
-    __slots__ = ("segment", "flat_idx", "shape", "dtype", "taped", "_value")
+    __slots__ = ("segment", "flat_idx", "shape", "dtype", "taped", "_value",
+                 "donated")
 
     def __init__(self, segment, flat_idx, shape, dtype, taped):
         self.segment = segment
@@ -87,6 +89,7 @@ class LazyRef:
         self.dtype = dtype
         self.taped = taped
         self._value = None
+        self.donated = None  # (name, origin, step) once poisoned
 
     @property
     def ndim(self):
@@ -101,6 +104,12 @@ class LazyRef:
 
     def force(self):
         """Materialise: flush the owning segment, return the concrete array."""
+        if self.donated is not None:
+            # poisoned by distcheck: this buffer was handed to a donating
+            # compiled step — reading it is use-after-donate
+            name, origin, step = self.donated
+            raise _distcheck.DonatedBufferError(
+                name, origin, step, "a lazy buffer read")
         if self._value is None:
             if _sanitize.ACTIVE:
                 # an implicit value read is splitting the live segment
@@ -168,6 +177,11 @@ class BulkSegment:
         live_t = tuple(live)
         plan_key = (tuple(self.plan), live_t)
         fused = _FUSED_CACHE.get(plan_key)
+        if _distcheck.CACHE_TRACK:
+            # recompile-churn seam: distinct plans per flush site feed the
+            # distcheck cache-stats (tools/diagnose.py "compile cache")
+            _distcheck.cache_event("bulk", "BulkSegment", plan_key,
+                                   fused is not None)
         if fused is None:
             fused = _FUSED_CACHE[plan_key] = jax.jit(
                 _build_fused(self.steps, live_t))
@@ -337,6 +351,10 @@ def record(op, kwargs, kw_key, nd_inputs, wrap, size):
             raw = buf
         if isinstance(raw, _Tracer):
             return None
+        if _distcheck.DONATED:
+            # use-after-donate caught at RECORD time — before the stale
+            # buffer is wired into a fused segment
+            _distcheck.check_live((raw,), f"op {op.name!r} (bulked)")
         pos = seg.ext_index.get(id(x)) if seg is not None else None
         if pos is None:
             if staged is None:

@@ -29,6 +29,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import autograd
+from .analysis import distcheck as _distcheck
 
 __all__ = ["CachedOp", "current_trace", "update_state"]
 
@@ -135,6 +136,11 @@ class CachedOp:
         self._flags = dict(flags) if flags else {}
         self._cache: Dict = {}   # key -> (fwd_jit, bwd_jit, state_handles, out_spec)
         self._uses_rng = True    # conservatively thread a key; cheap if unused
+        # recompile-churn call-site identity (analysis.distcheck pass 4):
+        # the signature cache below keys on input SHAPES, so per-step
+        # shape drift shows up as distinct keys at this site
+        self._site = "CachedOp[%s]" % getattr(
+            forward_fn, "__qualname__", type(forward_fn).__name__)
 
     # -------------------------------------------------------------- call ---
     def __call__(self, *args):
@@ -146,6 +152,10 @@ class CachedOp:
         in_raws = [a._data for a in arrays]
         params = self._param_handles
         param_raws = [p._data for p in params]
+        if _distcheck.DONATED:
+            # use-after-donate: stale aliases of donated buffers fail
+            # here, named, before they reach the compiled executable
+            _distcheck.check_live(in_raws + param_raws, self._site)
         training = autograd.is_training()
         from . import _amp_core
 
@@ -158,6 +168,9 @@ class CachedOp:
                tuple((tuple(r.shape), _dt(r.dtype)) for r in param_raws),
                training)
         entry = self._cache.get(key)
+        if _distcheck.CACHE_TRACK:
+            _distcheck.cache_event("cachedop", self._site, key,
+                                   entry is not None)
         if entry is None:
             entry = self._build(key, spec, arrays, params, training)
             self._cache[key] = entry

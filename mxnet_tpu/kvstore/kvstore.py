@@ -295,6 +295,14 @@ class _DistKVStore(KVStore):
         self._procs = jax.process_count()
         self._rank = jax.process_index()
         self._residuals = {}  # error-feedback buffers for 2bit compression
+        # collective-order deadlock detector (analysis.distcheck pass 2):
+        # every collective this rank issues is fingerprinted; barrier()
+        # cross-checks the fingerprints so rank-divergent schedules raise
+        # a structured error BEFORE they can wedge a real collective
+        from ..analysis import distcheck as _distcheck
+
+        self._sched = _distcheck.ScheduleRecorder() \
+            if _distcheck.enabled() else None
 
     @property
     def rank(self):
@@ -315,6 +323,14 @@ class _DistKVStore(KVStore):
             agg = vals[0]
             for v in vals[1:]:
                 agg = self._merge(agg, v)
+            if self._sched is not None:
+                # the static collective schedule this rank is committing
+                # to: op kind + key + payload signature (divergent key
+                # ORDER across ranks is the classic silent deadlock)
+                self._sched.note(
+                    "allgather" if self._type == "dist_async"
+                    else "allreduce",
+                    f"{k}:{tuple(agg.shape)}:{agg.dtype}")
             if self._procs > 1 and self._type == "dist_async" \
                     and self._updater is not None:
                 self._async_push(k, agg)
@@ -453,9 +469,24 @@ class _DistKVStore(KVStore):
         ``kvstore.sync`` watchdog point: a peer that never arrives turns
         the wait into :class:`PeerLostError` (with crash bundle) instead
         of an unbounded wedge, so a gang supervisor can restart the group
-        elastically."""
+        elastically.
+
+        When distcheck is enabled the barrier first cross-checks every
+        rank's collective-schedule fingerprint (a fixed-shape allgather,
+        deadlock-free even when the schedules diverged): ranks that
+        issued different collective sequences raise a structured
+        :class:`~mxnet_tpu.analysis.distcheck.CollectiveOrderError`
+        naming the divergence, instead of wedging in the NEXT collective
+        and surfacing only as a PeerLostError after the deadline."""
         from .. import faults as _faults
         from .. import watchdog as _watchdog
+
+        if self._sched is not None:
+            if self._procs > 1:
+                from ..analysis import distcheck as _distcheck
+
+                _distcheck.cross_check_schedule(self._sched, kv=self)
+            self._sched.note("barrier", "")
 
         def _rendezvous():
             # injectable ('kvstore.sync' hang == a peer died pre-barrier)

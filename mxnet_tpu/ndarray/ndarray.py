@@ -29,6 +29,7 @@ from .. import bulk as _bulk
 from .. import faults as _faults
 from .. import profiler as _profiler
 from .. import watchdog as _watchdog
+from ..analysis import distcheck as _distcheck
 from ..analysis import sanitize as _sanitize
 from ..base import MXNetError, canonical_dtype
 from ..context import Context, current_context
@@ -667,6 +668,10 @@ def _invoke(op_name, nd_inputs, kwargs, out=None, wrap=None):
             if bulked is not None:
                 return bulked
     raws = [x._data for x in nd_inputs]
+    if _distcheck.DONATED:
+        # use-after-donate: a stale alias of a buffer ShardedTrainer
+        # donated raises a param-named error here, at the use site
+        _distcheck.check_live(raws, f"op {op_name!r}")
     if _amp_core.ACTIVE:
         raws = _amp_core.cast_inputs(op_name, raws)
     if autograd.is_recording() and op.differentiable and autograd.any_on_tape(nd_inputs):

@@ -82,16 +82,22 @@ def _linalg_syevd(A):
 
 @register("linalg_sumlogdiag")
 def _linalg_sumlogdiag(A):
+    """Sum of the logs of the main-diagonal entries of each matrix
+    (parity: la_op.cc sumlogdiag)."""
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
 
 
 @register("linalg_extractdiag")
 def _linalg_extractdiag(A, offset=0):
+    """Extract the (offset) diagonal of each matrix as a vector
+    (parity: la_op.cc extractdiag)."""
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
 
 
 @register("linalg_makediag")
 def _linalg_makediag(A, offset=0):
+    """Build square matrices carrying the input vectors on the (offset)
+    diagonal (parity: la_op.cc makediag)."""
     base = jnp.zeros(A.shape[:-1] + (A.shape[-1] + abs(offset),) * 2,
                      A.dtype)
     idx = jnp.arange(A.shape[-1])
@@ -131,17 +137,21 @@ def _linalg_maketrian(A, offset=0, lower=True):
 
 @register("linalg_det")
 def _linalg_det(A):
+    """Determinant of each matrix (parity: la_op.cc det)."""
     return jnp.linalg.det(A)
 
 
 @register("linalg_slogdet", num_outputs=2)
 def _linalg_slogdet(A):
+    """Sign and log-abs-determinant of each matrix (parity: la_op.cc
+    slogdet)."""
     sign, logabs = jnp.linalg.slogdet(A)
     return sign, logabs
 
 
 @register("linalg_inverse")
 def _linalg_inverse(A):
+    """Matrix inverse of each matrix (parity: la_op.cc inverse)."""
     return jnp.linalg.inv(A)
 
 

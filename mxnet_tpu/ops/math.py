@@ -86,11 +86,14 @@ register("radians")(jnp.radians)
 
 @register("clip")
 def _clip(x, a_min=None, a_max=None):
+    """Clamp every element into [a_min, a_max] (parity: clip,
+    matrix_op.cc)."""
     return jnp.clip(x, a_min, a_max)
 
 
 @register("Cast", aliases=("cast",))
 def _cast(x, dtype="float32"):
+    """Cast to the given dtype (parity: Cast, elemwise_unary_op.cc)."""
     from ..base import canonical_dtype
 
     return x.astype(canonical_dtype(dtype))
@@ -98,6 +101,8 @@ def _cast(x, dtype="float32"):
 
 @register("amp_cast")
 def _amp_cast(x, dtype="bfloat16"):
+    """AMP-inserted cast (identity gradient; parity: amp_cast,
+    amp_cast.cc)."""
     from ..base import canonical_dtype
 
     return x.astype(canonical_dtype(dtype))

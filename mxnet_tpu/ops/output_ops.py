@@ -39,6 +39,8 @@ def _make_output_op(name, fwd_fn, grad_fn):
 
     @register(name)
     def op(data, label, grad_scale=1.0):
+        """Regression output head: identity forward, loss-defined backward
+        scaled by grad_scale (parity: regression_output.cc)."""
         lab = label.reshape(data.shape) if label.size == data.size \
             else label
         return core(data, lab, grad_scale)
@@ -93,6 +95,8 @@ _svm_core.defvjp(_svm_fwd, _svm_bwd)
 @register("SVMOutput")
 def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                 use_linear=False):
+    """SVM output head: identity forward; backward is the (linear or
+    squared) hinge-loss gradient (parity: svm_output.cc)."""
     return _svm_core(data, label, margin, regularization_coefficient,
                      use_linear)
 
@@ -123,4 +127,6 @@ _kl_sparse_core.defvjp(_kl_fwd, _kl_bwd)
 @register("IdentityAttachKLSparseReg")
 def _identity_attach_kl(data, sparseness_target=0.1, penalty=0.001,
                         momentum=0.9):
+    """Identity forward that attaches a KL sparsity-penalty gradient on
+    the mean activation (parity: identity_attach_KL_sparse_reg.cc)."""
     return _kl_sparse_core(data, sparseness_target, penalty, momentum)

@@ -29,6 +29,8 @@ from __future__ import annotations
 import functools
 from typing import Callable, Dict, Optional
 
+from ..analysis import distcheck as _distcheck
+
 __all__ = ["Operator", "register", "get", "list_ops", "apply_op", "infer_output"]
 
 _REGISTRY: Dict[str, "Operator"] = {}
@@ -176,11 +178,18 @@ class Operator:
             return functools.partial(self.fn, **kwargs)
         key = _key
         try:
-            return self._jit_cache[key]
+            hit = self._jit_cache[key]
         except KeyError:
-            pass
+            hit = None
         except TypeError:
             return functools.partial(self.fn, **kwargs)
+        if _distcheck.CACHE_TRACK:
+            # per-op dispatch-cache stats: the recompile-churn seam
+            # (analysis.distcheck pass 4 / tools/diagnose.py)
+            _distcheck.cache_event("dispatch", self.name, key,
+                                   hit is not None)
+        if hit is not None:
+            return hit
         jitted = jax.jit(self.partial(kwargs, key))
         self._jit_cache[key] = jitted
         return jitted
@@ -230,13 +239,11 @@ def get(name: str) -> Operator:
     try:
         return _REGISTRY[name]
     except KeyError:
-        import difflib
+        from ..base import did_you_mean
 
-        close = difflib.get_close_matches(name, _REGISTRY, n=3)
-        hint = f"; did you mean {close}?" if close else ""
         raise KeyError(f"operator {name!r} is not registered "
-                       f"({len(set(_REGISTRY.values()))} ops available"
-                       f"{hint})") from None
+                       f"({len(set(_REGISTRY.values()))} ops available)"
+                       f"{did_you_mean(name, _REGISTRY, n=3)}") from None
 
 
 def list_ops():

@@ -219,13 +219,10 @@ class OpSchema:
                 if self.open_kwargs or k in self.inputs:
                     out[k] = v
                     continue
-                import difflib
+                from ..base import did_you_mean
 
-                close = difflib.get_close_matches(
-                    k, list(self.params) + list(self.inputs), n=1)
-                reason = "unknown parameter"
-                if close:
-                    reason += f" (did you mean {close[0]!r}?)"
+                reason = "unknown parameter" + did_you_mean(
+                    k, list(self.params) + list(self.inputs))
                 raise OpParamError(
                     self.op_name, k, reason, valid=self.params.keys())
             out[k] = spec.coerce(self.op_name, v)

@@ -38,6 +38,15 @@ class DeviceMesh:
         if axes is None:
             axes = {"dp": n}
         sizes = dict(axes)
+        for a, v in sizes.items():
+            if not isinstance(a, str) or not a:
+                raise ValueError(
+                    f"mesh axis names must be non-empty strings, got "
+                    f"{a!r}; conventional axes: {list(AXIS_ORDER)}")
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"mesh axis {a!r} must have a positive integer size, "
+                    f"got {v!r}")
         prod = 1
         for v in sizes.values():
             prod *= v
@@ -59,6 +68,18 @@ class DeviceMesh:
 
     def size(self, axis: str) -> int:
         return self.axis_sizes.get(axis, 1)
+
+    def axis_error(self, axis) -> str:
+        """Mesh-naming diagnostic for an axis this mesh does not have:
+        did-you-mean suggestion (shared difflib helper) + the valid axis
+        list. Used by the distcheck sharding verifier and the resume/
+        reshard error paths so every mesh-naming error hints the same
+        way."""
+        from ..base import did_you_mean
+
+        return (f"axis {axis!r} is not an axis of this mesh"
+                f"{did_you_mean(axis, self.axis_names)}; valid axes: "
+                f"{list(self.axis_names)}")
 
     @property
     def num_devices(self) -> int:

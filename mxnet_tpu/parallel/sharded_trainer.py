@@ -189,6 +189,26 @@ class ShardedTrainer:
         self._rules = dict(sharding_rules(params, self._mesh))
         if rules:
             self._rules.update(rules)
+        # distributed-correctness pre-check (analysis.distcheck pass 1):
+        # a rule naming an absent axis would otherwise SILENTLY replicate
+        # in _place_params below — fail here, param-named, with
+        # did-you-mean hints (MXNET_TPU_DISTCHECK=0 opts out)
+        from ..analysis import distcheck as _distcheck
+
+        self._distcheck = _distcheck.enabled()
+        if self._distcheck:
+            names = self._param_names + self._aux_names
+            handles = self._train_handles + self._aux_handles
+            check_rules = {n: self._rules.get(n, ()) for n in names}
+            for n, spec in self._rules.items():
+                # user rules naming no parameter are dead — keep them in
+                # the checked set so the typo gets a did-you-mean hint
+                check_rules.setdefault(n, spec)
+            _distcheck.run(
+                rules=check_rules,
+                shapes={n: tuple(h.shape)
+                        for n, h in zip(names, handles)},
+                mesh=self._mesh, churn=False)
         self._wd_mult = [1.0 if (n.endswith("weight") or n.endswith("gamma"))
                          else 0.0 for n in self._param_names]
         self._opt_raws = self._init_opt_state()
@@ -514,6 +534,14 @@ class ShardedTrainer:
             # 'trainer.step' injection: raise/delay/kill, or nan-poison
             # the batch (which the nan_guard must then absorb)
             x_raw = _faults.point("trainer.step", x_raw)
+        if self._step_fn is None and self._distcheck:
+            # distcheck auto-run BEFORE compile: full sharding surface
+            # (params + optimizer-state layouts + batch dp divisibility)
+            # — a misconfiguration fails here with a param-named Issue
+            # list instead of an XLA error mid-compile
+            from ..analysis import distcheck as _distcheck
+
+            _distcheck.check_trainer(self, x_raw, y_raw)
         x_raw = self._put_batch(
             x_raw, self._mesh.sharding(
                 *(("dp",) + (None,) * (len(x_raw.shape) - 1))))
@@ -525,13 +553,31 @@ class ShardedTrainer:
 
         lr = self._lr if self._lr_scheduler is None \
             else float(self._lr_scheduler(self._t))
+        in_p = tuple(h._data for h in self._train_handles)
+        in_opt = self._opt_raws
+        in_aux = tuple(h._data for h in self._aux_handles)
         new_p, new_opt, new_aux, loss, ok = self._step_fn(
-            tuple(h._data for h in self._train_handles),
-            self._opt_raws,
-            tuple(h._data for h in self._aux_handles),
+            in_p, in_opt, in_aux,
             x_raw, y_raw, _rand.next_key(),
             jnp.asarray(self._t, jnp.int32),
             jnp.asarray(lr, jnp.float32))
+        if self._donate and self._distcheck:
+            # donation-safety (distcheck pass 3): the step donated every
+            # param/opt/aux input buffer — poison them so a stale alias
+            # used later raises a param-named use-after-donate error
+            # instead of jax's anonymous "Array has been deleted"
+            from ..analysis import distcheck as _distcheck
+
+            origin = "ShardedTrainer.step (donate=True)"
+            for name, raw in zip(self._param_names, in_p):
+                _distcheck.mark_donated(raw, name, origin, self._t)
+            for name, per in zip(self._param_names, in_opt):
+                for j, raw in enumerate(per):
+                    _distcheck.mark_donated(
+                        raw, f"{name} (optimizer state {j})", origin,
+                        self._t)
+            for name, raw in zip(self._aux_names, in_aux):
+                _distcheck.mark_donated(raw, name, origin, self._t)
         with autograd.pause():
             for h, raw in zip(self._train_handles, new_p):
                 h._data = raw  # donated buffers: rebind directly
@@ -813,10 +859,18 @@ class ShardedTrainer:
                         "MXNET_TPU_PREEMPT_RESHARD", "1") != "0"
                 saved_mesh = (saved_topo.get("mesh") or {}).get("axes")
                 if not reshard:
+                    # name the axes precisely: a typo'd axis on the new
+                    # mesh gets a did-you-mean hint + the valid axis list
+                    # (the shared difflib helper via mesh.axis_error)
+                    axis_notes = "".join(
+                        "; saved " + self._mesh.axis_error(a)
+                        for a in sorted(saved_mesh or {})
+                        if a not in self._mesh.axis_sizes)
                     raise ValueError(
                         f"checkpoint epoch {entry['epoch']} was written on "
                         f"DeviceMesh({saved_mesh}) but this trainer runs on "
-                        f"{self._mesh!r} ({'; '.join(diffs)}) and resharding "
+                        f"{self._mesh!r} ({'; '.join(diffs)}{axis_notes}) "
+                        "and resharding "
                         "is disabled — resume on the original topology, or "
                         "allow resharding (reshard=True / unset "
                         "MXNET_TPU_PREEMPT_RESHARD=0) to re-place the "

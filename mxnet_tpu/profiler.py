@@ -23,7 +23,8 @@ import time
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
            "dumps", "reset", "Domain", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope", "record_skip_step", "record_stall"]
+           "Marker", "scope", "record_skip_step", "record_stall",
+           "record_cache"]
 
 _lock = threading.Lock()
 _RECORDING = False       # master flag: a session is active and not paused
@@ -197,6 +198,18 @@ def record_stall(point, elapsed_s, bundle):
                    args={"point": point, "elapsed_s": round(elapsed_s, 3),
                          "bundle": bundle})
     record_counter("watchdog.stalls", _stall_count)
+
+
+def record_cache(kind, hits, misses):
+    """Dispatch/compile cache-hit/miss counter tracks (fed by
+    ``analysis.distcheck.cache_event`` — per-op jit dispatch, bulk
+    fused-segment, and CachedOp signature caches). Two counter tracks per
+    cache family so hit ratio and recompile churn line up with the op
+    timeline in the trace. No-op unless a session is recording (the
+    caller checks ``_RECORDING`` first to stay off the dispatch hot
+    path)."""
+    record_counter(f"compile_cache.{kind}.hits", hits)
+    record_counter(f"compile_cache.{kind}.misses", misses)
 
 
 def record_instant(name, cat="instant", args=None):

@@ -1,0 +1,454 @@
+"""Distributed-correctness analyzer (analysis.distcheck) tests.
+
+The planted-misconfiguration matrix: every supported parallelism config
+(dp x tp, ZeRO, pipeline pp, MoE ep) passes clean, and one planted bug per
+pass — bad axis name, divergent collective order, use-after-donate,
+churning compile-cache key — is caught with a structured, node/param-named
+Issue. Plus the knob (MXNET_TPU_DISTCHECK=0) and the mesh-naming
+did-you-mean satellites.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import distcheck
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_distcheck():
+    distcheck.clear_donated()
+    distcheck.reset_cache_stats()
+    yield
+    distcheck.clear_donated()
+    distcheck.reset_cache_stats()
+
+
+def _make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(4, in_units=32))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _first_param(net):
+    return next(iter(net.collect_params()))
+
+
+def _batch(b=8):
+    rng = np.random.default_rng(0)
+    return (mx.nd.array(rng.normal(size=(b, 16)).astype(np.float32)),
+            mx.nd.array(rng.normal(size=(b, 4)).astype(np.float32)))
+
+
+# ===================================================================== #
+# clean-config matrix: every parallelism flavour passes                 #
+# ===================================================================== #
+
+def test_clean_dp_tp_trainer_steps():
+    """dp x tp with default rules: the auto-run passes and training
+    proceeds (distcheck must not break a correct config)."""
+    st = ShardedTrainer(_make_net(), gloss.L2Loss(), "sgd",
+                        {"learning_rate": 0.05},
+                        mesh=DeviceMesh({"dp": 4, "tp": 2}))
+    x, y = _batch()
+    losses = [float(st.step(x, y).asscalar()) for _ in range(3)]
+    assert np.isfinite(losses).all()
+    assert distcheck.check_trainer(st) == []  # warnings included
+
+
+def test_clean_zero_trainer():
+    """ZeRO-1: dp-sharded optimizer-state layouts verify clean."""
+    st = ShardedTrainer(_make_net(), gloss.L2Loss(), "adam",
+                        {"learning_rate": 0.01},
+                        mesh=DeviceMesh({"dp": 8}), zero=True)
+    x, y = _batch()
+    st.step(x, y)
+    assert distcheck.check_trainer(st) == []
+
+
+def test_clean_pipeline_config():
+    """GPipe pp config: stacked stage params sharded on pp verify clean."""
+    mesh = DeviceMesh({"pp": 4})
+    issues = distcheck.check_sharding(
+        rules={"stages_weight": ("pp", None, None),
+               "stages_bias": ("pp", None)},
+        shapes={"stages_weight": (4, 16, 16), "stages_bias": (4, 16)},
+        mesh=mesh)
+    assert issues == []
+
+
+def test_clean_moe_ep_config():
+    """MoE EP config: stacked expert params sharded on ep verify clean,
+    router replicated."""
+    mesh = DeviceMesh({"dp": 2, "ep": 4})
+    issues = distcheck.check_sharding(
+        rules={"experts_w": ("ep", None, None), "router_w": ()},
+        shapes={"experts_w": (4, 8, 8), "router_w": (8, 4)},
+        mesh=mesh, batch_shape=(16, 8))
+    assert issues == []
+
+
+# ===================================================================== #
+# pass 1 — sharding verifier: planted bad axis                          #
+# ===================================================================== #
+
+def test_planted_bad_axis_refused_before_compile():
+    """A rule naming a nonexistent mesh axis is refused at trainer
+    CONSTRUCTION (before placement/compile), param-named, with a
+    did-you-mean hint and the valid axis list."""
+    net = _make_net()
+    pname = _first_param(net)
+    with pytest.raises(distcheck.DistCheckError) as ei:
+        ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                       mesh=DeviceMesh({"dp": 4, "tp": 2}),
+                       rules={pname: ("tpp", None)})
+    issues = [i for i in ei.value.issues if i.code == "undefined-axis"]
+    assert issues and issues[0].node == pname
+    msg = issues[0].message
+    assert "did you mean 'tp'" in msg
+    assert "valid axes" in msg and "'dp'" in msg
+
+
+def test_planted_duplicate_axis_and_spec_rank():
+    net = _make_net()
+    pname = _first_param(net)
+    with pytest.raises(distcheck.DistCheckError) as ei:
+        ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                       mesh=DeviceMesh({"dp": 4, "tp": 2}),
+                       rules={pname: ("tp", "tp")})
+    assert any(i.code == "duplicate-axis" and i.node == pname
+               for i in ei.value.issues)
+    issues = distcheck.check_sharding(
+        rules={"w": ("tp", None, None)}, shapes={"w": (32, 16)},
+        mesh=DeviceMesh({"dp": 4, "tp": 2}))
+    assert [i.code for i in issues] == ["spec-rank"]
+    assert issues[0].node == "w"
+
+
+def test_planted_indivisible_dim():
+    issues = distcheck.check_sharding(
+        rules={"w": ("tp", None)}, shapes={"w": (33, 16)},
+        mesh=DeviceMesh({"dp": 2, "tp": 2}))
+    assert [i.code for i in issues] == ["indivisible-dim"]
+    assert "33" in issues[0].message and issues[0].node == "w"
+
+
+def test_batch_indivisible_refused_before_compile():
+    st = ShardedTrainer(_make_net(), gloss.L2Loss(), "sgd", {},
+                        mesh=DeviceMesh({"dp": 8}))
+    with pytest.raises(distcheck.DistCheckError) as ei:
+        st.step(mx.nd.ones((12, 16)), mx.nd.ones((12, 4)))
+    assert any(i.code == "batch-indivisible" for i in ei.value.issues)
+    # the step executable was never built — refused before compile
+    assert st._step_fn is None
+
+
+def test_unknown_param_rule_warns_with_suggestion():
+    net = _make_net()
+    pname = _first_param(net)
+    with pytest.warns(distcheck.DistCheckWarning, match="no known param"):
+        ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                       mesh=DeviceMesh({"dp": 8}),
+                       rules={pname + "x": ()})
+
+
+def test_replicated_large_param_warning():
+    issues = distcheck.check_sharding(
+        rules={"embed": ()}, shapes={"embed": (2048, 1024)},
+        mesh=DeviceMesh({"dp": 4, "tp": 2}), large_param_elems=1 << 20)
+    assert [i.code for i in issues] == ["replicated-large-param"]
+    assert not issues[0].is_error  # advisory, not fatal
+    # pure-dp meshes replicate by design: no warning there
+    assert distcheck.check_sharding(
+        rules={"embed": ()}, shapes={"embed": (2048, 1024)},
+        mesh=DeviceMesh({"dp": 8}), large_param_elems=1 << 20) == []
+
+
+def test_distcheck_env_opt_out(monkeypatch):
+    """MXNET_TPU_DISTCHECK=0: the planted bad axis silently replicates
+    (the documented lenient mesh.sharding behaviour) instead of raising."""
+    monkeypatch.setenv("MXNET_TPU_DISTCHECK", "0")
+    net = _make_net()
+    st = ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                        mesh=DeviceMesh({"dp": 4, "tp": 2}),
+                        rules={_first_param(net): ("tpp", None)})
+    x, y = _batch()
+    st.step(x, y)  # no distcheck error, no donation poisoning
+    assert distcheck.donated_count() == 0
+
+
+# ===================================================================== #
+# pass 2 — collective-order deadlock detector                           #
+# ===================================================================== #
+
+def test_static_collective_schedule_extraction():
+    """The dp-gradient shape (sharded in, replicated out) compiles to an
+    all-reduce; a pointwise sharded map compiles to none."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = DeviceMesh({"dp": 8})
+    av = jax.ShapeDtypeStruct((8, 4), "float32")
+    reduced = distcheck.collective_schedule(
+        lambda x: jnp.sum(x), av,
+        in_shardings=(mesh.sharding("dp"),),
+        out_shardings=mesh.replicated())
+    assert reduced and reduced[0][0] == "all-reduce"
+    pointwise = distcheck.collective_schedule(
+        lambda x: x * 2, av,
+        in_shardings=(mesh.sharding("dp"),),
+        out_shardings=mesh.sharding("dp"))
+    assert pointwise == []
+    assert distcheck.schedule_fingerprint(reduced) \
+        != distcheck.schedule_fingerprint(pointwise)
+
+
+def test_planted_divergent_schedule_names_position():
+    """Two ranks whose static schedules diverge at position 1 get a
+    collective-order error naming exactly that position."""
+    a = [("all-reduce", "f32[8,4]", "[1,8]"), ("all-gather", "f32[4]", "[1,8]")]
+    b = [("all-reduce", "f32[8,4]", "[1,8]"), ("all-reduce", "f32[4]", "[1,8]")]
+    issues = distcheck.compare_schedules({0: a, 1: b})
+    assert len(issues) == 1 and issues[0].code == "collective-order"
+    assert issues[0].node == "collective #1"
+    assert "rank 1" in issues[0].message
+    # identical schedules: clean
+    assert distcheck.compare_schedules({0: a, 1: list(a)}) == []
+
+
+def test_cross_check_schedule_raises_on_divergence():
+    """The barrier-time fingerprint cross-check: rank-divergent recorded
+    schedules raise CollectiveOrderError naming both fingerprints."""
+    r0 = distcheck.ScheduleRecorder()
+    r1 = distcheck.ScheduleRecorder()
+    r0.note("allreduce", "w0:(4, 4):float32")
+    r0.note("allreduce", "w1:(2,):float32")
+    r1.note("allreduce", "w1:(2,):float32")   # reversed push order:
+    r1.note("allreduce", "w0:(4, 4):float32")  # the classic deadlock
+    with pytest.raises(distcheck.CollectiveOrderError) as ei:
+        distcheck.cross_check_schedule(
+            r0, allgather=lambda w: [w, r1.digest_words()])
+    assert "rank 0" in str(ei.value) and "rank 1" in str(ei.value)
+    assert ei.value.tail  # recent schedule entries for the post-mortem
+    # identical schedules pass
+    distcheck.cross_check_schedule(r0, allgather=lambda w: [w, w])
+
+
+def test_kvstore_records_collective_schedule():
+    """The dist kvstore feeds the recorder: push + barrier land in the
+    schedule with their keys, and the single-worker barrier stays clean."""
+    kv = mx.kv.create("dist_sync")
+    if kv._sched is None:
+        pytest.skip("distcheck disabled in this environment")
+    v = mx.nd.ones((4, 4))
+    kv.init("w0", v)
+    kv.push("w0", v)
+    kv.barrier()
+    ops = [op for op, _ in kv._sched.tail]
+    assert "allreduce" in ops and "barrier" in ops
+    assert any("w0" in d for _, d in kv._sched.tail)
+    fp = kv._sched.fingerprint()
+    assert fp.startswith(str(kv._sched.count) + ":")
+
+
+# ===================================================================== #
+# pass 3 — donation-safety checker                                      #
+# ===================================================================== #
+
+def test_planted_use_after_donate_eager():
+    """A stale alias of a donated parameter buffer raises a param-named
+    DonatedBufferError at the eager use site."""
+    net = _make_net()
+    st = ShardedTrainer(net, gloss.L2Loss(), "sgd",
+                        {"learning_rate": 0.05}, mesh=DeviceMesh({"dp": 8}))
+    pname = _first_param(net)
+    stale = mx.nd.NDArray(net.collect_params()[pname].data()._data)
+    x, y = _batch()
+    st.step(x, y)
+    assert distcheck.donated_count() >= 1
+    with pytest.raises(distcheck.DonatedBufferError) as ei:
+        stale * 2
+    e = ei.value
+    assert e.name == pname and "use-after-donate" in str(e)
+    assert "ShardedTrainer.step" in str(e) and "step 1" in str(e)
+
+
+def test_planted_use_after_donate_in_bulk_segment():
+    """The bulking recorder flags use-after-donate at RECORD (trace)
+    time, before the stale buffer is wired into a fused segment."""
+    from mxnet_tpu import engine
+
+    net = _make_net()
+    st = ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                        mesh=DeviceMesh({"dp": 8}))
+    pname = _first_param(net)
+    stale = mx.nd.NDArray(net.collect_params()[pname].data()._data)
+    x, y = _batch()
+    st.step(x, y)
+    with engine.bulk(16):
+        with pytest.raises(distcheck.DonatedBufferError, match=pname):
+            stale + 1
+
+
+def test_poisoned_lazyref_force_raises():
+    """mark_donated poisons a pending LazyRef: forcing it raises the
+    named error instead of executing the segment."""
+    from mxnet_tpu import engine
+
+    with engine.bulk(16):
+        lazy = mx.nd.ones((2, 2)) * 3
+        distcheck.mark_donated(lazy, "lazy_param", "test harness", step=7)
+        with pytest.raises(distcheck.DonatedBufferError) as ei:
+            lazy.asnumpy()
+    assert ei.value.name == "lazy_param" and ei.value.step == 7
+
+
+def test_donation_registry_prunes_with_aliases():
+    """Dropped aliases release their registry entries (weakref-pruned):
+    poisoning never leaks across steps."""
+    net = _make_net()
+    st = ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                        mesh=DeviceMesh({"dp": 8}))
+    x, y = _batch()
+    for _ in range(4):
+        st.step(x, y)
+    import gc
+
+    gc.collect()
+    assert distcheck.donated_count() == 0  # no live aliases -> no entries
+
+
+def test_donate_false_tracks_nothing():
+    net = _make_net()
+    st = ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                        mesh=DeviceMesh({"dp": 8}), donate=False)
+    pname = _first_param(net)
+    stale = mx.nd.NDArray(net.collect_params()[pname].data()._data)
+    x, y = _batch()
+    st.step(x, y)
+    np.testing.assert_allclose(stale.asnumpy(), stale.asnumpy())
+    assert distcheck.donated_count() == 0
+
+
+# ===================================================================== #
+# pass 4 — recompile-churn detector                                     #
+# ===================================================================== #
+
+def test_planted_churning_key_flagged():
+    """A CachedOp fed a fresh shape every call compiles every call: the
+    churn detector names the site and the drifting key component."""
+    from mxnet_tpu.cached_op import CachedOp
+
+    def body(a):
+        return a * 2
+
+    co = CachedOp(body)
+    for n in range(2, 8):
+        co(mx.nd.ones((n, 3)))
+    issues = distcheck.check_churn()
+    churn = [i for i in issues
+             if i.code == "cache-churn" and "CachedOp[" in i.node
+             and "body]" in i.node]
+    assert churn, issues
+    assert "drifting key component" in churn[0].message
+    assert not churn[0].is_error  # perf hazard, not fatal
+    # ... and the same op at a STABLE shape is not flagged
+    distcheck.reset_cache_stats()
+    co2 = CachedOp(body)
+    for _ in range(8):
+        co2(mx.nd.ones((4, 3)))
+    assert not [i for i in distcheck.check_churn() if "body]" in i.node]
+
+
+def test_dispatch_cache_stats_and_counters():
+    """Registry jit-cache lookups land in cache_stats, and a recording
+    profiler session receives compile_cache counter tracks."""
+    from mxnet_tpu import profiler
+
+    distcheck.reset_cache_stats()
+    x = mx.nd.ones((3, 3))
+    profiler.set_state("run")
+    try:
+        for p in (1.5, 2.5, 3.5):  # distinct static kwargs: misses
+            mx.nd.clip(x, 0.0, p).wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    stats = distcheck.cache_stats()
+    site = [(k, v) for k, v in stats.items() if k[0] == "dispatch"]
+    assert site, stats
+    total = sum(v["hits"] + v["misses"] for _, v in site)
+    assert total >= 3
+    with profiler._lock:
+        cache_events = [e for e in profiler._events
+                        if e["name"].startswith("compile_cache.")]
+    assert cache_events
+    profiler.reset()
+
+
+def test_cache_tracking_toggle():
+    distcheck.track_caches(False)
+    try:
+        distcheck.reset_cache_stats()
+        (mx.nd.ones((2, 2)) * 7).wait_to_read()
+        assert distcheck.cache_stats() == {}
+    finally:
+        distcheck.track_caches(True)
+
+
+def test_run_entry_point_is_callable_module():
+    """analysis.distcheck(...) — the documented orchestrator surface."""
+    from mxnet_tpu import analysis
+
+    mesh = DeviceMesh({"dp": 4, "tp": 2})
+    with pytest.raises(distcheck.DistCheckError):
+        analysis.distcheck(rules={"w": ("nope",)}, shapes={"w": (4, 4)},
+                           mesh=mesh)
+    issues = analysis.distcheck(rules={"w": ("tp", None)},
+                                shapes={"w": (4, 4)}, mesh=mesh,
+                                raise_on_error=False)
+    assert issues == []
+
+
+# ===================================================================== #
+# mesh-naming satellites                                                #
+# ===================================================================== #
+
+def test_mesh_constructor_validates_axis_sizes():
+    with pytest.raises(ValueError, match="positive integer"):
+        DeviceMesh({"dp": 0})
+    with pytest.raises(ValueError, match="positive integer"):
+        DeviceMesh({"dp": 2.5})
+    with pytest.raises(ValueError, match="non-empty strings"):
+        DeviceMesh({None: 2})
+
+
+def test_mesh_axis_error_suggests():
+    mesh = DeviceMesh({"dp": 4, "tp": 2})
+    msg = mesh.axis_error("tpp")
+    assert "did you mean 'tp'" in msg
+    assert "valid axes: ['dp', 'tp']" in msg
+
+
+def test_resume_reshard_disabled_error_lists_axes(tmp_path):
+    """The preempt reshard path: a reshard-disabled topology mismatch
+    names the missing axis with a did-you-mean hint + the valid axes."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), prefix="dc", keep=2)
+    net = _make_net()
+    st = ShardedTrainer(net, gloss.L2Loss(), "sgd", {},
+                        mesh=DeviceMesh({"dp": 4, "tp": 2}))
+    x, y = _batch()
+    st.step(x, y)
+    st.save_checkpoint(mgr, 1)
+    net2 = _make_net()
+    st2 = ShardedTrainer(net2, gloss.L2Loss(), "sgd", {},
+                         mesh=DeviceMesh({"dp": 8}))
+    with pytest.raises(ValueError) as ei:
+        st2.resume(mgr, reshard=False)
+    msg = str(ei.value)
+    assert "saved axis 'tp' is not an axis of this mesh" in msg
+    assert "valid axes: ['dp']" in msg

@@ -120,12 +120,27 @@ def test_mxlint_catches_planted_violations(tmp_path):
         "    return v\n"
         "@register('badop')\n"
         "def badop(data):\n"                             # no-schema-doc
-        "    return data\n")
+        "    return data\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "spec = P('dpp', None)\n")                       # partition-spec-literal
     findings = mxlint.run([str(bad)], root=str(tmp_path))
     rules = {f.rule for f in findings}
     assert rules == {"unused-import", "raw-jax-compat", "mutable-default",
                      "host-sync", "bare-except", "unseeded-random",
-                     "no-schema-doc"}
+                     "no-schema-doc", "partition-spec-literal"}
+    psl = [f for f in findings if f.rule == "partition-spec-literal"]
+    assert "did you mean" in psl[0].message  # difflib near-miss hint
+    # the canonical vocabulary, and parallel/ itself, stay clean
+    good_spec = tmp_path / "good_spec.py"
+    good_spec.write_text("from jax.sharding import PartitionSpec as P\n"
+                         "spec = P('dp', ('tp', 'sp'))\n")
+    assert mxlint.run([str(good_spec)], root=str(tmp_path)) == []
+    par = tmp_path / "mxnet_tpu" / "parallel"
+    par.mkdir(parents=True)
+    exempt = par / "exempt.py"
+    exempt.write_text("from jax.sharding import PartitionSpec as P\n"
+                      "spec = P('stage')\n")
+    assert mxlint.run([str(exempt)], root=str(tmp_path)) == []
     # noqa suppression works, per-rule
     ok = tmp_path / "ok.py"
     ok.write_text("v = x.asnumpy()  # noqa: host-sync\n")

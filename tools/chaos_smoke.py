@@ -16,6 +16,9 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     recorded, exit code 75 reserved), then a fresh trainer on a
     DIFFERENT simulated device count reshards the checkpoint on load
     and finishes cleanly,
+  * a MISCONFIGURED mesh (sharding rule naming an axis the mesh does not
+    have) refused by the distcheck analyzer BEFORE anything compiles,
+    with a param-named did-you-mean diagnostic,
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -217,6 +220,33 @@ def main(argv=None):
         trainer3.step(x, y)
     trainer3.save_checkpoint(manager, entry3["epoch"] + 1)
     net2 = net3  # the integrity pass below checks the resumed net
+
+    # phase 5: distributed-correctness pre-check — a sharding rule naming
+    # a nonexistent mesh axis must be REFUSED before anything compiles
+    # (analysis.distcheck pass 1), param-named with a did-you-mean hint
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import distcheck
+    from mxnet_tpu import gluon
+
+    bad_net = gluon.nn.Dense(16, in_units=8)
+    bad_net.initialize(mx.init.Xavier())
+    bad_net(batch_for(1, 0, args.seed)[0])
+    pname = next(iter(bad_net.collect_params()))
+    try:
+        from mxnet_tpu.parallel import ShardedTrainer as _ST
+
+        _ST(bad_net, gluon.loss.L2Loss(), "sgd", {},
+            mesh=DeviceMesh({"dp": max(1, n // 2)}),
+            rules={pname: ("dpp",)})
+        print("FAIL: misconfigured mesh rule was not refused by distcheck")
+        return 1
+    except distcheck.DistCheckError as e:
+        bad = [i for i in e.issues if i.code == "undefined-axis"]
+        if not bad or pname not in bad[0].node or \
+                "did you mean" not in bad[0].message:
+            print(f"FAIL: distcheck refusal lacks a named diagnostic: {e}")
+            return 1
+        print(f"  distcheck refused the bad mesh config: {bad[0]}")
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

@@ -88,18 +88,56 @@ def check_analysis():
     print("---------Analysis Knobs--------")
     verify = os.environ.get("MXNET_TPU_VERIFY", "<unset>")
     sanitize = os.environ.get("MXNET_TPU_SANITIZE", "<unset>")
+    distcheck = os.environ.get("MXNET_TPU_DISTCHECK", "<unset>")
     print(f"MXNET_TPU_VERIFY={verify}  "
           "(graph verifier inside simple_bind; on unless 0)")
     print(f"MXNET_TPU_SANITIZE={sanitize}  "
           "(sync-hazard sanitizer; off unless 1)")
+    print(f"MXNET_TPU_DISTCHECK={distcheck}  "
+          "(distributed-correctness analyzer: ShardedTrainer auto-check, "
+          "donation poisoning, compile-cache tracking; on unless 0)")
     try:
+        from mxnet_tpu.analysis import distcheck as _dc
         from mxnet_tpu.analysis import sanitize as _san
         from mxnet_tpu.analysis.verify import verify_enabled
 
-        print("effective     : verify=%s sanitize=%s"
-              % (verify_enabled(), _san.ACTIVE))
+        print("effective     : verify=%s sanitize=%s distcheck=%s"
+              % (verify_enabled(), _san.ACTIVE, _dc.enabled()))
     except ImportError as e:
         print("analysis import failed:", e)
+
+
+def check_compile_cache():
+    """Dispatch/compile cache statistics (analysis.distcheck pass 4) —
+    the per-site hit/miss/distinct-key report behind the recompile-churn
+    detector, and the measurement seam for the unified compile service
+    (ROADMAP item 5). Empty outside a training process; run this in-process
+    (``from tools.diagnose import check_compile_cache``) for live stats."""
+    print("--------Compile Cache----------")
+    try:
+        from mxnet_tpu.analysis import distcheck as _dc
+
+        stats = _dc.cache_stats()
+        if not stats:
+            print("no cache activity recorded "
+                  "(tracking %s; MXNET_TPU_DISTCHECK=0 disables)"
+                  % ("on" if _dc.CACHE_TRACK else "off"))
+        else:
+            print(f"{'site':<44s} {'hits':>8s} {'misses':>8s} "
+                  f"{'distinct':>9s}")
+            for (kind, site), rec in stats.items():
+                label = f"{kind}:{site}"[:44]
+                print(f"{label:<44s} {rec['hits']:>8d} "
+                      f"{rec['misses']:>8d} {rec['distinct_keys']:>9d}")
+        churn = _dc.check_churn()
+        if churn:
+            print("churn findings:")
+            for i in churn:
+                print(" ", i)
+        else:
+            print("churn findings: none")
+    except ImportError as e:
+        print("distcheck import failed:", e)
 
 
 def check_watchdog():
@@ -176,6 +214,7 @@ def main():
     check_hardware()
     check_environment()
     check_analysis()
+    check_compile_cache()
     check_watchdog()
     check_preempt()
 

@@ -29,6 +29,15 @@ unbounded-sync    a bare ``.join()`` / ``.block_until_ready()`` in library
                   behind it stalls the process forever with no crash
                   bundle. ``watchdog.py`` itself is exempt (it IS the
                   wrapper home).
+partition-spec-literal
+                  a hand-written PartitionSpec (or ``mesh.sharding(...)``)
+                  axis string outside ``parallel/`` that is not in the
+                  canonical mesh-axis vocabulary (dp/pp/tp/sp/ep —
+                  ``parallel/mesh.py AXIS_ORDER``): an off-vocabulary
+                  axis silently replicates on every standard mesh, the
+                  exact bug class the distcheck sharding verifier exists
+                  for. Keep axis names in the vocabulary (or route
+                  through ``parallel/``).
 
 Baseline workflow
 -----------------
@@ -61,9 +70,12 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 RULES = ("bare-except", "host-sync", "raw-jax-compat", "unseeded-random",
          "no-schema-doc", "unused-import", "mutable-default",
-         "unbounded-sync")
+         "unbounded-sync", "partition-spec-literal")
 
 _SYNC_METHODS = {"asnumpy", "asscalar"}
+# canonical mesh-axis vocabulary — keep in sync with
+# mxnet_tpu/parallel/mesh.py AXIS_ORDER
+_MESH_AXES = {"dp", "pp", "tp", "sp", "ep"}
 _COMPAT_NAMES = {"shard_map", "enable_x64", "pcast"}
 _NP_RANDOM_FNS = {
     "rand", "randn", "randint", "random", "random_sample", "ranf", "sample",
@@ -111,6 +123,9 @@ class _Linter(ast.NodeVisitor):
         self.is_init = os.path.basename(path) == "__init__.py"
         self.is_compat = os.path.basename(path) == "_jax_compat.py"
         self.is_watchdog = os.path.basename(path) == "watchdog.py"
+        # parallel/ is the home of the sharding vocabulary itself
+        self.is_parallel = "/parallel/" in rel.replace(os.sep, "/")
+        self.pspec_aliases = set()  # local names bound to PartitionSpec
         # module-level import bookkeeping for unused-import
         self.imports = {}   # local name -> (lineno, col, "import x" repr)
         self.used = set()
@@ -159,7 +174,39 @@ class _Linter(ast.NodeVisitor):
             chain = _dotted(func)
             if chain is not None:
                 self._check_np_random(node, chain)
+        self._check_partition_spec(node)
         self.generic_visit(node)
+
+    def _check_partition_spec(self, node):
+        if self.is_parallel:
+            return
+        func = node.func
+        chain = _dotted(func) or ""
+        is_spec_site = (
+            (isinstance(func, ast.Name) and func.id in self.pspec_aliases)
+            or chain.endswith(".PartitionSpec")
+            or (isinstance(func, ast.Attribute) and func.attr == "sharding"))
+        if not is_spec_site:
+            return
+        for arg in node.args:
+            elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) \
+                else [arg]
+            for elt in elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str) \
+                        and elt.value not in _MESH_AXES:
+                    import difflib
+
+                    close = difflib.get_close_matches(
+                        elt.value, sorted(_MESH_AXES), n=1)
+                    hint = f" (did you mean {close[0]!r}?)" if close else ""
+                    self.add(elt, "partition-spec-literal",
+                             f"PartitionSpec axis {elt.value!r} is not in "
+                             "the canonical mesh-axis vocabulary "
+                             f"{sorted(_MESH_AXES)}{hint}; off-vocabulary "
+                             "axes silently replicate on standard meshes "
+                             "— use a canonical axis or keep the spec in "
+                             "parallel/")
 
     def _check_np_random(self, node, chain):
         parts = chain.split(".")
@@ -200,6 +247,10 @@ class _Linter(ast.NodeVisitor):
         mod = node.module or ""
         if mod == "__future__":
             return
+        if mod == "jax.sharding":
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    self.pspec_aliases.add(a.asname or a.name)
         if not self.is_compat and mod.split(".")[0] == "jax":
             for a in node.names:
                 if a.name in _COMPAT_NAMES:
