@@ -13,10 +13,22 @@ Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline", ...}:
      executable via parallel.ShardedTrainer, incl. BN stat writeback;
      extra fields: achieved_tflops + mfu vs BENCH_PEAK_TFLOPS, default 459
      = v5p bf16 peak)
+Every line also carries compile-service telemetry (mxnet_tpu.compile):
+``compile_ms`` (time spent compiling this process), ``cache_hits`` /
+``cache_misses`` and ``cache_disk_hits`` — with ``MXNET_TPU_CACHE_DIR``
+set, a warm start shows ``compile_ms`` collapsing toward the disk-load
+time while ``cache_disk_hits`` absorbs the misses (the cold-vs-warm
+comparison the subprocess test in tests/test_compile.py asserts).
+
+``--train`` adds a third line: a small-model CPU training step-time
+metric (``*_train_cpu`` in ms/step), so BENCH_r06+ records a training
+number even when the TPU tunnel is down.
+
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
 BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
 auto-detected from the chip generation — v5e 197, v5p 459, v4 275, ...;
-an on-chip measured peak is also reported as measured_peak_tflops).
+an on-chip measured peak is also reported as measured_peak_tflops);
+BENCH_TRAIN_CPU_BATCH/_ITERS size the --train smoke.
 """
 import json
 import os
@@ -30,10 +42,40 @@ _FWD_GFLOPS = {"resnet50_v1": 4.09, "resnet50_v2": 4.09,
                "resnet152_v1": 11.5, "vgg16": 15.5, "alexnet": 0.71}
 
 
-def main():
+def _compile_fields(line):
+    """Fold the compile-service totals into one emitted JSON line: how
+    much of this process went to compiling vs cache hits (disk hits =
+    the persistent-cache warm-start win)."""
+    from mxnet_tpu import compile as _compile
+
+    t = _compile.totals()
+    line["compile_ms"] = t["compile_ms"]
+    line["cache_hits"] = t["hits"]
+    line["cache_misses"] = t["misses"]
+    line["cache_disk_hits"] = t["disk_hits"]
+    return line
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench",
+                                 description="headline benchmarks")
+    ap.add_argument("--train", action="store_true",
+                    help="also emit the small-model CPU training "
+                         "step-time metric (runs on any host)")
+    ap.add_argument("--train-only", action="store_true",
+                    help="emit ONLY the CPU training metric (skip the "
+                         "ResNet benches)")
+    args = ap.parse_args(argv)
+
     import mxnet_tpu as mx
     from mxnet_tpu.base import probe_backend_or_fallback
     from mxnet_tpu.gluon.model_zoo import vision
+
+    if args.train_only:
+        bench_train_cpu()
+        return
 
     # a downed TPU tunnel hangs the first backend touch forever; probe
     # (subprocess, 90s deadline) unless the platform is already pinned.
@@ -94,7 +136,7 @@ def main():
         achieved = throughput * fwd_flops / 1e12
         line["achieved_tflops"] = round(achieved, 1)
         line["mfu"] = round(achieved / _peak_tflops(), 3)
-    print(json.dumps(line), flush=True)
+    print(json.dumps(_compile_fields(line)), flush=True)
 
     if not skip_train:
         # training compiles a bigger program; cap its timed loop so the
@@ -102,6 +144,8 @@ def main():
         train_iters = int(os.environ.get("BENCH_TRAIN_ITERS",
                                          min(iters, 10)))
         bench_train(ctx, batch, dtype, train_iters, model)
+    if args.train:
+        bench_train_cpu()
 
 
 def bench_train(ctx, batch, dtype, iters, model):
@@ -156,7 +200,56 @@ def bench_train(ctx, batch, dtype, iters, model):
         if measured:
             line["measured_peak_tflops"] = round(measured, 1)
             line["mfu_vs_measured"] = round(achieved / measured, 3)
-    print(json.dumps(line), flush=True)
+    print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_train_cpu():
+    """CPU training step-time smoke: a small conv net through the SAME
+    fused ShardedTrainer step as the chip bench, sized to finish in
+    seconds — the training number BENCH_r06+ records when the TPU tunnel
+    is down. Emits ms/step (lower is better) plus img/s and the compile
+    telemetry; with MXNET_TPU_CACHE_DIR set, warm reruns show the
+    persistent cache collapsing compile_ms."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss, nn
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    batch = int(os.environ.get("BENCH_TRAIN_CPU_BATCH", 32))
+    iters = int(os.environ.get("BENCH_TRAIN_CPU_ITERS", 20))
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(32, 3, padding=1, activation="relu"),
+            nn.GlobalAvgPool2D(),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(batch, 3, 32, 32))
+    y = mx.nd.array(np.random.RandomState(0).randint(
+        0, 10, batch).astype(np.float32))
+    net(x)  # materialize deferred shapes
+    trainer = ShardedTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9},
+        mesh=DeviceMesh({"dp": 1}), nan_guard=False)
+    t0 = time.perf_counter()
+    trainer.step(x, y).wait_to_read()  # compile
+    compile_s = time.perf_counter() - t0
+    trainer.step(x, y).wait_to_read()  # warm
+    start = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    elapsed = time.perf_counter() - start
+    line = {
+        "metric": f"smallconv_train_bs{batch}_float32_cpu",
+        "value": round(elapsed / iters * 1e3, 3),
+        "unit": "ms/step",
+        "img_per_s": round(batch * iters / elapsed, 2),
+        "first_step_s": round(compile_s, 3),
+        "platform": "cpu",
+    }
+    print(json.dumps(_compile_fields(line)), flush=True)
 
 
 def _peak_tflops():

@@ -39,6 +39,7 @@ import threading
 import weakref
 
 from . import autograd
+from . import compile as _compile
 from . import profiler as _profiler
 from .analysis import distcheck as _distcheck
 from .analysis import sanitize as _sanitize
@@ -167,8 +168,6 @@ class BulkSegment:
         if not live:
             self._retire()
             return
-        import jax
-
         from . import faults as _faults
         from . import watchdog as _watchdog
 
@@ -183,8 +182,13 @@ class BulkSegment:
             _distcheck.cache_event("bulk", "BulkSegment", plan_key,
                                    fused is not None)
         if fused is None:
-            fused = _FUSED_CACHE[plan_key] = jax.jit(
-                _build_fused(self.steps, live_t))
+            # compiled through the unified service (mxnet_tpu.compile):
+            # the plan (op names + frozen kwargs + wiring) is the
+            # process-stable token, so identical segments hit the
+            # persistent cache across runs
+            fused = _FUSED_CACHE[plan_key] = _compile.jit(
+                _build_fused(self.steps, live_t), site="bulk",
+                token=("bulk", plan_key))
 
         def _execute():
             # 'engine.flush' injection point: an injected failure behaves
@@ -233,7 +237,8 @@ class BulkSegment:
                 _, pull = jax.vjp(_fn, *ext)
                 return pull(tuple(cots))
 
-            vjp_exec = _VJP_CACHE[vkey] = jax.jit(_vjp_run)
+            vjp_exec = _VJP_CACHE[vkey] = _compile.jit(
+                _vjp_run, site="bulk", token=("bulk-vjp", vkey))
         ext_t = tuple(self.ext_raws)
 
         def vjp_fn(cots, _exec=vjp_exec, _ext=ext_t):

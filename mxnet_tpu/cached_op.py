@@ -141,6 +141,26 @@ class CachedOp:
         # shape drift shows up as distinct keys at this site
         self._site = "CachedOp[%s]" % getattr(
             forward_fn, "__qualname__", type(forward_fn).__name__)
+        # process-stable identity for the unified compile service's
+        # persistent cache: source hash of the forward + repr of the
+        # bound instance (a gluon block's repr encodes its layer
+        # structure and hyper-params, which the traced computation bakes
+        # in but input/param shapes alone cannot distinguish)
+        import hashlib
+
+        ident = []
+        try:
+            import inspect
+
+            ident.append(inspect.getsource(
+                getattr(forward_fn, "__func__", forward_fn)))
+        except (OSError, TypeError):
+            pass
+        inst = getattr(forward_fn, "__self__", None)
+        if inst is not None:
+            ident.append(repr(inst))
+        self._token_src = hashlib.sha1(
+            "\n".join(ident).encode()).hexdigest()[:12] if ident else "nosrc"
 
     # -------------------------------------------------------------- call ---
     def __call__(self, *args):
@@ -259,10 +279,15 @@ class CachedOp:
                 n_outs_box.append(len(outs))
             return tuple(outs) + tuple(states)
 
-        fwd_jit = jax.jit(pure)
+        from . import compile as _compile
+
+        token = ("cachedop", self._site, self._token_src, key)
+        fwd_jit = _compile.jit(pure, site="cachedop",
+                               token=token + ("fwd",))
         # abstract trace now so the metadata boxes fill; compilation happens
-        # on first real call. NOT lower().compile(): that would pin devices,
-        # breaking reset_ctx — plain jit recompiles per arg placement.
+        # on first real call. The service keys executables on argument
+        # placement/sharding, so reset_ctx still recompiles per placement
+        # (the reason this was never lower().compile() before the seam).
         in_shapes = [jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
                      for a in arrays]
         p_shapes = [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
@@ -288,7 +313,8 @@ class CachedOp:
                               in_raws, param_raws)
             return pull(tuple(cots))
 
-        bwd_jit = jax.jit(bwd)
+        bwd_jit = _compile.jit(bwd, site="cachedop",
+                               token=token + ("bwd",))
         return fwd_jit, bwd_jit, state_handles, n_outs, out_spec
 
 

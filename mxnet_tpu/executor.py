@@ -86,6 +86,7 @@ class Executor:
                     self._grad_dict[name] = self._grad_dict[name].astype(
                         src.dtype)
         self._run = symbol._build_eval()
+        self._graph_token = None  # symbol-graph hash, computed lazily
         self._warned_uneven = False
         self._warned_argdict = False
         self._fed_names = set()  # args ever fed via forward kwargs (sticky)
@@ -104,10 +105,27 @@ class Executor:
         return out
 
     # ------------------------------------------------------------ compile --
+    def _token(self):
+        """Process-stable graph identity for the compile service: hash of
+        the symbol's serialized graph (computed once per executor — bind
+        time already walked the whole graph, one tojson at first compile
+        is noise next to the XLA compile it keys)."""
+        if self._graph_token is None:
+            import hashlib
+
+            try:
+                blob = self._symbol.tojson()
+            except Exception:
+                blob = repr((self.arg_names, self.output_names))
+            self._graph_token = hashlib.sha1(
+                blob.encode()).hexdigest()[:16]
+        return self._graph_token
+
     def _exe(self, kind, sig, training):
         import jax
 
         from . import _amp_core
+        from . import compile as _compile
 
         if _amp_core.cache_stale(self):
             self._jit.clear()
@@ -133,20 +151,24 @@ class Executor:
                                               has_aux=True)
                 return outs, new_aux, pull
 
-            fn = jax.jit(fwd_train)
+            fn = _compile.jit(fwd_train, site="executor",
+                              token=("executor", self._token(), key,
+                                     diff_names))
             fn.diff_names = diff_names
         elif kind == "fwd":
             def fwd(args, auxs, rng):
                 outs, new_aux = run(args, auxs, rng, training)
                 return tuple(outs), new_aux
 
-            fn = jax.jit(fwd)
+            fn = _compile.jit(fwd, site="executor",
+                              token=("executor", self._token(), key))
             fn.diff_names = ()
         else:  # kind == "pull": apply a stored pullback to cotangents
             def apply_pull(pull, cots):
                 return pull(tuple(cots))[0]
 
-            fn = jax.jit(apply_pull)
+            fn = _compile.jit(apply_pull, site="executor",
+                              token=("executor-pull", self._token(), key))
         self._jit[key] = fn
         return fn
 

@@ -13,6 +13,10 @@ through the stack:
                        engine's deferred-exception contract
     ``trainer.step``   the compiled train step (parallel/sharded_trainer.py)
     ``ckpt.write``     checkpoint file writes (checkpoint.py)
+    ``compile.load``   persistent compile-cache reads (compile.py) — the
+                       entry bytes are the payload, so ``corrupt`` mode
+                       exercises the CRC-mismatch recompile fallback
+    ``compile.write``  persistent compile-cache writes (compile.py)
 
 Faults are configured programmatically (:func:`configure`) or through the
 ``MXNET_TPU_FAULTS`` environment variable — read once, at first use, so

@@ -162,8 +162,6 @@ class Operator:
         """A jitted executable for these static kwargs (cached). `_key`
         is an optional precomputed `_freeze(kwargs)` (from `checked`);
         None means the kwargs are unhashable."""
-        import jax
-
         if self.eager:
             # data-dependent output shape (nonzero/unique/...): run the
             # emitter directly on concrete arrays, never under jit
@@ -190,7 +188,14 @@ class Operator:
                                    hit is not None)
         if hit is not None:
             return hit
-        jitted = jax.jit(self.partial(kwargs, key))
+        # the unified compile service (mxnet_tpu.compile): per-op hit/miss
+        # + compile-ms metrics, persistent disk cache, AOT warmup — the
+        # token (op name + frozen kwargs) is process-stable so warm starts
+        # find prior executables
+        from .. import compile as _compile
+
+        jitted = _compile.jit(self.partial(kwargs, key), site="dispatch",
+                              token=("op", self.name, key))
         self._jit_cache[key] = jitted
         return jitted
 

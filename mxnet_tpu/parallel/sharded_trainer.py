@@ -329,6 +329,49 @@ class ShardedTrainer:
         return tuple(out)
 
     # ------------------------------------------------------------- build ---
+    def _service_token(self, kind):
+        """Process-stable identity of the compiled step for the unified
+        compile service (mxnet_tpu.compile): everything the trace BAKES
+        into the executable that the aval signature cannot see — network
+        structure (gluon repr), loss, optimizer rule + scalar hypers, wd
+        schedule, sharding rules and the memory/robustness levers."""
+        import hashlib
+
+        hypers = tuple(sorted(
+            (k, v) for k, v in vars(self._opt).items()
+            if isinstance(v, (int, float, bool, str, type(None)))))
+        blob = "\n".join([
+            repr(self._net), repr(self._loss_fn), self._opt_name,
+            repr(hypers), repr(self._wd), repr(self._wd_mult),
+            repr(tuple(self._param_names)), repr(tuple(self._aux_names)),
+            repr(sorted(self._rules.items())),
+            repr(self._mesh.describe()),
+            repr((self._donate, self._zero, self._remat, self._accum,
+                  self._nan_guard))])
+        return ("trainer", kind,
+                hashlib.sha1(blob.encode()).hexdigest()[:16])
+
+    def warmup(self, x, y):
+        """AOT warmup: build + compile the step executable for batches
+        shaped like ``x``/``y`` (NDArray, jax array, or
+        ``jax.ShapeDtypeStruct``) WITHOUT running a step — the pod
+        cold-start hook. Registering the step with the compile service
+        also replays any pending warmup-manifest entries recorded by a
+        previous run, so every previously-seen batch signature compiles
+        (or disk-loads) here rather than at first traffic."""
+        x_raw = x._data if isinstance(x, NDArray) else x
+        y_raw = y._data if isinstance(y, NDArray) else y
+        if self._step_fn is None:
+            if self._distcheck:
+                # same pre-compile sharding surface check step() runs
+                from ..analysis import distcheck as _dc
+
+                _dc.check_trainer(self, x_raw, y_raw)
+            self._step_fn = self._build(x_raw, y_raw)
+        from .. import compile as _compile
+
+        return _compile.warmup()
+
     def _build(self, x_raw, y_raw):
         import jax
         import jax.numpy as jnp
@@ -482,8 +525,10 @@ class ShardedTrainer:
             else self._mesh.replicated()
         rep = self._mesh.replicated()
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(
-            step_fn,
+        from .. import compile as _compile
+
+        return _compile.jit(
+            step_fn, site="trainer", token=self._service_token("step"),
             in_shardings=(p_sh, opt_sh, aux_sh, x_sh, y_sh, rep, rep,
                           rep),
             out_shardings=(p_sh, opt_sh, aux_sh, rep, rep),
@@ -638,8 +683,12 @@ class ShardedTrainer:
             aux_sh = (self._mesh.replicated(),) * len(aux_handles)
             x_sh = self._mesh.sharding(
                 *(("dp",) + (None,) * (len(x_raw.shape) - 1)))
-            self._predict_fn = jax.jit(
-                fwd, in_shardings=(p_sh, aux_sh, x_sh),
+            from .. import compile as _compile
+
+            self._predict_fn = _compile.jit(
+                fwd, site="trainer",
+                token=self._service_token("predict"),
+                in_shardings=(p_sh, aux_sh, x_sh),
                 out_shardings=self._mesh.replicated())
         out = self._predict_fn(
             tuple(h._data for h in self._train_handles),

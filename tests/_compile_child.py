@@ -1,0 +1,83 @@
+"""Subprocess workload for the compile-service persistence tests.
+
+Runs a representative mixed workload — eager dispatch, a bulked segment,
+a hybridized (CachedOp) forward, a symbol executor forward, and two
+ShardedTrainer steps — with whatever MXNET_TPU_CACHE_DIR the parent set,
+then prints ONE json line of compile-service totals + per-site stats.
+
+The parent runs it twice against the same cache dir: the first (cold) run
+compiles everything; the second (warm) run must satisfy every miss from
+the persistent cache — zero XLA recompiles of previously-seen signatures.
+
+Determinism contract: shapes, dtypes, op sequence and net structure are
+fixed so both runs produce identical service tokens + signatures.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("MXNET_TEST_DEVICE", "cpu").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile as C
+from mxnet_tpu.gluon import loss as gloss, nn
+from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+
+def main():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    # --- eager dispatch (registry site): fixed op/kwarg/shape sequence
+    a = mx.nd.array(rng.rand(4, 4).astype(np.float32))
+    b = mx.nd.array(rng.rand(4, 4).astype(np.float32))
+    (mx.nd.dot(a, b) + 1.0).wait_to_read()
+    mx.nd.softmax(a).wait_to_read()
+
+    # --- bulked segment (bulk site)
+    with mx.engine.bulk(8):
+        z = (a * 2.0 + b).sum()
+        z.wait_to_read()
+
+    # --- CachedOp (hybridize site)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(rng.rand(8, 8).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 4, 8).astype(np.float32))
+    net(x)  # deferred init (eager)
+    net.hybridize()
+    net(x).wait_to_read()
+
+    # --- symbol executor site
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, no_bias=True,
+                                name="fc")
+    exe = out.simple_bind(mx.cpu(), data=(8, 8))
+    exe.forward(data=x)
+
+    # --- ShardedTrainer site, 2 steps = 1 signature. donate=False so the
+    # step executable is serializable: donating executables dispatch
+    # through jit's C++ path only (see compile.ServiceFunction) and warm
+    # through the native XLA cache instead of executable deserialization
+    trainer = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                             {"learning_rate": 0.1},
+                             mesh=DeviceMesh({"dp": 1}), donate=False)
+    trainer.step(x, y).wait_to_read()
+    trainer.step(x, y).wait_to_read()
+
+    report = {"totals": C.totals(), "stats": C.stats(),
+              "disk": C.disk_report(),
+              "manifest_entries": len(C.manifest())}
+    print("CHILD_REPORT " + json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

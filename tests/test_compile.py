@@ -1,0 +1,473 @@
+"""Unified compile service (mxnet_tpu/compile.py): canonical keys,
+two-level (memory + persistent disk) caching, AOT warmup manifests,
+per-site metrics agreement with distcheck, corruption/fingerprint
+fallback, and the eager-dispatch perf guard."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile as C
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_compile_child.py")
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    """Point the service at a fresh disk cache; restore memory-only mode
+    (the suite default) afterwards."""
+    d = str(tmp_path / "cache")
+    monkeypatch.setenv("MXNET_TPU_CACHE_DIR", d)
+    C.configure(cache_dir=d)
+    yield d
+    C.configure(cache_dir=None)
+
+
+def _jnp_ones(shape):
+    import jax.numpy as jnp
+
+    return jnp.ones(shape, jnp.float32)
+
+
+# ------------------------------------------------------------- in-memory ---
+
+def test_service_hit_miss_accounting():
+    C.reset_stats()
+    fn = C.jit(lambda x: x * 2 + 1, site="svc-test", token=("acct", 1))
+    x = _jnp_ones((4, 4))
+    for _ in range(5):
+        fn(x).block_until_ready()  # noqa: unbounded-sync — test code
+    st = C.stats()["svc-test"]
+    assert st["misses"] == 1 and st["compiles"] == 1
+    assert st["hits"] == 4
+    assert st["compile_ms"] > 0
+    # a new signature (shape change) is a fresh miss, not a hit
+    fn(_jnp_ones((2, 2))).block_until_ready()  # noqa: unbounded-sync
+    st = C.stats()["svc-test"]
+    assert st["misses"] == 2 and st["hits"] == 4
+
+
+def test_signature_distinguishes_dtype_and_structure():
+    import jax.numpy as jnp
+
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        return x + 1
+
+    fn = C.jit(f, site="svc-test", token=("sig", 2))
+    fn(jnp.ones((3,), jnp.float32))
+    fn(jnp.ones((3,), jnp.float32))
+    assert len(calls) == 1  # same sig -> no retrace
+    fn(jnp.ones((3,), jnp.int32))
+    assert len(calls) == 2  # dtype flip -> new executable
+
+
+def test_disabled_service_falls_through(monkeypatch):
+    prev = C.set_enabled(False)
+    try:
+        fn = C.jit(lambda x: x - 1, site="svc-test", token=("off", 1))
+        # env-disabled construction returns the raw jit object
+        assert not isinstance(fn, C.ServiceFunction)
+        out = fn(_jnp_ones((2,)))
+        assert float(out.sum()) == 0.0
+    finally:
+        C.set_enabled(prev)
+    # runtime toggle on an existing ServiceFunction bypasses accounting
+    fn2 = C.jit(lambda x: x + 3, site="svc-toggle", token=("off", 2))
+    C.reset_stats()
+    prev = C.set_enabled(False)
+    try:
+        fn2(_jnp_ones((2,)))
+    finally:
+        C.set_enabled(prev)
+    assert "svc-toggle" not in C.stats()
+
+
+# ------------------------------------------------------------ disk layer ---
+
+def test_disk_cache_roundtrip_in_process(cache_dir):
+    C.reset_stats()
+    fn = C.jit(lambda x: x * 5, site="svc-disk", token=("disk", 1))
+    x = _jnp_ones((8,))
+    assert float(fn(x)[0]) == 5.0
+    st = C.stats()["svc-disk"]
+    assert st["compiles"] == 1 and st["disk_hits"] == 0
+    rep = C.disk_report()
+    assert rep["dir"] == cache_dir and rep["entries"] >= 1
+    # drop the in-memory map: the same signature must now come from disk
+    C.clear_memory()
+    assert float(fn(x)[0]) == 5.0
+    st = C.stats()["svc-disk"]
+    assert st["disk_hits"] == 1 and st["compiles"] == 1
+    assert st["load_ms"] > 0
+
+
+def test_disk_entries_are_crc_manifested(cache_dir):
+    fn = C.jit(lambda x: x + 7, site="svc-disk", token=("crc", 1))
+    fn(_jnp_ones((4,)))
+    d = os.path.join(cache_dir, "exec", C.fingerprint())
+    bins = [n for n in os.listdir(d) if n.endswith(".bin")]
+    assert bins
+    for b in bins:
+        with open(os.path.join(d, b[:-4] + ".json")) as f:
+            meta = json.load(f)
+        assert meta["size"] == os.path.getsize(os.path.join(d, b))
+        assert meta["fingerprint"] == C.fingerprint()
+        assert "crc32" in meta and "site" in meta
+
+
+def test_corrupt_entry_falls_back_to_recompile(cache_dir):
+    """faults.py corrupt mode on the compile.load payload: CRC mismatch
+    must silently recompile, never load a flipped executable."""
+    from mxnet_tpu import faults
+
+    C.reset_stats()
+    fn = C.jit(lambda x: x * 11, site="svc-corrupt", token=("cor", 1))
+    x = _jnp_ones((4,))
+    fn(x)
+    C.clear_memory()
+    faults.configure({"compile.load": "corrupt@*"})
+    try:
+        out = fn(x)  # corrupted read -> CRC fallback -> recompile
+    finally:
+        faults.reset()
+    assert float(out[0]) == 11.0
+    st = C.stats()["svc-corrupt"]
+    assert st["corrupt"] >= 1
+    assert st["compiles"] == 2 and st["disk_hits"] == 0
+
+
+def test_truncated_entry_falls_back_and_gc_prunes(cache_dir):
+    C.reset_stats()
+    fn = C.jit(lambda x: x - 3, site="svc-trunc", token=("tr", 1))
+    x = _jnp_ones((4,))
+    fn(x)
+    d = os.path.join(cache_dir, "exec", C.fingerprint())
+    target = None
+    for n in os.listdir(d):
+        if n.endswith(".bin"):
+            with open(os.path.join(d, n[:-4] + ".json")) as f:
+                if json.load(f)["site"] == "svc-trunc":
+                    target = os.path.join(d, n)
+    assert target is not None
+    with open(target, "r+b") as f:
+        f.truncate(10)  # torn write
+    C.clear_memory()
+    out = fn(x)
+    assert float(out[0]) == -2.0
+    st = C.stats()["svc-trunc"]
+    assert st["corrupt"] >= 1 and st["compiles"] == 2
+    # gc removes exactly the corrupt pair (the recompile overwrote the
+    # entry, so re-corrupt first to observe the prune)
+    with open(target, "r+b") as f:
+        f.truncate(10)
+    out = C.gc_cache()
+    assert out["removed_corrupt"] >= 1
+
+
+def test_fingerprint_invalidation_and_gc(cache_dir, monkeypatch):
+    """A jax-version/backend change (simulated via the salt knob) makes
+    old entries invisible — recompile, never cross-fingerprint load —
+    and gc prunes the stale fingerprint wholesale."""
+    C.reset_stats()
+    fn = C.jit(lambda x: x * 13, site="svc-fp", token=("fp", 1))
+    x = _jnp_ones((4,))
+    fn(x)
+    old_fp = C.fingerprint()
+    monkeypatch.setenv("MXNET_TPU_CACHE_SALT", "new-jax-version")
+    C.configure()  # re-reads env; fingerprint recomputes
+    assert C.fingerprint() != old_fp
+    C.clear_memory()
+    fn(x)
+    st = C.stats()["svc-fp"]
+    assert st["compiles"] == 2 and st["disk_hits"] == 0
+    rep = C.disk_report()
+    assert rep["stale_entries"] >= 1  # the old-fingerprint entry
+    out = C.gc_cache()
+    assert out["removed_stale"] >= 1
+    assert C.disk_report()["stale_entries"] == 0
+
+
+# ----------------------------------------------------------- warmup / AOT --
+
+def test_warmup_manifest_records_and_replays(cache_dir):
+    C.reset_stats()
+    C.clear_manifest()
+    fn = C.jit(lambda x, s: x * s, site="svc-warm", token=("warm", 1))
+    fn(_jnp_ones((6, 2)), 3.0)
+    entries = [e for e in C.manifest() if e["site"] == "svc-warm"]
+    assert len(entries) == 1
+    # array leaf: shape/dtype recorded; scalar leaf: type + sample value
+    spec = entries[0]["args"]
+    assert spec["items"][0]["shape"] == [6, 2]
+    assert spec["items"][1]["t"] == "py"
+    # replay into a fresh memory state: warmup loads from disk, then the
+    # first real call is a pure HIT (compiled before traffic)
+    C.clear_memory()
+    C.reset_stats()
+    report = C.warmup(entries)
+    assert report["disk"] == 1 and report["errors"] == []
+    out = fn(_jnp_ones((6, 2)), 3.0)
+    assert float(out[0][0]) == 3.0
+    st = C.stats()["svc-warm"]
+    assert st["hits"] == 1 and st["compiles"] == 0
+    assert C.last_warmup()["entries"] == 1
+
+
+def test_warmup_pending_until_registration(cache_dir):
+    """Entries for a not-yet-registered token stay pending and replay the
+    moment the site registers (lazy sites: CachedOp builds on first
+    call) — the compile then happens at build, not at first traffic."""
+    C.clear_manifest()
+    token = ("pend", 42)
+    fn = C.jit(lambda x: x + 9, site="svc-pend", token=token)
+    fn(_jnp_ones((3,)))
+    entries = [e for e in C.manifest() if e["site"] == "svc-pend"]
+    del fn  # registration is weak: the function dies
+    report = C.warmup(entries)
+    assert report["pending"] == 1
+    C.reset_stats()
+    fn2 = C.jit(lambda x: x + 9, site="svc-pend", token=token)
+    st = C.stats()["svc-pend"]
+    assert st["disk_hits"] + st["compiles"] == 1  # replayed at creation
+    fn2(_jnp_ones((3,)))
+    assert C.stats()["svc-pend"]["hits"] == 1
+
+
+def test_manifest_save_and_file_roundtrip(cache_dir, tmp_path):
+    C.clear_manifest()
+    fn = C.jit(lambda x: x * 2, site="svc-save", token=("save", 1))
+    fn(_jnp_ones((2, 2)))
+    path = C.save_manifest(str(tmp_path / "m.json"))
+    with open(path) as f:
+        data = json.load(f)
+    assert any(e["site"] == "svc-save" for e in data)
+    # cache-dir manifest auto-accumulates too (the pod cold-start source)
+    with open(os.path.join(cache_dir, C.MANIFEST_FILE)) as f:
+        disk_entries = json.load(f)
+    assert any(e["site"] == "svc-save" for e in disk_entries)
+    C.clear_memory()
+    report = C.warmup(str(path))
+    assert report["errors"] == []
+    assert report["disk"] + report["compiled"] + report["cached"] >= 1
+
+
+def test_trainer_records_manifest_and_warmup(cache_dir):
+    """ShardedTrainer signatures land in the warmup manifest
+    automatically, and trainer.warmup() compiles before first traffic."""
+    from mxnet_tpu.gluon import loss as gloss, nn
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    C.clear_manifest()
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 6).astype(np.float32))
+    y = mx.nd.array(np.arange(4, dtype=np.float32) % 2)
+    net(x)
+    tr = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1}, mesh=DeviceMesh({"dp": 1}))
+    tr.step(x, y).wait_to_read()
+    assert any(e["site"] == "trainer" for e in C.manifest())
+    # non-donating steps are serializable: a first trainer records +
+    # persists, then an identically-configured fresh trainer warms up
+    # pre-traffic and its first step is a pure service hit (donating
+    # steps dispatch through jit only — the AOT call path corrupts
+    # donated buffers on CPU jaxlib — and warm via the native XLA cache)
+    kw = dict(mesh=DeviceMesh({"dp": 1}), donate=False)
+    tr2 = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.1}, **kw)
+    tr2.step(x, y).wait_to_read()
+    tr2b = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                          {"learning_rate": 0.1}, **kw)
+    report = tr2b.warmup(x, y)
+    assert report["errors"] == []
+    assert report["disk"] + report["compiled"] + report["cached"] >= 1
+    C.reset_stats()
+    tr2b.step(x, y).wait_to_read()
+    st = C.stats().get("trainer", {})
+    assert st.get("compiles", 0) == 0, st
+    # a donating trainer still records + warms (native-cache seeding),
+    # and steps stably through the jit path
+    tr3 = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.1},
+                         mesh=DeviceMesh({"dp": 1}))
+    assert tr3.warmup(x, y)["errors"] == []
+    tr3.step(x, y).wait_to_read()
+
+
+# --------------------------------------------------- cross-process (disk) --
+
+def _run_child(cache_dir):
+    env = dict(os.environ)
+    env["MXNET_TPU_CACHE_DIR"] = cache_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run([sys.executable, CHILD], capture_output=True,
+                         text=True, timeout=280, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    for line in out.stdout.splitlines():
+        if line.startswith("CHILD_REPORT "):
+            return json.loads(line[len("CHILD_REPORT "):])
+    raise AssertionError(f"no report in child output: {out.stdout[-800:]}")
+
+
+def test_subprocess_warm_start_hits_disk_cache(tmp_path):
+    """ACCEPTANCE: a second process over the same cache dir satisfies
+    >=90% of compile-cache lookups (zero XLA recompiles of previously-
+    seen signatures) and its compile time collapses to disk-load time."""
+    d = str(tmp_path / "cache")
+    cold = _run_child(d)
+    warm = _run_child(d)
+    ct, wt = cold["totals"], warm["totals"]
+    assert ct["compiles"] > 0 and ct["disk_hits"] == 0
+    # zero recompiles of previously-seen signatures
+    assert wt["compiles"] == 0, warm["stats"]
+    assert wt["disk_hits"] == wt["misses"]
+    hit_rate = (wt["hits"] + wt["disk_hits"]) / (wt["hits"] + wt["misses"])
+    assert hit_rate >= 0.90, (hit_rate, warm["stats"])
+    # warm "cold-start" compile cost measurably below cold
+    warm_cost = wt["compile_ms"] + wt["load_ms"]
+    assert warm_cost < ct["compile_ms"] * 0.5, (warm_cost, ct)
+    # every site that compiled cold got disk hits warm
+    for site, st in warm["stats"].items():
+        if st["misses"]:
+            assert st["compiles"] == 0, (site, st)
+    # the manifest accumulated for future pods
+    assert warm["manifest_entries"] >= 5
+
+
+# ------------------------------------------------------- metrics parity ----
+
+def test_churn_stats_agree_with_service(monkeypatch):
+    """distcheck pass-4 (recompile churn) sees the service's per-site
+    traffic through the 'service' cache family, with hit/miss counts
+    matching compile.stats() exactly."""
+    from mxnet_tpu.analysis import distcheck as dc
+
+    dc.track_caches(True)
+    try:
+        dc.reset_cache_stats()
+        C.reset_stats()
+        fn = C.jit(lambda x: x * 4, site="svc-churn", token=("ch", 1))
+        for n in (3, 3, 3, 4, 5):  # 3 sigs, 2 repeat hits
+            fn(_jnp_ones((n,)))
+        svc = C.stats()["svc-churn"]
+        rec = dc.cache_stats()[("service", "svc-churn")]
+        assert rec["hits"] == svc["hits"] == 2
+        assert rec["misses"] == svc["misses"] == 3
+        assert rec["distinct_keys"] == 3
+    finally:
+        dc.track_caches(dc.enabled())
+        dc.reset_cache_stats()
+
+
+def test_profiler_compile_cache_tracks():
+    from mxnet_tpu import profiler
+
+    profiler.reset()
+    profiler.set_config(profile_imperative=True, aggregate_stats=True)
+    profiler.set_state("run")
+    try:
+        fn = C.jit(lambda x: x * 6, site="svc-prof", token=("prof", 1))
+        fn(_jnp_ones((7,)))
+        fn(_jnp_ones((7,)))
+    finally:
+        profiler.set_state("stop")
+    events = profiler._events
+    names = {e["name"] for e in events}
+    assert "compile[svc-prof]" in names
+    assert "compile_cache.service.svc-prof.misses" in names
+
+
+# ------------------------------------------------------------ perf guard ---
+
+@pytest.mark.perf
+def test_dispatch_overhead_within_noise():
+    """CI guard: the compile-service layer must not tax the eager per-op
+    hot path — opperf --dispatch ns/op with the service on stays within
+    noise of the raw-jit baseline (service bypassed)."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import opperf
+
+    kw = dict(chain_len=8, bulk=8, size=256, iters=60, warmup=10, trials=3)
+    on = opperf.bench_dispatch(**kw)
+    prev = C.set_enabled(False)
+    try:
+        off = opperf.bench_dispatch(**kw)
+    finally:
+        C.set_enabled(prev)
+    # generous envelope: CPU CI timing is noisy; the real overhead is one
+    # dict probe + small tuple build (<~2us), the guard catches order-of-
+    # magnitude regressions (accidental sync, per-call disk IO, ...)
+    for k in ("unbulked_ns_per_op", "bulked_ns_per_op"):
+        assert on[k] <= off[k] * 1.6 + 2000.0, (k, on, off)
+
+
+# ------------------------------------------------------------- satellites --
+
+def test_bench_train_cpu_emits_compile_fields(capsys, monkeypatch):
+    monkeypatch.setenv("BENCH_TRAIN_CPU_BATCH", "8")
+    monkeypatch.setenv("BENCH_TRAIN_CPU_ITERS", "2")
+    sys.path.insert(0, REPO)
+    import bench
+
+    bench.bench_train_cpu()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["unit"] == "ms/step" and line["platform"] == "cpu"
+    assert line["value"] > 0 and line["img_per_s"] > 0
+    for field in ("compile_ms", "cache_hits", "cache_misses",
+                  "cache_disk_hits"):
+        assert field in line
+
+
+def test_bench_warm_start_compile_time_below_cold(tmp_path):
+    """ACCEPTANCE: bench.py's emitted JSON shows warm-start compile time
+    measurably below cold when a cache dir is set, with the misses
+    absorbed as disk hits."""
+    env = dict(os.environ)
+    env.update({"MXNET_TPU_CACHE_DIR": str(tmp_path / "cache"),
+                "BENCH_TRAIN_CPU_BATCH": "8",
+                "BENCH_TRAIN_CPU_ITERS": "2"})
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--train-only"],
+            capture_output=True, text=True, timeout=280, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    warm = run()
+    assert cold["compile_ms"] > 0 and cold["cache_disk_hits"] == 0
+    assert warm["cache_disk_hits"] > 0
+    assert warm["compile_ms"] < cold["compile_ms"] * 0.5, (warm, cold)
+
+
+def test_diagnose_reports_compile_cache(capsys, cache_dir):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import diagnose
+
+    fn = C.jit(lambda x: x + 2, site="svc-diag", token=("diag", 1))
+    fn(_jnp_ones((3,)))
+    diagnose.check_compile_cache()
+    out = capsys.readouterr().out
+    assert "disk cache    : " + cache_dir in out
+    assert "svc-diag" in out
+    assert "fingerprint" in out
+    # --gc prunes a planted stale fingerprint dir
+    stale = os.path.join(cache_dir, "exec", "deadbeef0000")
+    os.makedirs(stale, exist_ok=True)
+    with open(os.path.join(stale, "x.bin"), "wb") as f:
+        f.write(b"stale")
+    diagnose.check_compile_cache(gc=True)
+    assert not os.path.isdir(stale)

@@ -68,6 +68,81 @@ def test_prefetching_iter():
     assert len(list(it)) == 4
 
 
+def test_prefetching_iter_device_stage():
+    """The device-placement stage: batches come out already device_put on
+    the requested target (inside the fetch worker — double-buffered h2d),
+    values unchanged."""
+    import jax
+
+    data = np.arange(40).reshape(20, 2).astype(np.float32)
+    label = np.arange(20).astype(np.float32)
+    plain = list(PrefetchingIter(NDArrayIter(data, label, batch_size=5)))
+    staged = list(PrefetchingIter(NDArrayIter(data, label, batch_size=5),
+                                  device=mx.cpu()))
+    assert len(staged) == len(plain)
+    for p, s in zip(plain, staged):
+        assert isinstance(s.data[0]._data.sharding,
+                          jax.sharding.SingleDeviceSharding)
+        np.testing.assert_array_equal(p.data[0].asnumpy(),
+                                      s.data[0].asnumpy())
+        np.testing.assert_array_equal(p.label[0].asnumpy(),
+                                      s.label[0].asnumpy())
+
+
+def test_prefetching_iter_mesh_stage_matches_trainer_layout():
+    """mesh= stages batches dp-sharded on dim 0 — exactly the layout
+    ShardedTrainer._put_batch would produce, so the step's device_put is
+    a no-op."""
+    import jax
+
+    from mxnet_tpu.parallel import DeviceMesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = DeviceMesh({"dp": 2})
+    data = np.arange(48).reshape(8, 6).astype(np.float32)
+    label = np.arange(8).astype(np.float32)
+    it = PrefetchingIter(NDArrayIter(data, label, batch_size=4), mesh=mesh)
+    batch = next(it)
+    x_sh = batch.data[0]._data.sharding
+    y_sh = batch.label[0]._data.sharding
+    assert x_sh == mesh.sharding("dp", None)
+    assert y_sh == mesh.sharding("dp")
+    np.testing.assert_array_equal(batch.data[0].asnumpy(), data[:4])
+    # explicit shardings= pair behaves identically
+    it2 = PrefetchingIter(NDArrayIter(data, label, batch_size=4),
+                          shardings=(mesh.sharding("dp", None),
+                                     mesh.sharding("dp")))
+    b2 = next(it2)
+    assert b2.data[0]._data.sharding == x_sh
+    assert b2.label[0]._data.sharding == y_sh
+
+
+def test_prefetching_iter_stage_conflicting_args_rejected():
+    from mxnet_tpu.parallel import DeviceMesh
+
+    data = np.zeros((4, 2), np.float32)
+    with pytest.raises(ValueError, match="at most one"):
+        PrefetchingIter(NDArrayIter(data, batch_size=2),
+                        device=mx.cpu(), mesh=DeviceMesh({"dp": 1}))
+
+
+def test_prefetching_iter_stage_error_sticky():
+    """A failing device transfer in the placement stage follows the
+    deferred-error contract: sticky until reset()."""
+
+    class _BadSharding:
+        pass
+
+    data = np.zeros((4, 2), np.float32)
+    it = PrefetchingIter(NDArrayIter(data, batch_size=2),
+                         shardings=_BadSharding())
+    with pytest.raises(Exception):
+        next(it)
+    with pytest.raises(Exception):  # sticky
+        it.iter_next()
+
+
 def test_mnist_iter_from_files(tmp_path):
     """Write idx-format files and read via MNISTIter (parity:
     src/io/iter_mnist.cc)."""

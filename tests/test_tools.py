@@ -110,6 +110,7 @@ def test_mxlint_catches_planted_violations(tmp_path):
     bad.write_text(
         "import os\n"                                    # unused-import
         "import numpy as np\n"
+        "import jax\n"
         "from jax.experimental import enable_x64\n"      # raw-jax-compat
         "from mxnet_tpu.ops.registry import register\n"
         "def f(x, y=[]):\n"                              # mutable-default
@@ -121,13 +122,15 @@ def test_mxlint_catches_planted_violations(tmp_path):
         "@register('badop')\n"
         "def badop(data):\n"                             # no-schema-doc
         "    return data\n"
+        "g = jax.jit(badop)\n"                           # raw-jit
         "from jax.sharding import PartitionSpec as P\n"
         "spec = P('dpp', None)\n")                       # partition-spec-literal
     findings = mxlint.run([str(bad)], root=str(tmp_path))
     rules = {f.rule for f in findings}
-    assert rules == {"unused-import", "raw-jax-compat", "mutable-default",
-                     "host-sync", "bare-except", "unseeded-random",
-                     "no-schema-doc", "partition-spec-literal"}
+    assert rules == {"unused-import", "raw-jax-compat", "raw-jit",
+                     "mutable-default", "host-sync", "bare-except",
+                     "unseeded-random", "no-schema-doc",
+                     "partition-spec-literal"}
     psl = [f for f in findings if f.rule == "partition-spec-literal"]
     assert "did you mean" in psl[0].message  # difflib near-miss hint
     # the canonical vocabulary, and parallel/ itself, stay clean
@@ -145,6 +148,30 @@ def test_mxlint_catches_planted_violations(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text("v = x.asnumpy()  # noqa: host-sync\n")
     assert mxlint.run([str(ok)], root=str(tmp_path)) == []
+
+
+@pytest.mark.lint
+def test_mxlint_raw_jit_rule_scoping(tmp_path):
+    """raw-jit fires on direct jax.jit calls and 'from jax import jit',
+    but compile.py (the service home) and _jax_compat.py are exempt."""
+    import mxlint
+
+    direct = tmp_path / "site.py"
+    direct.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    assert {f.rule for f in mxlint.run([str(direct)],
+                                       root=str(tmp_path))} == {"raw-jit"}
+    imported = tmp_path / "site2.py"
+    imported.write_text("from jax import jit\nf = jit(lambda x: x)\n")
+    assert "raw-jit" in {f.rule for f in mxlint.run([str(imported)],
+                                                    root=str(tmp_path))}
+    exempt = tmp_path / "compile.py"
+    exempt.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    assert mxlint.run([str(exempt)], root=str(tmp_path)) == []
+    # the service call spelling stays clean
+    good = tmp_path / "site3.py"
+    good.write_text("from mxnet_tpu import compile as _compile\n"
+                    "f = _compile.jit(lambda x: x, site='s', token=('t',))\n")
+    assert mxlint.run([str(good)], root=str(tmp_path)) == []
 
 
 @pytest.mark.lint

@@ -107,13 +107,66 @@ def check_analysis():
         print("analysis import failed:", e)
 
 
-def check_compile_cache():
-    """Dispatch/compile cache statistics (analysis.distcheck pass 4) —
-    the per-site hit/miss/distinct-key report behind the recompile-churn
-    detector, and the measurement seam for the unified compile service
-    (ROADMAP item 5). Empty outside a training process; run this in-process
-    (``from tools.diagnose import check_compile_cache``) for live stats."""
+def check_compile_cache(gc=False):
+    """Compile-cache health: the unified compile service's per-site
+    hit/miss/compile-ms stats (mxnet_tpu.compile), the persistent on-disk
+    cache census (location / entries / bytes / staleness), the most recent
+    AOT warmup-manifest replay, and the analysis.distcheck pass-4
+    recompile-churn report. In-memory stats are empty outside a training
+    process; the on-disk census and last-warmup record persist. With
+    ``gc=True`` (the ``--gc`` flag), stale-fingerprint and corrupt disk
+    entries are pruned."""
     print("--------Compile Cache----------")
+    try:
+        from mxnet_tpu import compile as _compile
+
+        print(f"MXNET_TPU_CACHE_DIR="
+              f"{os.environ.get('MXNET_TPU_CACHE_DIR', '<unset>')}  "
+              "(persistent executable cache; memory-only when unset)")
+        print(f"MXNET_TPU_COMPILE_SERVICE="
+              f"{os.environ.get('MXNET_TPU_COMPILE_SERVICE', '<unset>')}  "
+              "(0 bypasses the service — raw jax.jit)")
+        svc = _compile.stats()
+        if svc:
+            print(f"{'service site':<16s} {'hits':>7s} {'misses':>7s} "
+                  f"{'disk':>6s} {'compiles':>9s} {'compile_ms':>11s} "
+                  f"{'load_ms':>8s}")
+            for site, st in svc.items():
+                print(f"{site:<16s} {st['hits']:>7d} {st['misses']:>7d} "
+                      f"{st['disk_hits']:>6d} {st['compiles']:>9d} "
+                      f"{st['compile_ms']:>11.1f} {st['load_ms']:>8.1f}")
+        else:
+            print("service stats : none this process")
+        rep = _compile.disk_report()
+        if rep["dir"] is None:
+            print("disk cache    : disabled (set MXNET_TPU_CACHE_DIR)")
+        else:
+            print(f"disk cache    : {rep['dir']}")
+            print(f"  fingerprint : {rep['fingerprint']}")
+            print(f"  entries     : {rep['entries']} "
+                  f"({rep['bytes']} bytes), xla-native "
+                  f"{rep['xla_entries']}")
+            if rep["stale_entries"]:
+                print(f"  stale       : {rep['stale_entries']} entries "
+                      f"({rep['stale_bytes']} bytes) from other "
+                      "fingerprints — prune with --gc")
+            if gc:
+                out = _compile.gc_cache()
+                print(f"  gc          : removed {out['removed_stale']} "
+                      f"stale + {out['removed_corrupt']} corrupt "
+                      f"({out['bytes_freed']} bytes freed)")
+        warm = _compile.last_warmup()
+        if warm is None:
+            print("last warmup   : none recorded")
+        else:
+            print(f"last warmup   : {warm.get('entries', 0)} entries — "
+                  f"{warm.get('compiled', 0)} compiled, "
+                  f"{warm.get('disk', 0)} from disk, "
+                  f"{warm.get('cached', 0)} cached, "
+                  f"{warm.get('pending', 0)} pending, "
+                  f"{len(warm.get('errors', []))} errors")
+    except ImportError as e:
+        print("compile service import failed:", e)
     try:
         from mxnet_tpu.analysis import distcheck as _dc
 
@@ -206,7 +259,15 @@ def check_preempt():
         print("preempt import failed:", e)
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="diagnose", description="mxnet_tpu environment report")
+    ap.add_argument("--gc", action="store_true",
+                    help="prune stale-fingerprint / corrupt entries from "
+                         "the on-disk compile cache (MXNET_TPU_CACHE_DIR)")
+    args = ap.parse_args(argv if argv is not None else [])
     check_python()
     check_pip()
     check_framework()
@@ -214,10 +275,12 @@ def main():
     check_hardware()
     check_environment()
     check_analysis()
-    check_compile_cache()
+    check_compile_cache(gc=args.gc)
     check_watchdog()
     check_preempt()
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    main(_sys.argv[1:])

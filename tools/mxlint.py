@@ -15,6 +15,12 @@ host-sync         ``.asnumpy()`` / ``.asscalar()`` / ``.item()`` in library
 raw-jax-compat    ``shard_map`` / ``enable_x64`` / ``pcast`` taken from jax
                   directly: their home moved across jax versions, so call
                   sites must go through ``mxnet_tpu._jax_compat``.
+raw-jit           a direct ``jax.jit(`` call outside ``compile.py`` /
+                  ``_jax_compat.py`` — every compile must go through the
+                  unified compile service (``mxnet_tpu.compile.jit``) so
+                  it gets the canonical cache key, the persistent on-disk
+                  cache, AOT warmup and the per-site hit/miss metrics;
+                  a raw jit site is invisible to all four.
 unseeded-random   module-level ``np.random.*`` draws bypass the seeded
                   stream (``mxnet_tpu.random`` / an explicit RandomState):
                   nondeterminism ``mx.random.seed`` cannot control.
@@ -68,9 +74,9 @@ from collections import Counter
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "mxlint_baseline.txt")
 
-RULES = ("bare-except", "host-sync", "raw-jax-compat", "unseeded-random",
-         "no-schema-doc", "unused-import", "mutable-default",
-         "unbounded-sync", "partition-spec-literal")
+RULES = ("bare-except", "host-sync", "raw-jax-compat", "raw-jit",
+         "unseeded-random", "no-schema-doc", "unused-import",
+         "mutable-default", "unbounded-sync", "partition-spec-literal")
 
 _SYNC_METHODS = {"asnumpy", "asscalar"}
 # canonical mesh-axis vocabulary — keep in sync with
@@ -123,6 +129,9 @@ class _Linter(ast.NodeVisitor):
         self.is_init = os.path.basename(path) == "__init__.py"
         self.is_compat = os.path.basename(path) == "_jax_compat.py"
         self.is_watchdog = os.path.basename(path) == "watchdog.py"
+        # compile.py IS the service — the one home of raw jax.jit
+        self.is_compile = os.path.basename(path) in ("compile.py",
+                                                     "_jax_compat.py")
         # parallel/ is the home of the sharding vocabulary itself
         self.is_parallel = "/parallel/" in rel.replace(os.sep, "/")
         self.pspec_aliases = set()  # local names bound to PartitionSpec
@@ -224,6 +233,15 @@ class _Linter(ast.NodeVisitor):
                 self.add(node, "raw-jax-compat",
                          f"{chain} moved across jax versions; route through "
                          "mxnet_tpu._jax_compat")
+        if not self.is_compile and node.attr == "jit":
+            chain = _dotted(node)
+            if chain is not None and chain.split(".")[0] == "jax":
+                self.add(node, "raw-jit",
+                         f"{chain} bypasses the unified compile service — "
+                         "use mxnet_tpu.compile.jit(fn, site=..., "
+                         "token=...) so this executable gets the "
+                         "canonical cache key, disk persistence, AOT "
+                         "warmup and cache metrics")
         self._mark_used(node)
         # do NOT generic_visit: _mark_used consumed the name chain
 
@@ -258,6 +276,12 @@ class _Linter(ast.NodeVisitor):
                              f"'from {mod} import {a.name}' moved across "
                              "jax versions; route through "
                              "mxnet_tpu._jax_compat")
+        if not self.is_compile and mod == "jax":
+            for a in node.names:
+                if a.name == "jit":
+                    self.add(node, "raw-jit",
+                             "'from jax import jit' bypasses the unified "
+                             "compile service; use mxnet_tpu.compile.jit")
         self._collect_import(node, ((a.asname or a.name, a.name)
                                     for a in node.names))
 
