@@ -24,6 +24,13 @@ comparison the subprocess test in tests/test_compile.py asserts).
 metric (``*_train_cpu`` in ms/step), so BENCH_r06+ records a training
 number even when the TPU tunnel is down.
 
+A serving line is emitted BY DEFAULT (disable with BENCH_SKIP_SERVE=1,
+or run just it with ``--serve-only``): sustained requests/s + p50/p99
+latency + batch fill ratio from a ``tools/loadgen.py`` closed loop
+against an in-process 2-model ``mxnet_tpu.serving`` container
+(BENCH_SERVE_SECONDS, default 30), so the serving trajectory is tracked
+in BENCH_r06+ alongside img/s.
+
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
 BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
 auto-detected from the chip generation — v5e 197, v5p 459, v4 275, ...;
@@ -67,7 +74,17 @@ def main(argv=None):
     ap.add_argument("--train-only", action="store_true",
                     help="emit ONLY the CPU training metric (skip the "
                          "ResNet benches)")
+    ap.add_argument("--serve", action="store_true",
+                    help="also emit the serving throughput metric "
+                         "(tools/loadgen.py closed loop against a "
+                         "2-model container; runs on any host)")
+    ap.add_argument("--serve-only", action="store_true",
+                    help="emit ONLY the serving metric")
     args = ap.parse_args(argv)
+
+    if args.serve_only:
+        bench_serve()
+        return
 
     import mxnet_tpu as mx
     from mxnet_tpu.base import probe_backend_or_fallback
@@ -146,6 +163,10 @@ def main(argv=None):
         bench_train(ctx, batch, dtype, train_iters, model)
     if args.train:
         bench_train_cpu()
+    # the serving line is part of the default metric series (the ROADMAP
+    # item-1 trajectory); BENCH_SKIP_SERVE=1 opts out
+    if args.serve or not os.environ.get("BENCH_SKIP_SERVE"):
+        bench_serve()
 
 
 def bench_train(ctx, batch, dtype, iters, model):
@@ -248,6 +269,42 @@ def bench_train_cpu():
         "img_per_s": round(batch * iters / elapsed, 2),
         "first_step_s": round(compile_s, 3),
         "platform": "cpu",
+    }
+    print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_serve():
+    """Serving throughput: tools/loadgen.py closed loop against an
+    in-process 2-model container (mxnet_tpu.serving) — sustained
+    requests/s with bounded tail latency, the ROADMAP item-1 acceptance
+    number. Pre-traffic warmup compiles every bucket, so
+    ``recompiles_during_run`` must be 0 (the compile service served only
+    cache hits while the clock ran). Env knobs: BENCH_SERVE_SECONDS
+    (default 30), BENCH_SERVE_CONCURRENCY (16), BENCH_SERVE_MODELS (2)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import loadgen
+
+    duration = float(os.environ.get("BENCH_SERVE_SECONDS", 30))
+    concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY", 16))
+    models = int(os.environ.get("BENCH_SERVE_MODELS", 2))
+    rep = loadgen.run_inproc(duration=duration, mode="closed",
+                             concurrency=concurrency, models=models)
+    import jax
+
+    line = {
+        "metric": f"serving_rps_{models}model_closed{concurrency}",
+        "value": rep["rps"],
+        "unit": "req/s",
+        "duration_s": rep["duration_s"],
+        "p50_ms": rep.get("p50_ms"),
+        "p99_ms": rep.get("p99_ms"),
+        "batch_fill_ratio": rep.get("batch_fill_ratio"),
+        "rejected": rep.get("rejected"),
+        "recompiles_during_run": rep.get("recompiles_during_run"),
+        "platform": jax.devices()[0].platform,
     }
     print(json.dumps(_compile_fields(line)), flush=True)
 

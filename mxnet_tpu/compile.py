@@ -31,7 +31,9 @@ call sites), which buys one seam for:
   traffic. ``ShardedTrainer`` and ``CachedOp`` record automatically by
   virtue of compiling through the service.
 * **Per-site metrics** — hit/miss/disk-hit/compile-ms per site
-  (``dispatch``/``bulk``/``cachedop``/``executor``/``trainer``), flowing
+  (``dispatch``/``bulk``/``cachedop``/``executor``/``trainer``/
+  ``predictor`` [the MXPred C-ABI path]/``serving`` [the predict-server
+  bucket executables]), flowing
   into the profiler's ``compile_cache.*`` counter tracks, the
   ``analysis.distcheck`` recompile-churn detector (site family
   ``service``), and the ``tools/diagnose.py`` "Compile Cache" report.
@@ -1016,8 +1018,8 @@ def jit(fn, *, site, token, **jit_kwargs):
     """The framework-wide replacement for ``jax.jit``.
 
     site : metric bucket — 'dispatch' | 'bulk' | 'cachedop' | 'executor'
-        | 'trainer' (new sites welcome; mxlint's ``raw-jit`` rule sends
-        every new compile call here).
+        | 'trainer' | 'predictor' | 'serving' (new sites welcome;
+        mxlint's ``raw-jit`` rule sends every new compile call here).
     token : the function's *stable identity across processes* — whatever
         deterministic hashable value distinguishes this function from any
         other the site builds (op name + frozen kwargs, bulk plan,

@@ -17,6 +17,11 @@ through the stack:
                        entry bytes are the payload, so ``corrupt`` mode
                        exercises the CRC-mismatch recompile fallback
     ``compile.write``  persistent compile-cache writes (compile.py)
+    ``serving.batch``  every in-flight serving batch (serving/batcher.py)
+                       — ``hang`` is the wedged-device drill the serving
+                       watchdog deadline converts into a crash bundle +
+                       failed batch (server keeps serving), ``preempt``
+                       the SIGTERM-mid-load drain drill
 
 Faults are configured programmatically (:func:`configure`) or through the
 ``MXNET_TPU_FAULTS`` environment variable — read once, at first use, so

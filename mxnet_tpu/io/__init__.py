@@ -1,6 +1,8 @@
 """Data IO (parity: python/mxnet/io/)."""
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MNISTIter, CSVIter, LibSVMIter, ImageRecordIter)
+                 PrefetchingIter, MNISTIter, CSVIter, LibSVMIter,
+                 ImageRecordIter, DeviceStager)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
+           "ImageRecordIter", "DeviceStager"]

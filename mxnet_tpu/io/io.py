@@ -22,7 +22,81 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "DeviceStager"]
+
+
+class DeviceStager:
+    """Memoised target-sharding resolver + ``jax.device_put`` — the
+    device-placement stage shared by :class:`PrefetchingIter` (h2d for
+    batch N+1 overlaps the consumer's compute on batch N) and the
+    serving batcher (h2d for the next padded bucket overlaps the
+    in-flight compiled call).
+
+    Exactly one of:
+
+    * ``device`` — a :class:`~mxnet_tpu.context.Context` or jax device:
+      single-device placement;
+    * ``mesh`` — a :class:`~mxnet_tpu.parallel.DeviceMesh`: arrays are
+      batch-sharded over ``dp`` (dim 0), replicated on the rest — the
+      ``ShardedTrainer`` input contract;
+    * ``shardings`` — explicit ``(data_sharding, label_sharding)`` (or a
+      single sharding for both) for custom layouts.
+
+    With none set the stager is inactive (``active`` False,
+    :meth:`put` is a pass-through).
+    """
+
+    def __init__(self, device=None, mesh=None, shardings=None):
+        if sum(x is not None for x in (device, mesh, shardings)) > 1:
+            raise ValueError("pass at most one of device=, mesh=, "
+                             "shardings=")
+        self._device = device
+        self._mesh = mesh
+        self._shardings = shardings
+        self._cache = {}  # (is_label, ndim) -> resolved sharding
+
+    @property
+    def active(self):
+        return (self._device is not None or self._mesh is not None
+                or self._shardings is not None)
+
+    def sharding_for(self, ndim, is_label=False):
+        """Resolve (and memoise) the target sharding for one array."""
+        key = (bool(is_label), ndim)
+        sh = self._cache.get(key)
+        if sh is not None:
+            return sh
+        import jax
+
+        if self._mesh is not None:
+            # batch-shard dim 0 over dp, replicate the rest — the
+            # ShardedTrainer._put_batch layout
+            spec = ("dp",) + (None,) * (ndim - 1) if ndim else ()
+            sh = self._mesh.sharding(*spec)
+        elif self._shardings is not None:
+            pair = self._shardings
+            if isinstance(pair, (list, tuple)):
+                sh = pair[1] if is_label and len(pair) > 1 else pair[0]
+            else:
+                sh = pair
+        else:
+            dev = self._device
+            dev = dev.jax_device() if hasattr(dev, "jax_device") else dev
+            sh = jax.sharding.SingleDeviceSharding(dev)
+        self._cache[key] = sh
+        return sh
+
+    def put(self, raw, is_label=False):
+        """Stage one host array onto its target layout (no-op when
+        already there, or when the stager is inactive)."""
+        if not self.active:
+            return raw
+        import jax
+
+        sh = self.sharding_for(getattr(raw, "ndim", 0), is_label)
+        if getattr(raw, "sharding", None) == sh:
+            return raw
+        return jax.device_put(raw, sh)
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -324,51 +398,15 @@ class PrefetchingIter(DataIter):
         self._next_batches = [None] * self.n_iter
         self._started = False
         self._error = None  # sticky deferred error, cleared by reset()
-        if sum(x is not None for x in (device, mesh, shardings)) > 1:
-            raise ValueError("pass at most one of device=, mesh=, "
-                             "shardings=")
-        self._device = device
-        self._mesh = mesh
-        self._shardings = shardings
-        self._sh_cache = {}  # (is_label, ndim) -> resolved sharding
-        self._staging = (device is not None or mesh is not None
-                         or shardings is not None)
+        self._stager = DeviceStager(device=device, mesh=mesh,
+                                    shardings=shardings)
+        self._staging = self._stager.active
 
     # ------------------------------------------------- device placement ---
-    def _sharding_for(self, is_label, ndim):
-        """Resolve (and memoise) the target sharding for one array."""
-        key = (is_label, ndim)
-        sh = self._sh_cache.get(key)
-        if sh is not None:
-            return sh
-        import jax
-
-        if self._mesh is not None:
-            # batch-shard dim 0 over dp, replicate the rest — the
-            # ShardedTrainer._put_batch layout
-            spec = ("dp",) + (None,) * (ndim - 1) if ndim else ()
-            sh = self._mesh.sharding(*spec)
-        elif self._shardings is not None:
-            pair = self._shardings
-            if isinstance(pair, (list, tuple)):
-                sh = pair[1] if is_label and len(pair) > 1 else pair[0]
-            else:
-                sh = pair
-        else:
-            dev = self._device
-            dev = dev.jax_device() if hasattr(dev, "jax_device") else dev
-            sh = jax.sharding.SingleDeviceSharding(dev)
-        self._sh_cache[key] = sh
-        return sh
-
     def _stage_nd(self, x, is_label):
-        import jax
-
         raw = x._data
-        sh = self._sharding_for(is_label, getattr(raw, "ndim", 0))
-        if getattr(raw, "sharding", None) == sh:
-            return x
-        return type(x)(jax.device_put(raw, sh))
+        staged = self._stager.put(raw, is_label)
+        return x if staged is raw else type(x)(staged)
 
     def _stage_batch(self, batch):
         """The device-placement stage: runs INSIDE the fetch worker so

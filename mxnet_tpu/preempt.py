@@ -11,7 +11,9 @@ with nothing written; with this module the run *drains*:
 2. The training loops check :func:`requested` after every step/batch —
    ``ShardedTrainer.step`` raises :class:`DrainRequested` rather than
    start a NEW step once the flag is up, and the estimator/module fit
-   loops drain themselves.
+   loops drain themselves. The predict server
+   (``serving.ModelServer.run_until_drained``) polls the same flag: it
+   stops admission, answers every admitted request, then exits 75.
 3. :func:`drain` writes the final checkpoint (an explicit ``save``
    callable, or the hook installed with ``watchdog.set_last_resort`` —
    ``ShardedTrainer.save_checkpoint``/``resume`` register one

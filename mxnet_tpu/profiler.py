@@ -24,7 +24,7 @@ import time
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
            "dumps", "reset", "Domain", "Task", "Frame", "Event", "Counter",
            "Marker", "scope", "record_skip_step", "record_stall",
-           "record_cache", "record_compile"]
+           "record_cache", "record_compile", "record_serving"]
 
 _lock = threading.Lock()
 _RECORDING = False       # master flag: a session is active and not paused
@@ -225,6 +225,22 @@ def record_compile(site, dur_ms, source, hits, misses):
     record_event(f"compile[{site}]", now - dur_ms * 1e3, dur_ms * 1e3,
                  cat="compile", args={"source": source})
     record_cache(f"service.{site}", hits, misses)
+
+
+def record_serving(model, bucket, rows, dur_ms, queue_depth):
+    """One served batch (mxnet_tpu.serving): a complete event spanning
+    the compiled bucket execution plus queue-depth / batch-rows counter
+    tracks, so serving latency and backlog line up with the compile-cache
+    and dispatch tracks in the trace. No-op unless a profiling session is
+    recording."""
+    if not _RECORDING:
+        return
+    now = _now_us()
+    record_event(f"serving[{model}]", now - dur_ms * 1e3, dur_ms * 1e3,
+                 cat="serving",
+                 args={"bucket": bucket, "rows": rows})
+    record_counter(f"serving.{model}.queue_depth", queue_depth)
+    record_counter(f"serving.{model}.batch_rows", rows)
 
 
 def record_instant(name, cat="instant", args=None):

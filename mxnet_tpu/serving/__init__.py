@@ -1,0 +1,70 @@
+"""Production inference serving: a continuous-batching predict server
+with bounded tail latency.
+
+The subsystem the ROADMAP north star ("heavy traffic from millions of
+users") asks for, built from the pieces the stack already has:
+
+* **ModelContainer / ServedModel** (``model.py``) — load N models
+  (gluon block, symbol+params, ``save_checkpoint`` pair, ONNX) and
+  pre-compile a small ladder of padded batch buckets through the
+  unified compile service (site ``serving``): persistent disk cache,
+  AOT warmup manifest, per-site hit/miss metrics. A warm pod calls
+  ``container.warmup()`` and serves with ZERO recompiles.
+* **BucketBatcher** (``batcher.py``) — per-model continuous/dynamic
+  batching: in-flight requests coalesce into the nearest bucket (pad,
+  run, slice) under a ``max_wait_ms`` admission deadline; queue-depth
+  admission control fast-rejects with :class:`ServerBusyError` (429)
+  instead of queueing unboundedly; h2d staging reuses the
+  PrefetchingIter device-put stage so transfer overlaps compute.
+* **ModelServer** (``server.py``) — the multi-tenant front:
+  submit/predict, per-model isolation (one model's stall never blocks
+  another's queue), p50/p95/p99 + throughput + queue depth + bucket
+  census + fill-ratio observability, and the SIGTERM drain protocol
+  (answer everything admitted, exit 75 via ``preempt``).
+* **HttpFrontEnd** (``http.py``) — a small JSON-over-HTTP front so
+  external clients / ``tools/loadgen.py``'s socket mode can drive it.
+
+Robust by construction: every in-flight batch runs under a
+``watchdog.sync("serving.batch", ...)`` deadline (a hung batch produces
+a crash bundle + StallError and the server KEEPS SERVING), the
+``serving.batch`` fault-injection point lets the chaos harness
+(``tools/chaos_smoke.py`` phase 6) exercise all of it, and every client
+wait is deadline-bounded (the ``serving-blocking-call`` mxlint rule
+gates the no-unbounded-wait contract for this package).
+
+Knobs: the ``MXNET_TPU_SERVING`` env grammar / :func:`configure` (see
+``config.py`` and docs/SERVING.md). Quick start::
+
+    from mxnet_tpu import serving
+
+    c = serving.ModelContainer()
+    c.add_block("mlp", net, example_shape=(16,))
+    server = serving.ModelServer(c).start()
+    server.warmup()                       # zero recompiles after this
+    y = server.predict("mlp", x)          # or submit() -> future
+    server.drain()                        # answer admitted, stop
+"""
+from .config import configure, configure_from_env, describe, effective
+from .errors import (ModelNotFound, RequestError, RequestTimeout,
+                     ServerBusyError, ServerDrainingError, ServingError)
+from .metrics import ModelMetrics
+from .model import ModelContainer, ServedModel
+from .batcher import BucketBatcher, ServingFuture
+from .server import ModelServer, live_servers, live_stats
+
+__all__ = [
+    "configure", "configure_from_env", "describe", "effective",
+    "ServingError", "ModelNotFound", "ServerBusyError",
+    "ServerDrainingError", "RequestError", "RequestTimeout",
+    "ModelMetrics", "ModelContainer", "ServedModel", "BucketBatcher",
+    "ServingFuture", "ModelServer", "live_servers", "live_stats",
+    "HttpFrontEnd",
+]
+
+
+def __getattr__(name):
+    if name == "HttpFrontEnd":  # http.server pulled in only when used
+        from .http import HttpFrontEnd
+
+        return HttpFrontEnd
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,335 @@
+"""Continuous/dynamic batching: per-model request queue → padded buckets.
+
+One :class:`BucketBatcher` per served model, two daemon threads:
+
+* the **collector** pops waiting requests, coalesces them into the
+  nearest padded bucket under the ``max_wait_ms`` admission deadline
+  (an underfull batch launches as soon as the oldest request has waited
+  the window; a full bucket launches immediately), pads with zero rows,
+  and **stages** the batch onto the device through the shared
+  :class:`~mxnet_tpu.io.io.DeviceStager` (the PrefetchingIter
+  device-put stage) — so h2d for batch N+1 overlaps the compiled call
+  for batch N;
+* the **runner** executes each staged batch under a
+  ``watchdog.sync("serving.batch", ...)`` deadline with the
+  ``serving.batch`` fault-injection point inside the span, slices the
+  outputs back per request, and fulfills the futures.
+
+Continuous: the collector never waits for the runner — requests arriving
+while a batch executes coalesce into the next one, so batches grow with
+load (high fill ratio under pressure, low latency when idle).
+
+Admission control: ``submit`` fast-rejects with
+:class:`~mxnet_tpu.serving.errors.ServerBusyError` the moment the
+queue-depth bound is hit (429 semantics — shed load, don't queue
+unboundedly) and with :class:`ServerDrainingError` once a drain started.
+
+Robustness: a hung batch (wedged device, poisoned input) blows its
+watchdog deadline → crash bundle + StallError; the batch's requests fail
+with a :class:`RequestError` carrying the cause and the batcher KEEPS
+SERVING the next batch. Nothing in this module blocks unboundedly —
+every wait carries a timeout (the ``serving-blocking-call`` mxlint rule
+gates this contract).
+"""
+from __future__ import annotations
+
+import queue as _qmod
+import threading
+import time
+from collections import deque
+
+import numpy as _np
+
+from . import config as _config
+from .errors import (RequestError, RequestTimeout, ServerBusyError,
+                     ServerDrainingError)
+from .metrics import ModelMetrics
+
+__all__ = ["ServingFuture", "BucketBatcher"]
+
+
+class ServingFuture:
+    """Client handle for one in-flight request. ``result`` is ALWAYS
+    deadline-bounded: with no explicit timeout the configured
+    ``timeout_ms`` default applies."""
+
+    __slots__ = ("model", "t_submit", "t_done", "_event", "_result",
+                 "_error")
+
+    def __init__(self, model):
+        self.model = model
+        self.t_submit = time.monotonic()
+        self.t_done = None
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """The response (one numpy array, or a list for multi-output
+        models), or raises the request's failure. Bounded: raises
+        :class:`RequestTimeout` after ``timeout`` seconds (default: the
+        configured ``timeout_ms``)."""
+        if timeout is None:
+            timeout = _config.effective()["timeout_ms"] / 1e3
+        if not self._event.wait(timeout):
+            raise RequestTimeout(
+                f"request to {self.model!r} not answered within "
+                f"{timeout:g}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def latency_ms(self):
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_submit) * 1e3
+
+    def _fulfill(self, result):
+        self.t_done = time.monotonic()
+        self._result = result
+        self._event.set()
+
+    def _fail(self, error):
+        self.t_done = time.monotonic()
+        self._error = error
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("arr", "n", "fut")
+
+    def __init__(self, arr, n, fut):
+        self.arr = arr
+        self.n = n
+        self.fut = fut
+
+
+class BucketBatcher:
+    """The per-model queue + continuous-batching worker pair."""
+
+    def __init__(self, model, metrics=None, max_queue=None,
+                 max_wait_ms=None, stage=None):
+        cfg = _config.effective()
+        self.model = model
+        self.metrics = metrics or ModelMetrics(model.name)
+        self._max_queue = int(cfg["max_queue"] if max_queue is None
+                              else max_queue)
+        self._max_wait = (cfg["max_wait_ms"] if max_wait_ms is None
+                          else float(max_wait_ms)) / 1e3
+        self._queue = deque()
+        self._rows = 0           # rows waiting (the admission bound)
+        self._inflight = 0       # batches popped but not yet finished
+        self._cond = threading.Condition()
+        self._staged = _qmod.Queue(maxsize=1)
+        self._draining = False
+        self._stopping = False
+        self._threads = ()
+        do_stage = cfg["stage"] if stage is None else bool(stage)
+        self._stager = None
+        if do_stage:
+            try:
+                import jax
+
+                from ..io.io import DeviceStager
+
+                self._stager = DeviceStager(device=jax.devices()[0])
+            except Exception:
+                self._stager = None
+
+    # ----------------------------------------------------------- control --
+    def start(self):
+        if self._threads:
+            return self
+        self._collector = threading.Thread(
+            target=self._collect_loop, daemon=True,
+            name=f"mxtpu-serve-{self.model.name}-collect")
+        self._runner = threading.Thread(
+            target=self._run_loop, daemon=True,
+            name=f"mxtpu-serve-{self.model.name}-run")
+        self._threads = (self._collector, self._runner)
+        self._collector.start()
+        self._runner.start()
+        return self
+
+    def queue_depth(self):
+        """Rows waiting for a batch (the bound admission checks)."""
+        return self._rows
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=30.0):
+        """Stop admission, answer everything already admitted (queued AND
+        in flight). Returns True when fully drained within `timeout`."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            with self._cond:
+                if not self._queue and self._inflight == 0:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self, timeout=5.0):
+        """Stop the worker threads; queued-but-unanswered requests fail
+        with ServerDrainingError (call :meth:`drain` first for a graceful
+        shutdown that answers them)."""
+        with self._cond:
+            self._stopping = True
+            self._draining = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = ()
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._rows = 0
+        for r in leftovers:
+            r.fut._fail(ServerDrainingError(self.model.name, "stopped"))
+            self.metrics.record_fail()
+
+    # ------------------------------------------------------------ submit --
+    def submit(self, arr):
+        """Admit one request (fast-reject on a full queue or a draining
+        server) and return its :class:`ServingFuture`."""
+        arr = self.model.validate(arr)
+        n = arr.shape[0]
+        fut = ServingFuture(self.model.name)
+        with self._cond:
+            if self._draining or self._stopping:
+                self.metrics.record_reject()
+                raise ServerDrainingError(self.model.name)
+            if self._rows + n > self._max_queue:
+                self.metrics.record_reject()
+                raise ServerBusyError(self.model.name, self._rows,
+                                      self._max_queue)
+            self._queue.append(_Request(arr, n, fut))
+            self._rows += n
+            self._cond.notify_all()
+        self.metrics.record_submit()
+        return fut
+
+    # --------------------------------------------------------- collector --
+    def _collect(self):
+        """Pop one coalesced batch (requests, rows) under the admission
+        deadline, or None when stopping."""
+        with self._cond:
+            while True:
+                while not self._queue:
+                    if self._stopping:
+                        return None
+                    self._cond.wait(timeout=0.1)
+                cap = self.model.max_bucket
+                deadline = self._queue[0].fut.t_submit + self._max_wait
+                while (self._queue and self._rows < cap
+                       and not self._stopping and not self._draining):
+                    now = time.monotonic()
+                    if now >= deadline:
+                        break
+                    self._cond.wait(timeout=min(deadline - now, 0.05))
+                if self._queue:
+                    break  # else: raced with stop()'s clear; re-wait
+            reqs, rows = [], 0
+            while self._queue and rows + self._queue[0].n <= cap:
+                r = self._queue.popleft()
+                reqs.append(r)
+                rows += r.n
+            self._rows -= rows
+            self._inflight += 1
+            return reqs, rows
+
+    def _pad(self, reqs, rows, bucket):
+        shape = (bucket,) + self.model.example_shape
+        out = _np.zeros(shape, dtype=self.model.dtype)
+        off = 0
+        for r in reqs:
+            out[off:off + r.n] = r.arr
+            off += r.n
+        return out
+
+    def _collect_loop(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            reqs, rows = batch
+            bucket = self.model.bucket_for(rows)
+            x = self._pad(reqs, rows, bucket)
+            if self._stager is not None:
+                # h2d on this thread overlaps the runner's compiled call
+                try:
+                    x = self._stager.put(x)
+                except Exception:
+                    pass  # staging is an optimisation; jit transfers too
+            while True:
+                try:
+                    self._staged.put((reqs, x, rows, bucket), timeout=0.25)
+                    break
+                except _qmod.Full:
+                    if self._stopping:
+                        self._fail_batch(reqs, ServerDrainingError(
+                            self.model.name, "stopped"))
+                        return
+
+    # ------------------------------------------------------------ runner --
+    def _fail_batch(self, reqs, err):
+        for r in reqs:
+            r.fut._fail(err)
+        self.metrics.record_fail(len(reqs))
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def _run_loop(self):
+        from .. import faults as _faults
+        from .. import watchdog as _watchdog
+
+        model = self.model
+        while True:
+            try:
+                item = self._staged.get(timeout=0.25)
+            except _qmod.Empty:
+                if self._stopping and not self._collector.is_alive():
+                    return
+                continue
+            reqs, x, rows, bucket = item
+
+            def run():
+                # 'serving.batch' injection: raise = failed batch, hang =
+                # the wedged-device scenario the watchdog converts into a
+                # crash bundle + StallError, preempt = SIGTERM mid-load
+                _faults.point("serving.batch")
+                return model.run(x, rows)
+
+            t0 = time.monotonic()
+            try:
+                outs = _watchdog.sync(
+                    "serving.batch", run,
+                    label=f"{model.name} bucket={bucket} rows={rows}")
+            except BaseException as e:
+                if isinstance(e, _watchdog.StallError):
+                    self.metrics.record_stall()
+                self._fail_batch(reqs, RequestError(
+                    f"model {model.name!r}: batch of {rows} rows failed: "
+                    f"{type(e).__name__}: {e}", cause=e))
+                continue
+            dur_ms = (time.monotonic() - t0) * 1e3
+            off = 0
+            now = time.monotonic()
+            for r in reqs:
+                sliced = [o[off:off + r.n] for o in outs]
+                r.fut._fulfill(sliced[0] if len(sliced) == 1 else sliced)
+                off += r.n
+                self.metrics.record_complete((now - r.fut.t_submit) * 1e3)
+            self.metrics.record_batch(bucket, rows, dur_ms,
+                                      self.queue_depth())
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()

@@ -1,0 +1,173 @@
+"""Serving knobs: defaults + the ``MXNET_TPU_SERVING`` env grammar.
+
+Mirrors the ``MXNET_TPU_FAULTS`` / ``MXNET_TPU_WATCHDOG`` convention: one
+environment variable, read once at first use (so subprocesses inherit a
+configuration), overridable programmatically via :func:`configure`.
+Entries are separated by ``,`` or ``;``::
+
+    buckets:<b1|b2|...>   padded batch buckets compiled per model
+                          (default 2|4|8|16|32 — the smallest bucket is 2
+                          so every request takes XLA's GEMM kernel path;
+                          a 1-row bucket takes the GEMV path whose
+                          last-bit rounding differs, breaking the
+                          bit-identical-across-batch-mates guarantee)
+    max_queue:<N>         admission bound: rows waiting per model before
+                          submit() fast-rejects with ServerBusyError
+                          (default 1024)
+    max_wait_ms:<F>       continuous-batching coalescing window: how long
+                          the collector holds an underfull batch waiting
+                          for batch-mates (default 2.0)
+    timeout_ms:<F>        default ServingFuture.result() deadline — every
+                          client wait is bounded (default 30000)
+    stage:<0|1>           device-put staging thread (h2d overlaps the
+                          in-flight compiled batch; default 1)
+
+Examples::
+
+    MXNET_TPU_SERVING="buckets:2|8|32,max_wait_ms:5"
+    serving.configure({"max_queue": 64}, max_wait_ms=1.0)
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+__all__ = ["configure", "configure_from_env", "effective", "describe",
+           "DEFAULTS"]
+
+ENV = "MXNET_TPU_SERVING"
+
+DEFAULTS = {
+    "buckets": (2, 4, 8, 16, 32),
+    "max_queue": 1024,
+    "max_wait_ms": 2.0,
+    "timeout_ms": 30000.0,
+    "stage": True,
+}
+
+_lock = threading.Lock()
+_CFG: dict | None = None
+_loaded_env = False
+
+
+def _parse_buckets(val):
+    try:
+        buckets = tuple(sorted({int(b) for b in val.split("|") if b.strip()}))
+    except ValueError:
+        raise ValueError(f"bad serving buckets {val!r}: expected "
+                         "'|'-separated integers, e.g. buckets:2|4|8")
+    if not buckets or any(b < 1 for b in buckets):
+        raise ValueError(f"bad serving buckets {val!r}: need at least one "
+                         "positive batch size")
+    return buckets
+
+
+def _coerce(key, val):
+    if key == "buckets":
+        if isinstance(val, str):
+            return _parse_buckets(val)
+        buckets = tuple(sorted({int(b) for b in val}))
+        if not buckets or any(b < 1 for b in buckets):
+            raise ValueError(f"bad serving buckets {val!r}")
+        return buckets
+    if key in ("max_queue",):
+        n = int(val)
+        if n < 1:
+            raise ValueError(f"serving {key} must be >= 1, got {n}")
+        return n
+    if key in ("max_wait_ms", "timeout_ms"):
+        f = float(val)
+        if f < 0:
+            raise ValueError(f"serving {key} must be >= 0, got {f}")
+        return f
+    if key == "stage":
+        if isinstance(val, str):
+            return val.strip().lower() not in ("0", "false", "off", "no")
+        return bool(val)
+    raise ValueError(
+        f"unknown serving option {key!r}; expected one of {sorted(DEFAULTS)}")
+
+
+def _parse(spec):
+    cfg = dict(DEFAULTS)
+    for entry in re.split(r"[;,]", spec):
+        entry = entry.strip()
+        if not entry:
+            continue
+        key, sep, val = entry.partition(":")
+        key, val = key.strip(), val.strip()
+        if not sep or not val:
+            raise ValueError(
+                f"bad {ENV} entry {entry!r}: expected <option>:<value>")
+        cfg[key] = _coerce(key, val)
+    return cfg
+
+
+def configure(spec=None, **options):
+    """Install a serving configuration (replacing any previous one).
+
+    spec : str in the grammar above, dict ``{option: value}``, or None to
+        fall back to the defaults. ``options`` keyword overrides apply on
+        top. Pass nothing at all to reset to defaults/env precedence.
+    """
+    global _CFG, _loaded_env
+    if isinstance(spec, dict):
+        cfg = dict(DEFAULTS)
+        for k, v in spec.items():
+            cfg[k] = _coerce(k, v)
+    elif spec:
+        cfg = _parse(spec)
+    else:
+        cfg = dict(DEFAULTS)
+    for k, v in options.items():
+        cfg[k] = _coerce(k, v)
+    with _lock:
+        _loaded_env = True  # explicit configure overrides the env
+        _CFG = cfg
+    return dict(cfg)
+
+
+def configure_from_env(force=True):
+    """(Re-)read ``MXNET_TPU_SERVING`` — tests use it to restore the
+    ambient configuration after exercising explicit ones."""
+    global _loaded_env, _CFG
+    if force:
+        with _lock:
+            _loaded_env = False
+            _CFG = None
+    _ensure_env()
+
+
+def _ensure_env():
+    global _loaded_env, _CFG
+    if _loaded_env:
+        return
+    with _lock:
+        if _loaded_env:
+            return
+        _loaded_env = True
+        env = os.environ.get(ENV, "")
+        if env:
+            try:
+                _CFG = _parse(env)
+            except ValueError as e:
+                from .. import log as _log
+
+                _log.get_logger("mxnet_tpu.serving").warning(
+                    "ignoring invalid %s: %s", ENV, e)
+                _CFG = None
+
+
+def effective() -> dict:
+    """The effective configuration dict (env-seeded, configure-overridden)."""
+    _ensure_env()
+    cfg = _CFG
+    return dict(cfg) if cfg is not None else dict(DEFAULTS)
+
+
+def describe() -> dict:
+    """Knobs + provenance for ``tools/diagnose.py``."""
+    out = effective()
+    out["env"] = os.environ.get(ENV, "<unset>")
+    return out

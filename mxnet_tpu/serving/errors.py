@@ -1,0 +1,68 @@
+"""Serving error taxonomy — every failure a client can see, typed.
+
+The shape mirrors an HTTP predict front end (the codes the http module
+maps them to): admission rejects are *fast* (429/503 analogues raised at
+``submit`` time, never after queueing), execution failures carry their
+cause, and every client wait is deadline-bounded (:class:`RequestTimeout`
+instead of a hung caller).
+"""
+from __future__ import annotations
+
+__all__ = ["ServingError", "ModelNotFound", "ServerBusyError",
+           "ServerDrainingError", "RequestError", "RequestTimeout"]
+
+
+class ServingError(RuntimeError):
+    """Base class for every serving-layer error."""
+
+
+class ModelNotFound(ServingError):
+    """The named model is not in the served container (HTTP 404)."""
+
+
+class ServerBusyError(ServingError):
+    """Admission control fast-reject: the model's queue-depth bound is
+    full (HTTP 429). Raised AT submit time — an overloaded server sheds
+    load immediately instead of growing an unbounded queue whose tail
+    latency nobody can meet. Attributes: ``model``, ``depth`` (rows
+    waiting), ``limit``."""
+
+    def __init__(self, model, depth, limit):
+        self.model = model
+        self.depth = depth
+        self.limit = limit
+        super().__init__(
+            f"model {model!r} queue is full ({depth}/{limit} rows waiting)"
+            " — retry with backoff (HTTP 429 analogue)")
+
+
+class ServerDrainingError(ServerBusyError):
+    """Admission stopped: the server is draining for shutdown/preemption
+    (HTTP 503). In-flight and queued requests still complete; new ones
+    must go to another replica."""
+
+    def __init__(self, model, reason="draining"):
+        self.model = model
+        self.depth = 0
+        self.limit = 0
+        ServingError.__init__(
+            self, f"model {model!r} not admitting requests ({reason}) — "
+            "the server is shutting down; retry against another replica")
+
+
+class RequestError(ServingError):
+    """The batch this request was coalesced into failed (injected fault,
+    watchdog StallError, bad input discovered at execution). The
+    underlying exception is ``cause`` (and ``__cause__``)."""
+
+    def __init__(self, message, cause=None):
+        self.cause = cause
+        super().__init__(message)
+        if cause is not None:
+            self.__cause__ = cause
+
+
+class RequestTimeout(ServingError):
+    """ServingFuture.result() deadline expired before the response
+    arrived. The request may still complete server-side; the client-side
+    wait is bounded by construction."""

@@ -1,0 +1,139 @@
+"""Minimal HTTP/JSON front end over a ModelServer.
+
+Endpoints (TF-Serving-flavoured paths, JSON bodies)::
+
+    POST /v1/models/<name>:predict   {"data": [[...], ...]}
+                                     -> {"model":..., "outputs": [[...]]}
+    GET  /v1/models                  -> {"models": [...]}
+    GET  /v1/stats                   -> ModelServer.stats()
+    GET  /healthz                    -> {"status": "ok"|"draining"}
+
+Error mapping — the typed serving errors become the status codes a
+load balancer expects: unknown model 404, admission fast-reject 429
+(with Retry-After), draining 503, request deadline 504, failed batch
+500.
+
+This front end exists so external clients (and ``tools/loadgen.py``'s
+socket mode) can drive the server; the throughput path is the
+in-process API. Serving a request is one bounded ``server.predict`` —
+the handler threads (ThreadingHTTPServer) never wait unbounded.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from .errors import (ModelNotFound, RequestError, RequestTimeout,
+                     ServerBusyError, ServerDrainingError)
+
+__all__ = ["HttpFrontEnd"]
+
+_PREDICT_RE = re.compile(r"^/(?:v1/models|models|predict)/([^/:]+)"
+                         r"(?::predict)?$")
+
+
+class HttpFrontEnd:
+    """Bind a ModelServer to a local HTTP port (``port=0`` picks one)."""
+
+    def __init__(self, server, host="127.0.0.1", port=0, timeout=None):
+        self._server = server
+        self._timeout = timeout
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "mxtpu-serving/0.1"
+
+            def log_message(self, *args):  # stay quiet under load
+                pass
+
+            def _json(self, code, payload, extra_headers=()):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                srv = front._server
+                if self.path == "/healthz":
+                    self._json(200, {"status": "draining" if srv.draining
+                                     else "ok"})
+                elif self.path in ("/v1/models", "/models"):
+                    self._json(200, {"models": srv.models()})
+                elif self.path in ("/v1/stats", "/stats"):
+                    self._json(200, srv.stats())
+                else:
+                    self._json(404, {"error": f"no route {self.path!r}"})
+
+            def do_POST(self):
+                srv = front._server
+                m = _PREDICT_RE.match(self.path)
+                if not m:
+                    self._json(404, {"error": f"no route {self.path!r}"})
+                    return
+                name = m.group(1)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    arr = _np.asarray(payload["data"])
+                except (ValueError, KeyError, TypeError) as e:
+                    self._json(400, {"error": f"bad request body: {e}"})
+                    return
+                try:
+                    out = srv.predict(name, arr, timeout=front._timeout)
+                except ModelNotFound as e:
+                    self._json(404, {"error": str(e)})
+                except ServerDrainingError as e:
+                    self._json(503, {"error": str(e)},
+                               extra_headers=[("Retry-After", "1")])
+                except ServerBusyError as e:
+                    self._json(429, {"error": str(e)},
+                               extra_headers=[("Retry-After", "0.1")])
+                except RequestTimeout as e:
+                    self._json(504, {"error": str(e)})
+                except (RequestError, ValueError) as e:
+                    code = 400 if isinstance(e, ValueError) else 500
+                    self._json(code, {"error": str(e)})
+                else:
+                    outs = out if isinstance(out, list) else [out]
+                    self._json(200, {"model": name,
+                                     "outputs": [o.tolist() for o in outs]})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+                daemon=True, name="mxtpu-serving-http")
+            self._thread.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

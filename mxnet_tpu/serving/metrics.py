@@ -1,0 +1,125 @@
+"""Per-model serving observability: latency percentiles, throughput,
+queue depth, bucket census, batch fill ratio.
+
+Counters are plain ints under one lock (the per-request cost is two lock
+acquisitions — submit and complete); latencies go into a bounded ring so
+a long-running server computes percentiles over recent traffic, not its
+whole life. Everything flows into the existing profiler when a session is
+recording (``serving[<model>]`` complete events + ``serving.<model>.*``
+counter tracks via :func:`mxnet_tpu.profiler.record_serving`), and into
+``tools/diagnose.py``'s "Serving" report via :meth:`snapshot`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+
+__all__ = ["ModelMetrics", "percentile"]
+
+_RING = 8192  # recent-latency window for percentiles
+
+
+def percentile(values, q):
+    """Nearest-rank percentile of a sequence (no numpy dependency on the
+    hot path; called only at snapshot time)."""
+    if not values:
+        return None
+    xs = sorted(values)
+    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+class ModelMetrics:
+    """Thread-safe serving counters for one served model."""
+
+    def __init__(self, model):
+        self.model = model
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0        # admission fast-rejects (busy + draining)
+        self.failed = 0          # requests failed by a failed batch
+        self.stalled = 0         # batches killed by a watchdog StallError
+        self.batches = 0
+        self.rows = 0            # real rows through compiled batches
+        self.padded_rows = 0     # padding rows (bucket - rows per batch)
+        self.bucket_census = Counter()
+        self._lat_ms = deque(maxlen=_RING)
+        self._t_first = None     # first completion (rps window start)
+        self._t_last = None
+
+    # ------------------------------------------------------- recording ---
+    def record_submit(self):
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self):
+        with self._lock:
+            self.rejected += 1
+        from .. import profiler as _profiler
+
+        if _profiler._RECORDING:
+            _profiler.record_instant(f"serving.{self.model}.reject",
+                                     cat="serving")
+
+    def record_complete(self, lat_ms):
+        now = time.monotonic()
+        with self._lock:
+            self.completed += 1
+            self._lat_ms.append(lat_ms)
+            if self._t_first is None:
+                self._t_first = now
+            self._t_last = now
+
+    def record_fail(self, n=1):
+        with self._lock:
+            self.failed += n
+
+    def record_batch(self, bucket, rows, dur_ms, queue_depth):
+        with self._lock:
+            self.batches += 1
+            self.rows += rows
+            self.padded_rows += bucket - rows
+            self.bucket_census[bucket] += 1
+        from .. import profiler as _profiler
+
+        if _profiler._RECORDING:
+            _profiler.record_serving(self.model, bucket, rows, dur_ms,
+                                     queue_depth)
+
+    def record_stall(self):
+        with self._lock:
+            self.stalled += 1
+
+    # -------------------------------------------------------- snapshot ---
+    def snapshot(self, **extra):
+        """One JSON-able dict: counters + p50/p95/p99 over the recent
+        window + batch fill ratio + completion-window rps. ``extra``
+        (live queue depth etc.) is merged in by the caller."""
+        with self._lock:
+            lat = list(self._lat_ms)
+            padded = self.rows + self.padded_rows
+            window = (self._t_last - self._t_first) \
+                if (self._t_first is not None
+                    and self._t_last is not None
+                    and self._t_last > self._t_first) else None
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "failed": self.failed,
+                "stalled_batches": self.stalled,
+                "batches": self.batches,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "batch_fill_ratio": round(self.rows / padded, 4)
+                if padded else None,
+                "bucket_census": dict(sorted(self.bucket_census.items())),
+                "rps": round(self.completed / window, 2) if window else None,
+            }
+        for q, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+            v = percentile(lat, q)
+            out[key] = round(v, 3) if v is not None else None
+        out.update(extra)
+        return out

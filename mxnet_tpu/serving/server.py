@@ -1,0 +1,191 @@
+"""ModelServer: the multi-tenant front door over a ModelContainer.
+
+One :class:`~mxnet_tpu.serving.batcher.BucketBatcher` per model — so one
+model's stall can NEVER block another's queue (per-model collector and
+runner threads, per-model admission bounds). The server adds:
+
+* **submit/predict** routing (unknown model → :class:`ModelNotFound`),
+* aggregate **stats()** (per-model latency percentiles, throughput,
+  queue depth, bucket census, fill ratio — the diagnose "Serving"
+  report and the loadgen/bench numbers),
+* the **drain** protocol: stop admission, answer every admitted request
+  (queued and in flight), stop workers. :meth:`run_until_drained` wires
+  it to :mod:`mxnet_tpu.preempt` — a SIGTERM under load finishes what
+  was admitted and the process exits 75 (``EX_TEMPFAIL``, the
+  reschedule-me code the whole stack uses).
+
+Live servers register in a weak set so ``tools/diagnose.py`` can report
+queue depths / rejects / the last drain from inside a serving process.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from .batcher import BucketBatcher
+from .errors import ModelNotFound
+
+__all__ = ["ModelServer", "live_servers", "live_stats"]
+
+_LIVE = weakref.WeakSet()
+
+
+def live_servers():
+    """ModelServer instances alive in this process (diagnose)."""
+    return list(_LIVE)
+
+
+def live_stats():
+    """stats() of every live server (diagnose's Serving report)."""
+    return [s.stats() for s in live_servers()]
+
+
+class ModelServer:
+    """Serve every model in a :class:`ModelContainer` with continuous
+    batching, admission control and bounded tail latency."""
+
+    def __init__(self, container, max_queue=None, max_wait_ms=None,
+                 stage=None, name="mxtpu-server"):
+        self.name = name
+        self._container = container
+        self._overrides = {"max_queue": max_queue,
+                           "max_wait_ms": max_wait_ms, "stage": stage}
+        self._batchers = {}
+        self._started = False
+        self._draining = False
+        self._t_start = None
+        self._drain_event = None
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- lifecycle --
+    def start(self):
+        with self._lock:
+            if self._started:
+                return self
+            for model in self._container:
+                self._batchers[model.name] = BucketBatcher(
+                    model, **self._overrides).start()
+            self._started = True
+            self._t_start = time.monotonic()
+        _LIVE.add(self)
+        return self
+
+    def warmup(self):
+        """Pre-compile every model's bucket ladder (+ replay the compile
+        service's warmup manifest) BEFORE admitting traffic."""
+        return self._container.warmup()
+
+    @property
+    def started(self):
+        return self._started
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def container(self):
+        return self._container
+
+    def models(self):
+        return list(self._batchers) if self._batchers \
+            else self._container.names()
+
+    # ------------------------------------------------------------ serving --
+    def _batcher(self, model):
+        b = self._batchers.get(model)
+        if b is None:
+            if not self._started:
+                raise RuntimeError(f"server {self.name!r} not started")
+            raise ModelNotFound(
+                f"model {model!r} not served; available: "
+                f"{sorted(self._batchers)}")
+        return b
+
+    def submit(self, model, arr):
+        """Admit one request; returns a
+        :class:`~mxnet_tpu.serving.batcher.ServingFuture`. Fast-rejects
+        with ServerBusyError / ServerDrainingError — never queues beyond
+        the per-model bound."""
+        return self._batcher(model).submit(arr)
+
+    def predict(self, model, arr, timeout=None):
+        """Synchronous submit + bounded wait."""
+        return self.submit(model, arr).result(timeout)
+
+    # -------------------------------------------------------------- drain --
+    def drain(self, timeout=30.0):
+        """Stop admission on every model, answer everything admitted,
+        stop the workers. Returns True when fully drained in time. The
+        SIGTERM path: ``preempt`` raises the flag, the serving loop calls
+        this, then exits 75 for the gang scheduler to reschedule."""
+        self._draining = True
+        ok = True
+        for b in self._batchers.values():
+            ok = b.drain(timeout=timeout) and ok
+        answered = sum(b.metrics.completed for b in self._batchers.values())
+        failed = sum(b.metrics.failed for b in self._batchers.values())
+        for b in self._batchers.values():
+            b.stop()
+        self._drain_event = {"time": time.time(), "drained": ok,
+                             "answered": answered, "failed": failed}
+        from .. import profiler as _profiler
+
+        if _profiler._RECORDING:
+            _profiler.record_instant(f"serving.{self.name}.drain",
+                                     cat="serving", args=self._drain_event)
+        return ok
+
+    def stop(self):
+        """Hard stop (drainless): queued requests fail. Prefer
+        drain() → stop() — stop after a drain is a no-op join."""
+        for b in self._batchers.values():
+            b.stop()
+        self._started = False
+        _LIVE.discard(self)
+
+    def run_until_drained(self, poll=0.05, install=True, exit=False):
+        """Block until a preemption drain is requested (SIGTERM through
+        :mod:`mxnet_tpu.preempt`, or ``preempt.request()``), then drain
+        and hand off to ``preempt.drain`` — which records the drain event
+        and, with ``exit=True``, raises ``SystemExit(75)`` so the
+        supervisor reschedules. Returns the drain-event dict when
+        ``exit=False``."""
+        from .. import preempt as _preempt
+
+        if install:
+            _preempt.install()
+        while not _preempt.requested():
+            time.sleep(poll)
+        ok = self.drain()
+        ev = _preempt.drain(save=False, exit=exit)
+        if isinstance(ev, dict):
+            ev["serving"] = dict(self._drain_event or {},
+                                 drained=ok)
+        return ev
+
+    # -------------------------------------------------------------- stats --
+    def stats(self):
+        """Aggregate observability snapshot (diagnose / loadgen / bench):
+        per-model p50/p95/p99 latency, rps, queue depth, bucket census,
+        batch fill ratio, rejects, stalls + the last drain event."""
+        models = {}
+        for name, b in self._batchers.items():
+            models[name] = b.metrics.snapshot(
+                queue_depth=b.queue_depth(),
+                buckets=list(b.model.buckets),
+                draining=b.draining)
+        return {
+            "name": self.name,
+            "started": self._started,
+            "draining": self._draining,
+            "uptime_s": round(time.monotonic() - self._t_start, 1)
+            if self._t_start else None,
+            "models": models,
+            "last_drain": self._drain_event,
+        }
+
+    def __repr__(self):
+        return (f"ModelServer({self.name!r}, "
+                f"models={self.models()}, started={self._started})")

@@ -16,6 +16,10 @@ this library that can block:
     ``kvstore.push`` / ``kvstore.pull``   liveness heartbeats only (the
                        aggregation itself is eager NDArray math; deadlines
                        apply to the blocking spans above)
+    ``serving.batch``  one in-flight predict-server batch (serving/
+                       batcher.py) — a wedged batch becomes a crash
+                       bundle + StallError; the batch's requests fail
+                       typed and the server keeps serving
 
 Three cooperating pieces:
 

@@ -175,6 +175,54 @@ def test_mxlint_raw_jit_rule_scoping(tmp_path):
 
 
 @pytest.mark.lint
+def test_mxlint_serving_blocking_call_rule(tmp_path):
+    """serving-blocking-call: serving/ code may not block outside a
+    watchdog.sync span — device syncs and zero-arg waits fire; callables
+    passed to *.sync(...) (lambda or by name) are exempt, as is the same
+    code outside serving/."""
+    import mxlint
+
+    serving_dir = tmp_path / "mxnet_tpu" / "serving"
+    serving_dir.mkdir(parents=True)
+    bad = serving_dir / "bad.py"
+    bad.write_text(
+        "def f(x, t, q):\n"
+        "    x.wait_to_read()\n"        # device sync
+        "    jax.block_until_ready(x)\n"  # device sync
+        "    t.join()\n"                # zero-arg unbounded wait
+        "    q.get()\n"                 # zero-arg unbounded wait
+        "    t.join(timeout=1.0)\n"     # bounded: clean
+        "    q.get(timeout=0.5)\n"      # bounded: clean
+    )
+    findings = [f for f in mxlint.run([str(bad)], root=str(tmp_path))
+                if f.rule == "serving-blocking-call"]
+    assert len(findings) == 4
+    assert "bounded-tail-latency" in findings[0].message
+    # the watchdog.sync exemption: inline lambda AND a local fn by name
+    ok = serving_dir / "ok.py"
+    ok.write_text(
+        "def g(model, x, w):\n"
+        "    def run():\n"
+        "        out = model(x)\n"
+        "        jax.block_until_ready(out)\n"
+        "        return out\n"
+        "    a = w.sync('serving.batch', run)\n"
+        "    b = w.sync('serving.batch', lambda: x.wait_to_read())\n"
+        "    return a, b\n")
+    assert [f for f in mxlint.run([str(ok)], root=str(tmp_path))
+            if f.rule == "serving-blocking-call"] == []
+    # identical blocking code OUTSIDE serving/ is not this rule's business
+    other = tmp_path / "mxnet_tpu" / "elsewhere.py"
+    other.write_text("def f(x):\n    x.wait_to_read()\n")
+    assert [f for f in mxlint.run([str(other)], root=str(tmp_path))
+            if f.rule == "serving-blocking-call"] == []
+    # the real serving package is clean under the rule
+    findings = [f for f in mxlint.run(["mxnet_tpu/serving"])
+                if f.rule == "serving-blocking-call"]
+    assert findings == [], findings
+
+
+@pytest.mark.lint
 def test_mxlint_baseline_gate_blocks_regressions(tmp_path):
     """Baseline semantics: within-count passes, one extra finding fails."""
     import mxlint
@@ -215,9 +263,11 @@ def test_chaos_smoke_recovers(tmp_path):
     schedule — NaN guard absorbs a poisoned batch, checkpoint-write
     retry absorbs an injected write failure, an injected crash is
     recovered via CheckpointManager resume, an injected hang surfaces as
-    a StallError + bundle, and an injected SIGTERM preemption drains
-    gracefully and resumes resharded on half the simulated devices —
-    exit code 0."""
+    a StallError + bundle, an injected SIGTERM preemption drains
+    gracefully and resumes resharded on half the simulated devices, and
+    the phase-6 serving drill passes (wedged serving batch -> bundle +
+    continued service; subprocess SIGTERM under load -> all admitted
+    requests answered, exit 75) — exit code 0."""
     import chaos_smoke
 
     from mxnet_tpu import faults, preempt
@@ -233,3 +283,7 @@ def test_chaos_smoke_recovers(tmp_path):
     assert (tmp_path / "MANIFEST.json").exists()
     # phase 4 left a drain-event record next to the checkpoints
     assert any(f.startswith("drain-") for f in os.listdir(tmp_path))
+    # phase 6 wrote a serving-stall crash bundle into the crash dir
+    crash = tmp_path / "crash"
+    assert crash.is_dir() and any(
+        "serving_batch" in f for f in os.listdir(crash))

@@ -19,6 +19,12 @@ Proves the fault-tolerance stack end to end on one machine, fast:
   * a MISCONFIGURED mesh (sharding rule naming an axis the mesh does not
     have) refused by the distcheck analyzer BEFORE anything compiles,
     with a param-named did-you-mean diagnostic,
+  * the SERVING drill (phase 6): a model server's in-flight batch is
+    wedged by an injected ``serving.batch`` hang — the watchdog writes a
+    crash bundle, the batch's requests fail typed, and the server KEEPS
+    SERVING; then, in a subprocess, SIGTERM lands mid-load — admission
+    stops, every admitted request is answered, and the process exits 75
+    for the gang scheduler (``--serve-drill`` is that child's entry),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -69,6 +75,78 @@ def build(seed, mesh=None):
     return net, trainer
 
 
+def serve_drill(seed=0):
+    """The phase-6 child: a 1-model server under closed-loop load takes
+    a SIGTERM mid-run; the drain must answer every admitted request and
+    the process must exit preempt.exit_code() (75). Prints one
+    ``SERVE_DRILL {...}`` JSON line for the parent to verify."""
+    import json
+    import signal
+    import threading
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import preempt, serving
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    container = serving.ModelContainer()
+    container.add_block("drill", net, example_shape=(8,), buckets=(2, 4, 8))
+    server = serving.ModelServer(container, max_wait_ms=1.0).start()
+    server.warmup()
+    if not preempt.install():
+        print("SERVE_DRILL " + json.dumps({"error": "no signal handlers"}))
+        return 1
+
+    pool = [np.random.RandomState(i).randn(1, 8).astype(np.float32)
+            for i in range(8)]
+    futures, flock = [], threading.Lock()
+    stop = threading.Event()
+
+    def load_worker(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                fut = server.submit("drill", pool[(tid + i) % len(pool)])
+            except serving.ServerDrainingError:
+                return  # admission stopped: the drain is under way
+            with flock:
+                futures.append(fut)
+            i += 1
+            time.sleep(0.002)
+
+    workers = [threading.Thread(target=load_worker, args=(t,), daemon=True)
+               for t in range(4)]
+    for w in workers:
+        w.start()
+    time.sleep(0.4)  # get a steady stream of admitted requests going
+    os.kill(os.getpid(), signal.SIGTERM)  # the platform preempts us
+    while not preempt.requested():
+        time.sleep(0.01)
+    drained = server.drain(timeout=30.0)
+    stop.set()
+    for w in workers:
+        w.join(timeout=5.0)
+    with flock:
+        admitted = len(futures)
+        answered = sum(1 for f in futures if f.done()
+                       and f._error is None)
+    report = {"admitted": admitted, "answered": answered,
+              "drained": bool(drained),
+              "exit_code": preempt.exit_code()}
+    print("SERVE_DRILL " + json.dumps(report), flush=True)
+    if not (drained and admitted and answered == admitted):
+        return 1
+    # records the drain event and raises SystemExit(75) for the wrapper
+    preempt.drain(save=False)
+    return 1  # unreachable: drain() exits
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--epochs", type=int, default=2)
@@ -76,7 +154,16 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--dir", default=None,
                         help="checkpoint directory (default: a tempdir)")
+    parser.add_argument("--serve-drill", action="store_true",
+                        help="run only the phase-6 SIGTERM-under-load "
+                             "child (exits 75 on success)")
+    parser.add_argument("--skip-serve-drill", action="store_true",
+                        help="skip the phase-6 subprocess half (in-process "
+                             "CI harnesses that cannot spawn)")
     args = parser.parse_args(argv)
+
+    if args.serve_drill:
+        return serve_drill(seed=args.seed)
 
     import numpy as np
 
@@ -247,6 +334,79 @@ def main(argv=None):
             print(f"FAIL: distcheck refusal lacks a named diagnostic: {e}")
             return 1
         print(f"  distcheck refused the bad mesh config: {bad[0]}")
+
+    # phase 6: serving — (a) an injected serving.batch hang is caught by
+    # the watchdog (crash bundle + typed request failure) and the server
+    # KEEPS SERVING; (b) in a subprocess, SIGTERM mid-load drains
+    # gracefully (all admitted requests answered) and exits 75
+    from mxnet_tpu import serving, watchdog as _wd
+
+    mx.random.seed(args.seed + 7)
+    serve_net = gluon.nn.HybridSequential()
+    serve_net.add(gluon.nn.Dense(16, activation="relu"),
+                  gluon.nn.Dense(4))
+    serve_net.initialize(mx.init.Xavier())
+    serve_net(mx.nd.zeros((2, 8)))
+    scontainer = serving.ModelContainer()
+    scontainer.add_block("chaos", serve_net, example_shape=(8,),
+                         buckets=(2, 4))
+    sserver = serving.ModelServer(scontainer, max_wait_ms=1.0).start()
+    sserver.warmup()
+    serve_hang = 2.0
+    _wd.configure({"serving.batch": 0.6},
+                  crash_dir=os.path.join(ckpt_dir, "crash"), interval=0.1)
+    faults.configure(f"serving.batch:hang@1:{serve_hang}", seed=args.seed)
+    xs = np.random.RandomState(args.seed).randn(1, 8).astype(np.float32)
+    fut = sserver.submit("chaos", xs)
+    try:
+        fut.result(timeout=10.0)
+        print("FAIL: the injected serving hang was not detected")
+        return 1
+    except serving.RequestError as e:
+        if not isinstance(e.cause, _wd.StallError):
+            print(f"FAIL: serving batch failed without a StallError: {e}")
+            return 1
+        if not (e.cause.bundle and os.path.isdir(e.cause.bundle)):
+            print("FAIL: no crash bundle for the serving stall")
+            return 1
+        print(f"  serving watchdog caught the wedged batch: {e.cause}")
+    faults.reset()
+    _wd.configure(None)
+    time.sleep(serve_hang + 0.5)  # let the abandoned waiter drain out
+    y = sserver.predict("chaos", xs, timeout=10.0)  # server kept serving
+    if y.shape != (1, 4):
+        print(f"FAIL: post-stall predict shape {y.shape}")
+        return 1
+    print("  server kept serving after the stall "
+          f"(stats: {sserver.stats()['models']['chaos']['stalled_batches']}"
+          " stalled batch)")
+    sserver.drain(timeout=10.0)
+
+    if not args.skip_serve_drill:
+        import json as _json
+        import subprocess
+        import sys as _sys
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the drill must see pristine fault/watchdog state
+        env.pop("MXNET_TPU_FAULTS", None)
+        proc = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__), "--serve-drill",
+             "--seed", str(args.seed)],
+            capture_output=True, text=True, timeout=300, env=env)
+        lines = [l for l in proc.stdout.splitlines()
+                 if l.startswith("SERVE_DRILL ")]
+        if proc.returncode != 75 or not lines:
+            print(f"FAIL: serve drill rc={proc.returncode} (want 75)\n"
+                  f"stdout={proc.stdout}\nstderr={proc.stderr[-2000:]}")
+            return 1
+        drill = _json.loads(lines[-1].split(" ", 1)[1])
+        if not drill["admitted"] or drill["answered"] != drill["admitted"]:
+            print(f"FAIL: serve drill dropped requests: {drill}")
+            return 1
+        print(f"  SIGTERM-under-load drill: {drill['answered']}/"
+              f"{drill['admitted']} admitted requests answered, exit 75")
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

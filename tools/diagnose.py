@@ -193,6 +193,49 @@ def check_compile_cache(gc=False):
         print("distcheck import failed:", e)
 
 
+def check_serving():
+    """Serving knobs + live server state (queue depths, bucket census,
+    admission rejects, tail latency) + the last drain event. Live stats
+    only exist inside a serving process; the knobs and the drain record
+    persist."""
+    print("---------Serving Knobs---------")
+    print(f"MXNET_TPU_SERVING={os.environ.get('MXNET_TPU_SERVING', '<unset>')}  "
+          "(buckets / max_queue / max_wait_ms / timeout_ms / stage — "
+          "docs/SERVING.md)")
+    try:
+        from mxnet_tpu import serving
+
+        print("effective     :", serving.describe())
+        live = serving.live_stats()
+        if not live:
+            print("live servers  : none in this process")
+        for srv in live:
+            print(f"server {srv['name']!r}: started={srv['started']} "
+                  f"draining={srv['draining']} "
+                  f"uptime={srv['uptime_s']}s")
+            print(f"  {'model':<20s} {'queue':>6s} {'done':>8s} "
+                  f"{'rej':>6s} {'fail':>5s} {'stall':>5s} {'fill':>6s} "
+                  f"{'p50ms':>7s} {'p99ms':>7s}")
+            for name, m in srv["models"].items():
+                print(f"  {name:<20s} {m['queue_depth']:>6d} "
+                      f"{m['completed']:>8d} {m['rejected']:>6d} "
+                      f"{m['failed']:>5d} {m['stalled_batches']:>5d} "
+                      f"{str(m['batch_fill_ratio']):>6s} "
+                      f"{str(m['p50_ms']):>7s} {str(m['p99_ms']):>7s}")
+                print(f"    bucket census: {m['bucket_census']}")
+            if srv.get("last_drain"):
+                print("  last drain  :", srv["last_drain"])
+        from mxnet_tpu import preempt as _preempt
+
+        ev = _preempt.last_drain()
+        if ev is not None:
+            print("last drain evt:", ev.get("path"),
+                  f"(cause {ev.get('signal') or ev.get('reason')}, "
+                  f"exit {ev.get('exit_code')})")
+    except ImportError as e:
+        print("serving import failed:", e)
+
+
 def check_watchdog():
     """Watchdog knobs + the most recent crash bundle, if one exists
     (docs/ROBUSTNESS.md) — the first thing to read after a wedged run."""
@@ -276,6 +319,7 @@ def main(argv=None):
     check_environment()
     check_analysis()
     check_compile_cache(gc=args.gc)
+    check_serving()
     check_watchdog()
     check_preempt()
 

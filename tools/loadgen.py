@@ -1,0 +1,358 @@
+#!/usr/bin/env python
+"""loadgen — closed + open-loop load generator for the serving stack.
+
+The acceptance harness for ROADMAP item 1 ("a load-test harness
+demonstrating sustained thousands of requests/s with bounded tail
+latency"): builds a small multi-model container in-process (or targets a
+running HTTP front end), drives it for a fixed duration, and reports
+sustained requests/s, client-side p50/p95/p99 latency, admission
+rejects, the server's batch fill ratio — and whether ANY recompile
+happened during the run (after warmup the compile service must show
+only cache hits).
+
+Modes
+-----
+closed   N worker threads, each submit → wait → repeat (throughput finds
+         the natural concurrency-limited operating point).
+open     a scheduler thread injects requests at a fixed --rate
+         regardless of completions (the tail-latency-under-offered-load
+         view); completions are collected by a waiter pool.
+
+Targets
+-------
+default      in-process ModelServer over --models small MLPs
+--via-http   same server, but driven through the JSON/HTTP front end
+             (socket path exercised end to end)
+--url URL    an already-running external front end
+
+Examples::
+
+    JAX_PLATFORMS=cpu python tools/loadgen.py --duration 30
+    python tools/loadgen.py --mode open --rate 2000 --duration 10
+    python tools/loadgen.py --via-http --duration 5
+
+The last stdout line is one JSON report (bench.py --serve embeds it into
+the BENCH_r06+ metric series).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------ demo models --
+
+def build_demo_container(models=2, dim=16, classes=10, hidden=32, seed=0,
+                         buckets=None):
+    """N distinct small MLPs — enough weight diversity that responses
+    differ per model, small enough that CPU serves thousands of rps."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon import nn
+
+    container = serving.ModelContainer()
+    for i in range(models):
+        mx.random.seed(seed + i * 101)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden + 8 * i, activation="relu"),
+                nn.Dense(classes))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((2, dim)))
+        container.add_block(f"model{i}", net, example_shape=(dim,),
+                            buckets=buckets)
+    return container
+
+
+def _percentiles(lats):
+    from mxnet_tpu.serving.metrics import percentile
+
+    return {k: (round(percentile(lats, q), 3)
+                if percentile(lats, q) is not None else None)
+            for q, k in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms"))}
+
+
+# -------------------------------------------------------------- in-process --
+
+def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
+               models=2, dim=16, warmup=True, server=None, via_http=False,
+               max_wait_ms=None):
+    """Drive a ModelServer (built here unless `server` is passed) and
+    return the report dict. With ``via_http`` the same traffic goes
+    through the JSON front end on a loopback socket."""
+    import numpy as np
+
+    from mxnet_tpu import compile as _compile
+    from mxnet_tpu import serving
+
+    own_server = server is None
+    if own_server:
+        container = build_demo_container(models=models, dim=dim)
+        server = serving.ModelServer(container).start()
+    names = server.models()
+    if warmup:
+        server.warmup()
+    pre = _compile.stats().get("serving", {})
+    pre_misses = pre.get("misses", 0)
+
+    front = None
+    if via_http:
+        front = serving.HttpFrontEnd(server).start()
+
+        def do_request(name, x):
+            import urllib.request
+
+            body = json.dumps({"data": x.tolist()}).encode()
+            req = urllib.request.Request(
+                f"{front.url}/v1/models/{name}:predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                json.loads(resp.read())
+    else:
+        def do_request(name, x):
+            server.predict(name, x, timeout=10.0)
+
+    pool = [np.random.RandomState(i).randn(1, dim).astype(np.float32)
+            for i in range(64)]
+    lock = threading.Lock()
+    lats, completed, rejected, errors = [], [0], [0], []
+    stop_at = time.perf_counter() + duration
+
+    def record(ms):
+        with lock:
+            lats.append(ms)
+            completed[0] += 1
+
+    def closed_worker(tid):
+        i = 0
+        while time.perf_counter() < stop_at:
+            name = names[(tid + i) % len(names)]
+            x = pool[(tid * 7 + i) % len(pool)]
+            t0 = time.perf_counter()
+            try:
+                do_request(name, x)
+                record((time.perf_counter() - t0) * 1e3)
+            except serving.ServerBusyError:
+                with lock:
+                    rejected[0] += 1
+                time.sleep(0.001)
+            except Exception as e:  # keep driving; report at the end
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                if len(errors) > 100:
+                    return
+            i += 1
+
+    def open_loop():
+        # scheduler: submit at the offered rate; waiter pool collects
+        import queue as qmod
+
+        inflight = qmod.Queue()
+        done = threading.Event()
+
+        def waiter():
+            while True:
+                try:
+                    item = inflight.get(timeout=0.25)
+                except qmod.Empty:
+                    if done.is_set():
+                        return
+                    continue
+                t0, fut = item
+                try:
+                    fut.result(10.0)
+                    record((time.perf_counter() - t0) * 1e3)
+                except serving.ServerBusyError:
+                    with lock:
+                        rejected[0] += 1
+                except Exception as e:
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+        waiters = [threading.Thread(target=waiter, daemon=True)
+                   for _ in range(max(2, concurrency))]
+        for w in waiters:
+            w.start()
+        period = 1.0 / max(rate, 1.0)
+        nxt = time.perf_counter()
+        i = 0
+        while time.perf_counter() < stop_at:
+            now = time.perf_counter()
+            if now < nxt:
+                time.sleep(min(nxt - now, 0.002))
+                continue
+            nxt += period
+            name = names[i % len(names)]
+            x = pool[i % len(pool)]
+            t0 = time.perf_counter()
+            try:
+                fut = server.submit(name, x)
+                inflight.put((t0, fut))
+            except serving.ServerBusyError:
+                with lock:
+                    rejected[0] += 1
+            i += 1
+        done.set()
+        for w in waiters:
+            w.join(timeout=15.0)
+
+    t_start = time.perf_counter()
+    if mode == "open" and not via_http:
+        open_loop()
+    else:
+        threads = [threading.Thread(target=closed_worker, args=(t,),
+                                    daemon=True)
+                   for t in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration + 30.0)
+    elapsed = time.perf_counter() - t_start
+
+    post = _compile.stats().get("serving", {})
+    stats = server.stats()
+    fills = [m.get("batch_fill_ratio") for m in stats["models"].values()
+             if m.get("batch_fill_ratio")]
+    report = {
+        "harness": "loadgen",
+        "mode": mode,
+        "via_http": bool(via_http),
+        "duration_s": round(elapsed, 2),
+        "models": names,
+        "concurrency": concurrency,
+        "requests": completed[0] + rejected[0] + len(errors),
+        "completed": completed[0],
+        "rejected": rejected[0],
+        "errors": len(errors),
+        "first_errors": errors[:3],
+        "rps": round(completed[0] / elapsed, 1) if elapsed else 0.0,
+        "batch_fill_ratio": round(sum(fills) / len(fills), 4)
+        if fills else None,
+        "recompiles_during_run": post.get("misses", 0) - pre_misses,
+        "server_stats": stats["models"],
+    }
+    report.update(_percentiles(sorted(lats)))
+    if front is not None:
+        front.close()
+    if own_server:
+        server.drain(timeout=10.0)
+    return report
+
+
+# --------------------------------------------------------------- over HTTP --
+
+def run_http(url, duration=30.0, concurrency=8, dim=16):
+    """Closed-loop drive of an EXTERNAL front end at `url` (model list
+    discovered via GET /v1/models)."""
+    import urllib.request
+
+    import numpy as np
+
+    with urllib.request.urlopen(f"{url.rstrip('/')}/v1/models",
+                                timeout=10.0) as resp:
+        names = json.loads(resp.read())["models"]
+    pool = [np.random.RandomState(i).randn(1, dim).astype(np.float32)
+            for i in range(64)]
+    lock = threading.Lock()
+    lats, completed, rejected, errors = [], [0], [0], []
+    stop_at = time.perf_counter() + duration
+
+    def worker(tid):
+        i = 0
+        while time.perf_counter() < stop_at:
+            name = names[(tid + i) % len(names)]
+            body = json.dumps(
+                {"data": pool[(tid * 7 + i) % len(pool)].tolist()}).encode()
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/v1/models/{name}:predict", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    json.loads(resp.read())
+                with lock:
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                    completed[0] += 1
+            except urllib.error.HTTPError as e:
+                with lock:
+                    if e.code in (429, 503):
+                        rejected[0] += 1
+                    else:
+                        errors.append(f"HTTP {e.code}")
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 30.0)
+    elapsed = time.perf_counter() - t_start
+    report = {
+        "harness": "loadgen", "mode": "closed", "via_http": True,
+        "url": url, "duration_s": round(elapsed, 2), "models": names,
+        "concurrency": concurrency, "completed": completed[0],
+        "rejected": rejected[0], "errors": len(errors),
+        "rps": round(completed[0] / elapsed, 1) if elapsed else 0.0,
+    }
+    report.update(_percentiles(sorted(lats)))
+    return report
+
+
+# --------------------------------------------------------------------- cli --
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="loadgen", description="serving load generator")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="seconds of sustained load (default 30)")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop workers / open-loop waiters")
+    ap.add_argument("--rate", type=float, default=2000.0,
+                    help="open-loop offered requests/s")
+    ap.add_argument("--models", type=int, default=2,
+                    help="demo MLPs in the in-process container")
+    ap.add_argument("--dim", type=int, default=16,
+                    help="demo model feature dim")
+    ap.add_argument("--via-http", action="store_true",
+                    help="drive the in-process server through the HTTP "
+                         "front end (socket path end to end)")
+    ap.add_argument("--url", default=None,
+                    help="drive an EXTERNAL front end instead of building "
+                         "an in-process server")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the pre-traffic bucket warmup (recompiles "
+                         "will then land inside the measured window)")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        report = run_http(args.url, duration=args.duration,
+                          concurrency=args.concurrency, dim=args.dim)
+    else:
+        report = run_inproc(
+            duration=args.duration, mode=args.mode,
+            concurrency=args.concurrency, rate=args.rate,
+            models=args.models, dim=args.dim, warmup=not args.no_warmup,
+            via_http=args.via_http)
+    print(f"loadgen: {report['completed']} completed in "
+          f"{report['duration_s']}s -> {report['rps']} req/s, "
+          f"p50 {report.get('p50_ms')}ms p99 {report.get('p99_ms')}ms, "
+          f"{report['rejected']} rejected, "
+          f"{report.get('recompiles_during_run', 'n/a')} recompiles "
+          "during the run", file=sys.stderr, flush=True)
+    print(json.dumps(report), flush=True)
+    return 0 if report.get("errors", 0) == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,19 @@ partition-spec-literal
                   exact bug class the distcheck sharding verifier exists
                   for. Keep axis names in the vocabulary (or route
                   through ``parallel/``).
+serving-blocking-call
+                  a blocking call in ``serving/`` code outside a
+                  ``watchdog.sync(...)`` span: device syncs
+                  (``wait_to_read``/``waitall``/``asnumpy``/
+                  ``block_until_ready``/...) and unbounded waits
+                  (zero-argument ``.join()``/``.result()``/``.get()``/
+                  ``.wait()``/``.acquire()``). The serving contract is
+                  bounded tail latency BY CONSTRUCTION — every wait must
+                  carry a timeout or run under a watchdog deadline, so a
+                  wedged device yields a crash bundle + StallError, never
+                  a hung server. Callables passed to ``*.sync(...)``
+                  (inline lambdas or local functions by name) are exempt:
+                  the sync IS their deadline.
 
 Baseline workflow
 -----------------
@@ -76,7 +89,14 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 RULES = ("bare-except", "host-sync", "raw-jax-compat", "raw-jit",
          "unseeded-random", "no-schema-doc", "unused-import",
-         "mutable-default", "unbounded-sync", "partition-spec-literal")
+         "mutable-default", "unbounded-sync", "partition-spec-literal",
+         "serving-blocking-call")
+
+# serving/ blocking-call vocabulary: device syncs (flagged regardless of
+# arguments) and waits that are unbounded only in their zero-arg form
+_SERVING_BLOCKING = {"wait_to_read", "wait_to_write", "waitall", "asnumpy",
+                     "asscalar", "block_until_ready", "item"}
+_SERVING_UNBOUNDED = {"join", "result", "get", "wait", "acquire"}
 
 _SYNC_METHODS = {"asnumpy", "asscalar"}
 # canonical mesh-axis vocabulary — keep in sync with
@@ -134,6 +154,9 @@ class _Linter(ast.NodeVisitor):
                                                      "_jax_compat.py")
         # parallel/ is the home of the sharding vocabulary itself
         self.is_parallel = "/parallel/" in rel.replace(os.sep, "/")
+        # serving/ code must never wait unboundedly outside watchdog.sync
+        self.is_serving = "serving" in rel.replace(os.sep, "/").split("/")[:-1]
+        self._serving_pending = []  # (node, message) resolved in finish()
         self.pspec_aliases = set()  # local names bound to PartitionSpec
         # module-level import bookkeeping for unused-import
         self.imports = {}   # local name -> (lineno, col, "import x" repr)
@@ -183,8 +206,25 @@ class _Linter(ast.NodeVisitor):
             chain = _dotted(func)
             if chain is not None:
                 self._check_np_random(node, chain)
+            if self.is_serving:
+                self._check_serving_blocking(node, func)
         self._check_partition_spec(node)
         self.generic_visit(node)
+
+    def _check_serving_blocking(self, node, func):
+        attr = func.attr
+        unbounded = (attr in _SERVING_UNBOUNDED and not node.args
+                     and not node.keywords)
+        if attr in _SERVING_BLOCKING:
+            why = f".{attr}() blocks on the device"
+        elif unbounded:
+            why = f"zero-argument .{attr}() waits unboundedly"
+        else:
+            return
+        self._serving_pending.append((node, (
+            f"{why}; serving code is bounded-tail-latency by construction "
+            "— run it inside watchdog.sync('serving.batch', ...) or pass "
+            "a timeout")))
 
     def _check_partition_spec(self, node):
         if self.is_parallel:
@@ -337,6 +377,30 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
     # ------------------------------------------------------------- finish --
+    def _sync_exempt_intervals(self, tree):
+        """Line intervals covered by a watchdog deadline: every argument
+        of a ``*.sync(...)`` call after the point name (inline lambdas),
+        plus the bodies of local functions passed to one by name."""
+        intervals, names = [], set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "sync"):
+                continue
+            for arg in node.args[1:]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                else:
+                    intervals.append((arg.lineno,
+                                      getattr(arg, "end_lineno",
+                                              arg.lineno)))
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in names:
+                intervals.append((node.lineno,
+                                  getattr(node, "end_lineno", node.lineno)))
+        return intervals
+
     def finish(self, tree):
         # names used in nested strings (getattr-style) are not tracked —
         # unused-import stays conservative: report only plain never-seen
@@ -347,6 +411,13 @@ class _Linter(ast.NodeVisitor):
             self.add(node, "unused-import",
                      f"imported name {local!r} "
                      f"({orig}) is never used in this module")
+        if self._serving_pending:
+            exempt = self._sync_exempt_intervals(tree)
+            for node, message in self._serving_pending:
+                line = getattr(node, "lineno", 1)
+                if any(lo <= line <= hi for lo, hi in exempt):
+                    continue
+                self.add(node, "serving-blocking-call", message)
         return self.findings
 
 
