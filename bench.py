@@ -11,8 +11,11 @@ Prints TWO JSON lines {"metric", "value", "unit", "vs_baseline", ...}:
   1. resnet50_v1_infer_bs128_bfloat16  (hybridized compiled scoring)
   2. resnet50_v1_train_bs128_bfloat16  (ONE fused fwd+loss+bwd+SGD-momentum
      executable via parallel.ShardedTrainer, incl. BN stat writeback;
-     extra fields: achieved_tflops + mfu vs BENCH_PEAK_TFLOPS, default 459
-     = v5p bf16 peak)
+     extra fields: achieved_tflops + the nominal mfu vs the per-device-kind
+     peak table in mxnet_tpu.telemetry.costs — TPU v3..v6e + a CPU
+     placeholder, BENCH_PEAK_TFLOPS override — AND mfu_xla, the measured
+     ratio whose numerator is the XLA cost_analysis() flops the compile
+     service captured for the executable)
 Every line also carries compile-service telemetry (mxnet_tpu.compile):
 ``compile_ms`` (time spent compiling this process), ``cache_hits`` /
 ``cache_misses`` and ``cache_disk_hits`` — with ``MXNET_TPU_CACHE_DIR``
@@ -60,6 +63,27 @@ def _compile_fields(line):
     line["cache_hits"] = t["hits"]
     line["cache_misses"] = t["misses"]
     line["cache_disk_hits"] = t["disk_hits"]
+    return line
+
+
+def _mfu_xla_fields(line, site, calls_per_sec, devices=1):
+    """Measured-flops MFU: the compile service captured XLA
+    ``cost_analysis()`` for `site`'s newest executable
+    (mxnet_tpu.telemetry.costs); divided by the per-device-kind peak
+    table this is ``mfu_xla`` — the ratio whose numerator is what XLA
+    actually scheduled, emitted ALONGSIDE the nominal ``mfu`` so
+    BENCH_r06+ records both."""
+    from mxnet_tpu.telemetry import costs as _tcosts
+
+    rec = _tcosts.latest(site)
+    flops = (rec or {}).get("flops")
+    if not flops:
+        return line
+    line["xla_flops_per_call"] = flops
+    mfu = _tcosts.mfu_xla(flops, calls_per_sec, devices=devices,
+                          peak=_peak_tflops())
+    if mfu is not None:
+        line["mfu_xla"] = round(mfu, 5)
     return line
 
 
@@ -149,10 +173,15 @@ def main(argv=None):
         "platform": ctx.device_type,
     }
     fwd_flops = _FWD_GFLOPS.get(model, 0.0) * 1e9
-    if fwd_flops and ctx.device_type != "cpu":
+    if fwd_flops:
+        # nominal mfu now lands on CPU fallback lines too (the table has
+        # an explicit placeholder 'cpu' peak); the platform field keeps
+        # fallback ratios out of the chip series
         achieved = throughput * fwd_flops / 1e12
         line["achieved_tflops"] = round(achieved, 1)
         line["mfu"] = round(achieved / _peak_tflops(), 3)
+    # hybridized scoring compiles through the 'cachedop' service site
+    _mfu_xla_fields(line, "cachedop", iters / elapsed)
     print(json.dumps(_compile_fields(line)), flush=True)
 
     if not skip_train:
@@ -221,6 +250,8 @@ def bench_train(ctx, batch, dtype, iters, model):
         if measured:
             line["measured_peak_tflops"] = round(measured, 1)
             line["mfu_vs_measured"] = round(achieved / measured, 3)
+    _mfu_xla_fields(line, "trainer", iters * 1.0 / elapsed,
+                    devices=trainer.mesh.num_devices)
     print(json.dumps(_compile_fields(line)), flush=True)
 
 
@@ -270,6 +301,7 @@ def bench_train_cpu():
         "first_step_s": round(compile_s, 3),
         "platform": "cpu",
     }
+    _mfu_xla_fields(line, "trainer", iters / elapsed)
     print(json.dumps(_compile_fields(line)), flush=True)
 
 
@@ -310,32 +342,13 @@ def bench_serve():
 
 
 def _peak_tflops():
-    """BENCH_PEAK_TFLOPS override when set to a positive number, else the
-    auto-detected nominal peak ("0"/unset both mean auto-detect)."""
-    try:
-        override = float(os.environ.get("BENCH_PEAK_TFLOPS", 0))
-    except ValueError:
-        override = 0.0
-    return override if override > 0 else _nominal_peak_tflops()
+    """The per-device-kind peak table (TPU v3..v6e + CPU placeholder)
+    lives in mxnet_tpu.telemetry.costs — BENCH_PEAK_TFLOPS override
+    preserved, "0"/unset mean auto-detect from
+    ``jax.devices()[0].device_kind``."""
+    from mxnet_tpu.telemetry import costs as _tcosts
 
-
-def _nominal_peak_tflops():
-    """Nominal bf16 peak for the attached chip generation (public specs);
-    overridable via BENCH_PEAK_TFLOPS. Order matters: 'v5 lite'/'v5e'
-    must match before the bare 'v5'."""
-    table = [("v6e", 918.0), ("v6", 918.0), ("v5 lite", 197.0),
-             ("v5e", 197.0), ("v5p", 459.0), ("v5", 459.0),
-             ("v4", 275.0), ("v3", 123.0)]
-    try:
-        import jax
-
-        kind = jax.devices()[0].device_kind.lower()
-        for key, peak in table:
-            if key in kind:
-                return peak
-    except Exception:
-        pass
-    return 459.0
+    return _tcosts.peak_tflops(env="BENCH_PEAK_TFLOPS")
 
 
 def _measure_chip_peak(n=4096, chain=16):

@@ -52,7 +52,8 @@ def __getattr__(name):
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
                 "contrib", "util", "runtime", "onnx", "operator", "library",
                 "log", "name", "attribute", "faults", "checkpoint",
-                "analysis", "watchdog", "preempt", "compile", "serving"):
+                "analysis", "watchdog", "preempt", "compile", "serving",
+                "telemetry"):
         import importlib
 
         try:

@@ -181,12 +181,11 @@ def ensure_live_backend(timeout_s=90, retries=1):
 def probe_backend_or_fallback(skip_env="MXTPU_SKIP_PROBE"):
     """Entry-point guard for examples/benchmarks: run the liveness probe
     (unless `skip_env` is set or MXTPU_PLATFORM pins a platform) and
-    print a loud stderr warning when a downed tunnel forced the CPU
-    fallback. Returns ensure_live_backend's platform string, or
-    "skipped". Call it in main() AFTER argument parsing and BEFORE the
-    first backend touch."""
+    log a loud warning when a downed tunnel forced the CPU fallback.
+    Returns ensure_live_backend's platform string, or "skipped". Call it
+    in main() AFTER argument parsing and BEFORE the first backend
+    touch."""
     import os
-    import sys
 
     # MXTPU_SKIP_PROBE always works; callers may add their own knob too
     # (bench.py keeps BENCH_SKIP_PROBE for compatibility)
@@ -194,8 +193,10 @@ def probe_backend_or_fallback(skip_env="MXTPU_SKIP_PROBE"):
         return "skipped"
     plat = ensure_live_backend()
     if plat == "cpu-fallback":
-        print("default backend unreachable; running on CPU",
-              file=sys.stderr, flush=True)
+        from . import log as _log
+
+        _log.get_logger("mxnet_tpu.base").warning(
+            "default backend unreachable; running on CPU")
     return plat
 
 

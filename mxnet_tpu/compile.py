@@ -68,6 +68,9 @@ import zlib
 from . import faults as _faults
 from . import profiler as _profiler
 from .analysis import distcheck as _distcheck
+from .telemetry import _state as _tele_state
+from .telemetry import costs as _tele_costs
+from .telemetry import flight as _flight
 
 __all__ = ["jit", "stats", "totals", "reset_stats", "set_enabled",
            "enabled", "configure", "cache_dir", "fingerprint", "warmup",
@@ -846,6 +849,65 @@ def warmup(source=None):
     return report
 
 
+# -------------------------------------------------- telemetry capture ------
+
+_XCOST_DEFAULT = frozenset(
+    ("trainer", "cachedop", "executor", "serving", "predictor"))
+_xcost_sites = None
+
+
+def _xcost_wanted(site):
+    """Should this site's executables get XLA cost/memory analyses
+    captured into telemetry? ``MXNET_TPU_TELEMETRY_XCOST``: unset = the
+    big-executable sites (per-op 'dispatch' and fused 'bulk' segments
+    are excluded — their trace-only capture would re-trace on every
+    miss for records nobody reads); '0' = none; 'all' = every site; a
+    comma list = exactly those sites."""
+    global _xcost_sites
+    if not _tele_state.enabled:
+        return False
+    if _xcost_sites is None:
+        spec = os.environ.get("MXNET_TPU_TELEMETRY_XCOST", "").strip()
+        if not spec:
+            _xcost_sites = _XCOST_DEFAULT
+        elif spec.lower() in ("0", "false", "off"):
+            _xcost_sites = frozenset()
+        elif spec.lower() == "all":
+            _xcost_sites = True
+        else:
+            _xcost_sites = frozenset(
+                s.strip() for s in spec.split(",") if s.strip())
+    return _xcost_sites is True or site in _xcost_sites
+
+
+def _capture_analysis(site, token_key, compiled=None, lowered=None,
+                      source="compile"):
+    """Record one executable's XLA analyses into telemetry (best effort
+    — never let observability fail a compile). With a ``Compiled`` in
+    hand both ``cost_analysis`` and ``memory_analysis`` land; the
+    trace-only path (``Lowered``) yields cost only."""
+    obj = compiled if compiled is not None else lowered
+    if obj is None:
+        return
+    try:
+        cost = obj.cost_analysis()
+    except Exception:
+        cost = None
+    mem = None
+    if compiled is not None:
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            mem = None
+    if cost is None and mem is None:
+        return
+    try:
+        _tele_costs.record_executable(site, token_key, cost=cost, mem=mem,
+                                      source=source)
+    except Exception:
+        pass
+
+
 # --------------------------------------------------------------- service ---
 
 class ServiceFunction:
@@ -910,6 +972,7 @@ class ServiceFunction:
         st[1] += 1
         if _distcheck.CACHE_TRACK:
             _distcheck.cache_event("service", self._site, sig, False)
+        _flight.rec("compile.miss", self._site, self.__name__)
         canon = None if (_DIR is None or self._donating) \
             else _canon(self._token_key, sig)
         if canon is not None:
@@ -924,6 +987,9 @@ class ServiceFunction:
                 # disk hits are warmup-worthy signatures too: keep the
                 # manifest fresh for future pods
                 _record_manifest(self._token_key, self._site, args)
+                if _xcost_wanted(self._site):
+                    _capture_analysis(self._site, self._token_key,
+                                      compiled=loaded, source="disk")
                 _profiler_compile(self._site, ms, "disk", st)
                 return loaded(*args)
             # compile AOT so the executable can be serialized for the
@@ -941,6 +1007,9 @@ class ServiceFunction:
                 _record_manifest(self._token_key, self._site, args)
                 _disk_store(key, compiled, self._site, canon,
                             _spec_tree(args))
+                if _xcost_wanted(self._site):
+                    _capture_analysis(self._site, self._token_key,
+                                      compiled=compiled, source="compile")
                 _profiler_compile(self._site, ms, "compile", st)
                 try:
                     return compiled(*args)
@@ -958,6 +1027,16 @@ class ServiceFunction:
         st[4] += ms
         self._seen[sig] = self._jit
         _record_manifest(self._token_key, self._site, args)
+        if _xcost_wanted(self._site):
+            # no Compiled object in hand on this path (the jit's own
+            # executable is internal); one extra trace+lower buys the
+            # cost analysis — no XLA backend compile happens here
+            try:
+                _capture_analysis(self._site, self._token_key,
+                                  lowered=self._jit.lower(*args),
+                                  source="trace")
+            except Exception:
+                pass
         _profiler_compile(self._site, ms, "compile", st)
         return out
 
@@ -988,6 +1067,9 @@ class ServiceFunction:
         ms = (time.perf_counter() - t0) * 1e3
         st[3] += 1
         st[4] += ms
+        if _xcost_wanted(self._site):
+            _capture_analysis(self._site, self._token_key,
+                              compiled=compiled, source="warmup")
         if self._donating:
             # the compile above seeded jax's native compilation cache, so
             # the jit re-trace at first traffic skips backend-compile —

@@ -14,6 +14,7 @@ from __future__ import annotations
 import gzip
 import struct
 import threading
+import time
 from collections import namedtuple
 
 import numpy as _np
@@ -515,16 +516,26 @@ class PrefetchingIter(DataIter):
         duplicate batches."""
         if self._error is not None:
             raise self._error
+        from ..telemetry import flight as _flight
+        from ..telemetry import steps as _tsteps
+
         try:
             if not self._started:
                 self._fetch()
                 self._started = True
+            # the time the CONSUMER actually blocks on the pipeline is
+            # the data-wait phase of the next training step (0 when the
+            # prefetch kept ahead of compute)
+            t0 = time.perf_counter()
             self._join()
+            _tsteps.phase("data_wait", (time.perf_counter() - t0) * 1e3)
             batches = list(self._next_batches)
             for b in batches:
                 if isinstance(b, BaseException):
                     # deferred worker error (parity: engine exceptions
                     # surface at the next sync point)
+                    _flight.rec("io.error", "io.fetch",
+                                type(b).__name__)
                     raise b
             if any(b is None for b in batches):
                 assert all(b is None for b in batches), \

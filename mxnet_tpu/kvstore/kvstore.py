@@ -32,7 +32,14 @@ from ..ndarray import NDArray
 from ..watchdog import StallError
 from .base import KVStoreBase
 
-__all__ = ["KVStore", "PeerLostError", "create"]
+__all__ = ["KVStore", "PeerLostError", "create", "OP_COUNTS"]
+
+# process-lifetime op totals, read by the telemetry 'kvstore' collector
+# at scrape time (mxtpu_kvstore_ops_total{op=...}) — plain dict int
+# bumps so the per-push cost is nil; collectives additionally land in
+# the flight recorder via their watchdog 'kvstore.sync' spans
+OP_COUNTS = {"init": 0, "push": 0, "pull": 0, "barrier": 0,
+             "allreduce": 0}
 
 
 class PeerLostError(StallError):
@@ -86,6 +93,7 @@ class KVStore(KVStoreBase):
 
     # ------------------------------------------------------------ core ----
     def init(self, key, value):
+        OP_COUNTS["init"] += 1
         keys, values = self._canonical(key, value)
         for k, v in zip(keys, values):
             if k in self._store:
@@ -110,6 +118,7 @@ class KVStore(KVStoreBase):
         from .. import watchdog as _watchdog
 
         _watchdog.beat("kvstore.push")  # liveness for hang diagnostics
+        OP_COUNTS["push"] += 1
         _faults.point("kvstore.push")  # flaky-gradient-sync injection
         keys, values = self._canonical_push(key, value)
         for k, vals in zip(keys, values):
@@ -129,6 +138,7 @@ class KVStore(KVStoreBase):
         from .. import watchdog as _watchdog
 
         _watchdog.beat("kvstore.pull")  # liveness for hang diagnostics
+        OP_COUNTS["pull"] += 1
         keys, outs = self._canonical(key, out)
         for k, o in zip(keys, outs):
             src = self._value_for_pull(k)
@@ -220,6 +230,7 @@ class KVStore(KVStoreBase):
         return 1
 
     def barrier(self):
+        OP_COUNTS["barrier"] += 1
         from .. import engine
 
         engine.wait_all()
@@ -413,6 +424,8 @@ class _DistKVStore(KVStore):
         from .. import faults as _faults
         from .. import watchdog as _watchdog
 
+        OP_COUNTS["allreduce"] += 1
+
         def _reduce():
             import jax.numpy as jnp
 
@@ -481,6 +494,7 @@ class _DistKVStore(KVStore):
         from .. import faults as _faults
         from .. import watchdog as _watchdog
 
+        OP_COUNTS["barrier"] += 1
         if self._sched is not None:
             if self._procs > 1:
                 from ..analysis import distcheck as _distcheck

@@ -568,10 +568,48 @@ class ShardedTrainer:
                               label=f"step {self._t + 1}")
 
     def _step_impl(self, x, y):
+        from ..telemetry import steps as _tsteps
+
+        # per-step phase timeline (data-wait / h2d / compute / optimizer
+        # / sync — docs/OBSERVABILITY.md): the record opens here, phases
+        # accrue inside _step_exec, and a raising step (injected fault,
+        # drain request, stall) abandons its partial record
+        _tsteps.begin_step(self._t + 1)
+        try:
+            out = self._step_exec(x, y)
+        except BaseException:
+            _tsteps.abort()
+            raise
+        _tsteps.end_step(flops=self._step_flops(),
+                         devices=self._mesh.num_devices)
+        return out
+
+    def _step_flops(self):
+        """XLA-analyzed flops per invocation of the compiled step (the
+        ``mfu_xla`` numerator), or None before the compile service has
+        captured a cost analysis for it."""
+        from ..telemetry import costs as _tcosts
+
+        token = getattr(self._step_fn, "_token_key", None)
+        return _tcosts.flops_for(token) if token is not None else None
+
+    def step_report(self):
+        """The most recent step's telemetry record: duration, phase
+        split, and (once cost analysis is captured) ``flops`` +
+        ``mfu_xla``. None before the first completed step (or with
+        telemetry disabled)."""
+        from ..telemetry import steps as _tsteps
+
+        return _tsteps.last()
+
+    def _step_exec(self, x, y):
+        import time as _time
+
         import jax
 
         from .. import faults as _faults
         from .. import random as _rand
+        from ..telemetry import steps as _tsteps
 
         x_raw = x._data if isinstance(x, NDArray) else x
         y_raw = y._data if isinstance(y, NDArray) else y
@@ -587,10 +625,12 @@ class ShardedTrainer:
             from ..analysis import distcheck as _distcheck
 
             _distcheck.check_trainer(self, x_raw, y_raw)
+        t0 = _time.perf_counter()
         x_raw = self._put_batch(
             x_raw, self._mesh.sharding(
                 *(("dp",) + (None,) * (len(x_raw.shape) - 1))))
         y_raw = self._put_batch(y_raw, self._mesh.sharding("dp"))
+        _tsteps.phase("h2d", (_time.perf_counter() - t0) * 1e3)
         if self._step_fn is None:
             self._step_fn = self._build(x_raw, y_raw)
         self._t += 1
@@ -601,11 +641,17 @@ class ShardedTrainer:
         in_p = tuple(h._data for h in self._train_handles)
         in_opt = self._opt_raws
         in_aux = tuple(h._data for h in self._aux_handles)
+        t0 = _time.perf_counter()
         new_p, new_opt, new_aux, loss, ok = self._step_fn(
             in_p, in_opt, in_aux,
             x_raw, y_raw, _rand.next_key(),
             jnp.asarray(self._t, jnp.int32),
             jnp.asarray(lr, jnp.float32))
+        # the fused executable runs fwd+bwd+optimizer as one program, so
+        # the optimizer phase is folded into compute (async dispatch:
+        # device time lands in the nan-guard sync read below, or in the
+        # next step's phases when nan_guard=False)
+        _tsteps.phase("compute", (_time.perf_counter() - t0) * 1e3)
         if self._donate and self._distcheck:
             # donation-safety (distcheck pass 3): the step donated every
             # param/opt/aux input buffer — poison them so a stale alias
@@ -630,7 +676,9 @@ class ShardedTrainer:
                 h._data = raw
         self._opt_raws = new_opt
         if self._nan_guard:
+            t0 = _time.perf_counter()
             self._account_skip(bool(ok))  # blocks on step completion
+            _tsteps.phase("sync", (_time.perf_counter() - t0) * 1e3)
         return NDArray(loss)
 
     def _account_skip(self, ok):

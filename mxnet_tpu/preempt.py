@@ -52,6 +52,7 @@ import threading
 import time
 
 from . import log as _log
+from .telemetry import flight as _flight
 
 __all__ = ["DrainRequested", "DRAIN_EXIT_CODE", "install", "installed",
            "uninstall", "maybe_install_from_env", "requested", "request",
@@ -119,6 +120,7 @@ def _handler(signum, frame):
                            "t_wall": time.time(),
                            "t_mono": time.monotonic(),
                            "pid": os.getpid()}
+    _flight.rec("preempt.request", "signal", _signal_name(signum))
     _logger.warning(
         "preempt: received %s — draining (the in-flight step finishes, "
         "then a final checkpoint is written and the process exits %d)",
@@ -211,6 +213,7 @@ def request(reason="api"):
                                    "t_wall": time.time(),
                                    "t_mono": time.monotonic(),
                                    "pid": os.getpid()}
+            _flight.rec("preempt.request", "api", str(reason))
     return _event
 
 
@@ -313,6 +316,12 @@ def drain(save=None, exit=True, code=None, directory=None):
         except Exception as e:  # a failed save must not mask the drain
             _logger.error("preempt: final checkpoint failed: %s", e)
             ev["final_checkpoint"] = f"failed: {type(e).__name__}: {e}"
+    # the flight-recorder tail rides in every drain record: what the
+    # process was doing when the platform pulled the plug, with no
+    # profiler session required
+    _flight.rec("preempt.drain", "drain",
+                ev.get("signal") or ev.get("reason"))
+    ev["flight_tail"] = _flight.tail(64)
     ev["recorded"] = _write_event(ev, directory)
     _logger.warning("preempt: drained (%s); final checkpoint: %s; "
                     "exiting %d for reschedule",

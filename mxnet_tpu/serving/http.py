@@ -7,6 +7,12 @@ Endpoints (TF-Serving-flavoured paths, JSON bodies)::
     GET  /v1/models                  -> {"models": [...]}
     GET  /v1/stats                   -> ModelServer.stats()
     GET  /healthz                    -> {"status": "ok"|"draining"}
+    GET  /metrics                    -> Prometheus text format: the full
+                                     telemetry registry (serving rps /
+                                     latency / queue depth, compile-cache
+                                     hits/misses, watchdog stalls, device
+                                     memory — mxnet_tpu.telemetry.export)
+    GET  /metrics.json               -> the same registry as JSON
 
 Error mapping — the typed serving errors become the status codes a
 load balancer expects: unknown model 404, admission fast-reject 429
@@ -61,6 +67,14 @@ class HttpFrontEnd:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, code, text, ctype):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 srv = front._server
                 if self.path == "/healthz":
@@ -70,6 +84,16 @@ class HttpFrontEnd:
                     self._json(200, {"models": srv.models()})
                 elif self.path in ("/v1/stats", "/stats"):
                     self._json(200, srv.stats())
+                elif self.path == "/metrics":
+                    from ..telemetry import export as _export
+
+                    self._text(200, _export.render_prometheus(),
+                               _export.PROMETHEUS_CONTENT_TYPE)
+                elif self.path == "/metrics.json":
+                    from ..telemetry import export as _export
+
+                    self._text(200, _export.render_json(),
+                               "application/json")
                 else:
                     self._json(404, {"error": f"no route {self.path!r}"})
 

@@ -15,6 +15,8 @@ import threading
 import time
 from collections import Counter, deque
 
+from ..telemetry import flight as _flight
+
 __all__ = ["ModelMetrics", "percentile"]
 
 _RING = 8192  # recent-latency window for percentiles
@@ -57,6 +59,7 @@ class ModelMetrics:
     def record_reject(self):
         with self._lock:
             self.rejected += 1
+        _flight.rec("serving.reject", self.model)
         from .. import profiler as _profiler
 
         if _profiler._RECORDING:
@@ -82,6 +85,8 @@ class ModelMetrics:
             self.rows += rows
             self.padded_rows += bucket - rows
             self.bucket_census[bucket] += 1
+        _flight.rec("serving.batch", self.model,
+                    f"bucket={bucket} rows={rows}")
         from .. import profiler as _profiler
 
         if _profiler._RECORDING:
@@ -91,6 +96,7 @@ class ModelMetrics:
     def record_stall(self):
         with self._lock:
             self.stalled += 1
+        _flight.rec("serving.stall", self.model)
 
     # -------------------------------------------------------- snapshot ---
     def snapshot(self, **extra):

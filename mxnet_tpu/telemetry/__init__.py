@@ -1,0 +1,68 @@
+"""Unified telemetry: the one observability seam of the framework.
+
+Every subsystem built so far grew its own instrumentation island — the
+profiler's Chrome-trace events, watchdog crash bundles, serving
+``stats()``, ``compile_cache.*`` counters. This package is the seam that
+ties them together for a fleet operator:
+
+* :mod:`~mxnet_tpu.telemetry.registry` — counters/gauges/histograms with
+  bounded label sets, fed by push (coarse events) and pull (collectors
+  over the subsystems' existing counters), exported as Prometheus text +
+  JSON;
+* :mod:`~mxnet_tpu.telemetry.export` — the collectors, the standalone
+  :class:`~mxnet_tpu.telemetry.export.MetricsServer`, and the rendering
+  behind the serving front end's ``GET /metrics``;
+* :mod:`~mxnet_tpu.telemetry.flight` — the always-on constant-memory
+  flight recorder whose tail ships in every watchdog crash bundle and
+  preemption drain event;
+* :mod:`~mxnet_tpu.telemetry.memory` — device-memory live/peak gauges
+  (allocator stats, ``live_arrays`` fallback) + OOM forensics over the
+  per-executable ``memory_analysis()`` captured at compile time;
+* :mod:`~mxnet_tpu.telemetry.costs` — per-executable
+  ``cost_analysis()`` records, the per-device-kind peak-TFLOPS table,
+  and the measured ``mfu_xla`` arithmetic;
+* :mod:`~mxnet_tpu.telemetry.steps` — the per-step phase timeline
+  (data-wait / h2d / compute / optimizer / sync).
+
+Knobs: ``MXNET_TPU_TELEMETRY=0`` disables push instrumentation
+(:func:`set_enabled` at runtime); ``MXNET_TPU_FLIGHT`` sizes the flight
+ring; ``MXNET_TPU_TELEMETRY_MEMSAMPLE`` paces step-boundary memory
+samples; ``MXNET_TPU_TELEMETRY_XCOST`` scopes executable-analysis
+capture; ``MXNET_TPU_TELEMETRY_MAX_SERIES`` bounds per-metric
+cardinality. Overhead contract: disabled, every hook is one
+module-global check; enabled, nothing runs on the per-op dispatch path
+(the A/B perf gate in ``tests/test_telemetry.py`` holds ``opperf
+--dispatch`` within noise). See ``docs/OBSERVABILITY.md``.
+"""
+from __future__ import annotations
+
+from . import _state, costs, export, flight, memory, registry, steps
+from ._state import set_enabled
+from .export import (MetricsServer, metrics_snapshot, register_collector,
+                     render_prometheus)
+
+__all__ = ["enabled", "set_enabled", "describe", "registry", "flight",
+           "costs", "memory", "steps", "export", "MetricsServer",
+           "metrics_snapshot", "render_prometheus", "register_collector"]
+
+
+def enabled() -> bool:
+    """True when push instrumentation is active."""
+    return _state.enabled
+
+
+def describe():
+    """Effective knobs + state as a plain dict (``tools/diagnose.py``)."""
+    import os
+
+    return {
+        "enabled": _state.enabled,
+        "env": os.environ.get("MXNET_TPU_TELEMETRY", "<unset>"),
+        "flight_ring": flight.size(),
+        "flight_events": sum(flight.counts().values()),
+        "metrics": len(registry.all_metrics()),
+        "memory_sample_every": memory.sample_every(),
+        "executables_tracked": {s: a["executables"]
+                                for s, a in costs.aggregate().items()},
+        "last_step": steps.last(),
+    }

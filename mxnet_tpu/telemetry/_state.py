@@ -1,0 +1,28 @@
+"""Shared telemetry switch — one module-global every submodule reads.
+
+Kept in its own tiny dependency-free module so the hot-path check in
+:func:`mxnet_tpu.telemetry.flight.rec` (and the step/memory
+instrumentation) is a single attribute load, and so no submodule has to
+import the package ``__init__`` (which imports all of them).
+
+``MXNET_TPU_TELEMETRY=0`` disables every *push* instrumentation point
+(flight recorder, step breakdown, memory sampling, executable
+cost/memory capture) at process start; :func:`set_enabled` flips it at
+runtime (the A/B perf-gate seam). Pull-based exports (the metrics
+registry collectors) always answer a scrape — they only read counters
+other subsystems already keep.
+"""
+from __future__ import annotations
+
+import os
+
+enabled = os.environ.get("MXNET_TPU_TELEMETRY", "1").lower() \
+    not in ("0", "false", "off")
+
+
+def set_enabled(on) -> bool:
+    """Toggle push instrumentation; returns the previous state."""
+    global enabled
+    prev = enabled
+    enabled = bool(on)
+    return prev

@@ -1,0 +1,300 @@
+"""Metrics export: subsystem collectors + the ``/metrics`` endpoints.
+
+The scrape path: :func:`collect` runs every registered collector —
+pull-based adapters that copy counters the subsystems already keep
+(``compile.stats()``, ``serving.live_stats()``, the watchdog stall
+count, kvstore op counts, device memory, flight-recorder totals) into
+the :mod:`~mxnet_tpu.telemetry.registry` — then the registry renders
+Prometheus text (:func:`render_prometheus`) or JSON
+(:func:`metrics_snapshot`).
+
+Collectors look subsystems up through ``sys.modules``: a module that was
+never imported has no traffic to report, and a scrape must never be the
+thing that pulls jax (or the serving stack) into a process.
+
+Serving exposure:
+
+* the serving :class:`~mxnet_tpu.serving.http.HttpFrontEnd` mounts
+  ``GET /metrics`` (Prometheus text) and ``GET /metrics.json`` directly
+  — one port serves predictions and observability;
+* :class:`MetricsServer` is the standalone twin for processes without a
+  serving front end (trainers): ``MetricsServer(port=9100).start()``
+  exposes ``/metrics``, ``/metrics.json`` and ``/healthz``.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+
+from . import costs as _costs, flight as _flight, memory as _memory
+from . import registry as _registry
+
+__all__ = ["register_collector", "collect", "metrics_snapshot",
+           "render_prometheus", "render_json", "MetricsServer",
+           "PROMETHEUS_CONTENT_TYPE"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_lock = threading.Lock()
+_COLLECTORS = []           # (name, fn)
+_defaults_installed = False
+
+
+def register_collector(name, fn):
+    """Register a scrape-time collector (replaces a previous one of the
+    same name)."""
+    with _lock:
+        for i, (n, _) in enumerate(_COLLECTORS):
+            if n == name:
+                _COLLECTORS[i] = (name, fn)
+                return
+        _COLLECTORS.append((name, fn))
+
+
+def collect():
+    """Run every collector (errors are swallowed per collector — one
+    broken subsystem must not take down the whole scrape). Returns the
+    list of collector names that raised."""
+    _ensure_defaults()
+    errors = []
+    with _lock:
+        items = list(_COLLECTORS)
+    for name, fn in items:
+        try:
+            fn()
+        except Exception:
+            errors.append(name)
+    if errors:
+        _registry.gauge("mxtpu_collector_errors",
+                        "Collectors that raised at the last scrape").set(
+                            len(errors))
+    return errors
+
+
+def metrics_snapshot():
+    """Collect, then return the registry as a JSON-able dict."""
+    collect()
+    return _registry.snapshot()
+
+
+def render_prometheus():
+    """Collect, then render the registry in Prometheus text format."""
+    collect()
+    return _registry.render_prometheus()
+
+
+def render_json():
+    return json.dumps(metrics_snapshot(), sort_keys=True)
+
+
+# ---------------------------------------------------- default collectors ---
+
+def _collect_compile():
+    mod = sys.modules.get("mxnet_tpu.compile")
+    if mod is None:
+        return
+    hits = _registry.counter("mxtpu_compile_cache_hits_total",
+                             "Compile-service in-memory cache hits",
+                             labels=("site",))
+    misses = _registry.counter("mxtpu_compile_cache_misses_total",
+                               "Compile-service cache misses",
+                               labels=("site",))
+    disk = _registry.counter("mxtpu_compile_cache_disk_hits_total",
+                             "Compile-service persistent-cache hits",
+                             labels=("site",))
+    compiles = _registry.counter("mxtpu_compile_compiles_total",
+                                 "Fresh XLA compiles", labels=("site",))
+    cms = _registry.counter("mxtpu_compile_ms_total",
+                            "Milliseconds spent compiling",
+                            labels=("site",))
+    lms = _registry.counter("mxtpu_compile_load_ms_total",
+                            "Milliseconds spent loading cached "
+                            "executables", labels=("site",))
+    for site, st in mod.stats().items():
+        hits.set_total(st["hits"], site)
+        misses.set_total(st["misses"], site)
+        disk.set_total(st["disk_hits"], site)
+        compiles.set_total(st["compiles"], site)
+        cms.set_total(st["compile_ms"], site)
+        lms.set_total(st["load_ms"], site)
+
+
+def _collect_serving():
+    mod = sys.modules.get("mxnet_tpu.serving.server")
+    if mod is None:
+        return
+    req = _registry.counter("mxtpu_serving_requests_total",
+                            "Serving requests by outcome",
+                            labels=("model", "outcome"))
+    rps = _registry.gauge("mxtpu_serving_rps",
+                          "Completion-window requests/s", labels=("model",))
+    lat = _registry.gauge("mxtpu_serving_latency_ms",
+                          "Recent-window latency percentiles",
+                          labels=("model", "quantile"))
+    depth = _registry.gauge("mxtpu_serving_queue_depth",
+                            "Rows waiting for a batch", labels=("model",))
+    fill = _registry.gauge("mxtpu_serving_batch_fill_ratio",
+                           "Real rows / padded rows", labels=("model",))
+    batches = _registry.counter("mxtpu_serving_batches_total",
+                                "Compiled batches executed",
+                                labels=("model",))
+    stalls = _registry.counter("mxtpu_serving_stalled_batches_total",
+                               "Batches killed by a watchdog stall",
+                               labels=("model",))
+    for srv in mod.live_stats():
+        for model, m in srv.get("models", {}).items():
+            for outcome in ("submitted", "completed", "rejected",
+                            "failed"):
+                req.set_total(m.get(outcome, 0), model, outcome)
+            if m.get("rps") is not None:
+                rps.set(m["rps"], model)
+            for q in ("p50", "p95", "p99"):
+                v = m.get(f"{q}_ms")
+                if v is not None:
+                    lat.set(v, model, q)
+            depth.set(m.get("queue_depth", 0), model)
+            if m.get("batch_fill_ratio") is not None:
+                fill.set(m["batch_fill_ratio"], model)
+            batches.set_total(m.get("batches", 0), model)
+            stalls.set_total(m.get("stalled_batches", 0), model)
+
+
+def _collect_watchdog():
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    if prof is not None:
+        _registry.counter(
+            "mxtpu_watchdog_stalls_total",
+            "Deadline-blown sync points (crash bundles written)"
+        ).set_total(prof._stall_count)
+    wd = sys.modules.get("mxnet_tpu.watchdog")
+    if wd is not None:
+        _registry.gauge("mxtpu_watchdog_enabled",
+                        "1 when a watchdog deadline config is installed"
+                        ).set(1.0 if wd.enabled() else 0.0)
+
+
+def _collect_kvstore():
+    mod = sys.modules.get("mxnet_tpu.kvstore.kvstore")
+    if mod is None or not hasattr(mod, "OP_COUNTS"):
+        return
+    ops = _registry.counter("mxtpu_kvstore_ops_total",
+                            "KVStore operations", labels=("op",))
+    for op, n in mod.OP_COUNTS.items():
+        ops.set_total(n, op)
+
+
+def _collect_memory():
+    _memory.sample(reason="scrape")
+    tracked = _registry.gauge("mxtpu_executables_tracked",
+                              "Distinct executables with captured "
+                              "XLA analyses", labels=("site",))
+    temp = _registry.gauge("mxtpu_executable_temp_bytes",
+                           "Sum of XLA temp bytes over tracked "
+                           "executables", labels=("site",))
+    for site, agg in _costs.aggregate().items():
+        tracked.set(agg["executables"], site)
+        temp.set(agg["temp_bytes"], site)
+
+
+def _collect_flight():
+    ev = _registry.counter("mxtpu_flight_events_total",
+                           "Flight-recorder events", labels=("kind",))
+    for kind, n in _flight.counts().items():
+        ev.set_total(n, kind)
+    _registry.gauge("mxtpu_flight_ring_size",
+                    "Flight-recorder capacity (0 = disabled)").set(
+                        _flight.size())
+
+
+def _collect_preempt():
+    mod = sys.modules.get("mxnet_tpu.preempt")
+    if mod is None:
+        return
+    _registry.gauge("mxtpu_preempt_drain_requested",
+                    "1 once a preemption drain has been requested").set(
+                        1.0 if mod.requested() else 0.0)
+
+
+def _ensure_defaults():
+    global _defaults_installed
+    if _defaults_installed:
+        return
+    _defaults_installed = True
+    register_collector("compile", _collect_compile)
+    register_collector("serving", _collect_serving)
+    register_collector("watchdog", _collect_watchdog)
+    register_collector("kvstore", _collect_kvstore)
+    register_collector("memory", _collect_memory)
+    register_collector("flight", _collect_flight)
+    register_collector("preempt", _collect_preempt)
+
+
+# ------------------------------------------------------ standalone server ---
+
+class MetricsServer:
+    """A loopback HTTP endpoint exposing ``/metrics`` (Prometheus text),
+    ``/metrics.json`` and ``/healthz`` for processes that do not run the
+    serving front end (trainers, the gang supervisor). ``port=0`` picks
+    a free one."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "mxtpu-metrics/0.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path in ("/metrics", "/"):
+                    self._send(200, render_prometheus(),
+                               PROMETHEUS_CONTENT_TYPE)
+                elif self.path == "/metrics.json":
+                    self._send(200, render_json(), "application/json")
+                elif self.path == "/healthz":
+                    self._send(200, '{"status": "ok"}',
+                               "application/json")
+                else:
+                    self._send(404, f'{{"error": "no route '
+                                    f'{self.path}"}}', "application/json")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1}, daemon=True,
+                name="mxtpu-metrics-http")
+            self._thread.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

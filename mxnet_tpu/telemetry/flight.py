@@ -1,0 +1,112 @@
+"""Flight recorder: an always-on, constant-memory ring of the last N
+structured runtime events.
+
+The profiler answers "what happened?" only when someone remembered to
+turn it on *before* the incident. The flight recorder is the other half
+of post-mortem observability: it is **always on** (like an aircraft
+FDR), costs one module-global check plus a slot write per event, and its
+tail ships inside every watchdog crash bundle (``flight.json``) and
+every preemption drain event (``flight_tail``) — so a hang or a
+preemption at 3am yields the last-N timeline of what the process was
+doing with no profiling session required.
+
+Recorded event kinds (the coarse seams, never the per-op hot path):
+
+    ``step.begin`` / ``step.end``   trainer step boundaries (+ duration)
+    ``sync``                        every watchdog-spanned blocking point
+                                    (engine.flush, host.sync,
+                                    trainer.step, io.fetch, kvstore.sync
+                                    — collectives —, serving.batch)
+    ``compile.miss``                compile-service miss (site + source)
+    ``serving.reject``              admission fast-reject
+    ``serving.batch`` / ``serving.stall``   served / wedged batch
+    ``watchdog.warn`` / ``watchdog.stall``  escalation ladder steps
+    ``preempt.request`` / ``preempt.drain`` preemption lifecycle
+    ``io.error``                    prefetch worker failure
+    ``oom``                         RESOURCE_EXHAUSTED surfaced
+
+Memory contract: the ring is a preallocated list of fixed slot lists
+written **in place** — after the first lap no list/dict/tuple is
+allocated per event (only the unavoidable float/str objects for the
+fields themselves), so a multi-week serving process holds exactly
+``MXNET_TPU_FLIGHT`` (default 1024; 0 disables) events forever.
+
+Lock-light: writers claim slots via an atomic counter
+(``itertools.count`` — C-implemented, GIL-atomic) and write their slot
+without a lock. A reader racing a writer can observe one torn slot;
+:func:`tail` drops slots whose sequence number is inconsistent, which is
+the right trade for a recorder that must never stall the recorded.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+
+from . import _state
+
+__all__ = ["rec", "tail", "counts", "size", "clear"]
+
+try:
+    _N = int(os.environ.get("MXNET_TPU_FLIGHT", "1024"))
+except ValueError:
+    _N = 1024
+_N = max(0, _N)
+
+# slot layout: [seq, t_mono, t_wall, kind, point, label]
+_ring = [[-1, 0.0, 0.0, "", "", None] for _ in range(_N)]
+_seq = itertools.count()
+_counts: dict = {}
+
+
+def rec(kind, point="", label=None):
+    """Record one event (no-op when telemetry is disabled or the ring
+    size is 0). ``label`` may be any short printable value — it lands in
+    crash bundles verbatim."""
+    if not _state.enabled or _N == 0:
+        return
+    i = next(_seq)
+    slot = _ring[i % _N]
+    slot[0] = -1  # invalidate while torn
+    slot[1] = time.monotonic()
+    slot[2] = time.time()
+    slot[3] = kind
+    slot[4] = point
+    slot[5] = label
+    slot[0] = i   # publish
+    _counts[kind] = _counts.get(kind, 0) + 1
+
+
+def tail(n=None):
+    """The last ``n`` (default: all retained) events as JSON-able dicts,
+    oldest first. Torn or empty slots are skipped."""
+    events = []
+    for slot in _ring:
+        seq, t_mono, t_wall, kind, point, label = slot
+        if seq < 0:
+            continue
+        events.append({"seq": seq, "t_mono": round(t_mono, 6),
+                       "t_wall": round(t_wall, 6), "kind": kind,
+                       "point": point, "label": label})
+    events.sort(key=lambda e: e["seq"])
+    if n is not None:
+        events = events[-int(n):]
+    return events
+
+
+def counts():
+    """Process-lifetime event totals per kind (feeds the
+    ``mxtpu_flight_events_total`` metric series)."""
+    return dict(_counts)
+
+
+def size():
+    """Ring capacity (``MXNET_TPU_FLIGHT``; 0 = disabled)."""
+    return _N
+
+
+def clear():
+    """Drop all retained events and counts (tests)."""
+    for slot in _ring:
+        slot[0] = -1
+    _counts.clear()

@@ -1,0 +1,171 @@
+"""Device-memory telemetry: live/peak byte gauges + OOM forensics.
+
+A framework whose whole point is TPU HBM had, before this module, zero
+visibility into it. Two sources, used in this order:
+
+* **allocator stats** — ``device.memory_stats()`` (TPU/GPU runtimes):
+  ``bytes_in_use`` / ``peak_bytes_in_use`` per local device, the real
+  HBM numbers;
+* **host fallback** — CPU jaxlib returns no allocator stats, so the
+  fallback sums ``jax.live_arrays()`` (the process's live framework
+  buffers) under one ``host`` pseudo-device, with the peak tracked
+  host-side. An estimate, but it moves with the working set and keeps
+  the ``mxtpu_device_memory_*`` series populated on fallback hosts.
+
+Samples are taken at trainer step boundaries (every
+``MXNET_TPU_TELEMETRY_MEMSAMPLE``-th step, default 1; 0 disables) and at
+every ``/metrics`` scrape, so a serving-only process reports memory too.
+
+OOM forensics: :func:`oom_report` combines the live sample with the
+top-K resident executables by XLA ``memory_analysis()`` (captured at
+compile time by :mod:`mxnet_tpu.compile` into
+:mod:`mxnet_tpu.telemetry.costs`) — the first thing to read when a pod
+dies RESOURCE_EXHAUSTED. The watchdog embeds it in every crash bundle.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from . import _state, costs as _costs, registry as _registry
+
+__all__ = ["sample", "device_memory", "top_executables", "oom_report",
+           "maybe_sample_step", "sample_every"]
+
+_lock = threading.Lock()
+_host_peak = 0
+_last_sample = None
+
+
+def sample_every() -> int:
+    """Step-boundary sampling period (0 disables step sampling)."""
+    try:
+        return max(0, int(os.environ.get("MXNET_TPU_TELEMETRY_MEMSAMPLE",
+                                         "1")))
+    except ValueError:
+        return 1
+
+
+def device_memory():
+    """One record per local device: ``{device, platform, live_bytes,
+    peak_bytes, source}``. Never raises — an unreachable backend yields
+    an empty list."""
+    global _host_peak
+    out = []
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    fallback = []
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out.append({
+                "device": f"{d.platform}:{d.id}",
+                "platform": d.platform,
+                "live_bytes": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes": int(stats.get("peak_bytes_in_use",
+                                            stats.get("bytes_in_use", 0))),
+                "source": "memory_stats",
+            })
+        else:
+            fallback.append(d)
+    if fallback and not out:
+        try:
+            import jax
+
+            live = sum(a.nbytes for a in jax.live_arrays())
+        except Exception:
+            return out
+        with _lock:
+            _host_peak = max(_host_peak, live)
+            peak = _host_peak
+        out.append({"device": "host", "platform": fallback[0].platform,
+                    "live_bytes": int(live), "peak_bytes": int(peak),
+                    "source": "live_arrays"})
+    return out
+
+
+def sample(reason="scrape"):
+    """Take one sample and publish the live/peak gauges. Returns the
+    per-device records (None when telemetry is disabled)."""
+    global _last_sample
+    if not _state.enabled:
+        return None
+    recs = device_memory()
+    if recs:
+        live = _registry.gauge(
+            "mxtpu_device_memory_live_bytes",
+            "Live device (or host-fallback) bytes at the last sample",
+            labels=("device",))
+        peak = _registry.gauge(
+            "mxtpu_device_memory_peak_bytes",
+            "Peak device (or host-fallback) bytes observed",
+            labels=("device",))
+        for r in recs:
+            live.set(r["live_bytes"], r["device"])
+            peak.set(r["peak_bytes"], r["device"])
+    _last_sample = {"reason": reason, "devices": recs}
+    return recs
+
+
+def last_sample():
+    """The most recent sample (diagnose), or None."""
+    return _last_sample
+
+
+_step_counter = 0
+
+
+def maybe_sample_step():
+    """Step-boundary sampling hook (called by the trainer step timeline);
+    honours the ``MXNET_TPU_TELEMETRY_MEMSAMPLE`` period."""
+    global _step_counter
+    n = sample_every()
+    if n == 0:
+        return None
+    _step_counter += 1
+    if _step_counter % n:
+        return None
+    return sample(reason="step")
+
+
+def top_executables(k=10):
+    """The K most memory-resident executables the compile service has
+    built, by XLA-analyzed ``temp + output + generated-code`` bytes —
+    what is plausibly *still resident* and worth evicting/resharding
+    when HBM runs out."""
+    recs = _costs.records()
+
+    def resident(r):
+        return (r.get("temp_bytes", 0) or 0) \
+            + (r.get("output_bytes", 0) or 0) \
+            + (r.get("generated_code_bytes", 0) or 0)
+
+    recs = [r for r in recs if resident(r) > 0]
+    recs.sort(key=resident, reverse=True)
+    out = []
+    for r in recs[:k]:
+        out.append({"site": r["site"], "token": r["token"],
+                    "resident_bytes": resident(r),
+                    "temp_bytes": r.get("temp_bytes", 0),
+                    "output_bytes": r.get("output_bytes", 0),
+                    "argument_bytes": r.get("argument_bytes", 0),
+                    "generated_code_bytes":
+                        r.get("generated_code_bytes", 0)})
+    return out
+
+
+def oom_report(k=10):
+    """The OOM post-mortem: live per-device sample + top-K resident
+    executables + per-site aggregates. Embedded in watchdog crash
+    bundles and printed by ``tools/diagnose.py``."""
+    return {"devices": device_memory(),
+            "top_executables": top_executables(k),
+            "aggregate": _costs.aggregate()}

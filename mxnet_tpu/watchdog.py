@@ -84,6 +84,7 @@ import threading
 import time
 
 from . import log as _log
+from .telemetry import flight as _flight
 
 __all__ = ["StallError", "configure", "configure_from_env", "enabled",
            "sync", "beat", "heartbeats", "set_last_resort", "last_resort",
@@ -392,6 +393,13 @@ def _write_bundle(span):
             json.dump(_report(span), f, indent=1, default=repr)
         with open(os.path.join(path, "sanitize.json"), "w") as f:
             json.dump(_sanitizer_history(), f, indent=1)
+        # the always-on flight recorder: the last-N event timeline (step
+        # boundaries, syncs, compile misses, serving traffic) ships in
+        # EVERY bundle, so the post-mortem does not depend on the
+        # profiler having been running when the process wedged
+        _flight.rec("watchdog.stall", span.point, span.label)
+        with open(os.path.join(path, "flight.json"), "w") as f:
+            json.dump(_flight.tail(), f, indent=1, default=repr)
         span.bundle = path
         _logger.error("watchdog: %r (%s) stalled %.1fs >= deadline %gs; "
                       "crash bundle written to %s", span.point,
@@ -451,6 +459,15 @@ def _report(span):
         report["profiler"] = _profiler.dumps()
     except Exception as e:
         report["profiler"] = f"<unavailable: {e}>"
+    try:
+        # device-memory forensics: live/peak per device + the top-K
+        # resident executables by XLA memory_analysis — the OOM half of
+        # a stall post-mortem (a wedge is often an allocator death spiral)
+        from .telemetry import memory as _tele_memory
+
+        report["memory"] = _tele_memory.oom_report()
+    except Exception as e:
+        report["memory"] = f"<unavailable: {e}>"
     return report
 
 
@@ -497,6 +514,7 @@ def _monitor_loop(gen):
                 elapsed = now - s.start
                 if not s.warned and elapsed >= s.deadline * cfg.warn_fraction:
                     s.warned = True
+                    _flight.rec("watchdog.warn", s.point, s.label)
                     _logger.warning(
                         "watchdog: %r (%s) has been blocking for %.1fs "
                         "(deadline %gs)", s.point, s.label or "-", elapsed,
@@ -563,6 +581,10 @@ def sync(point, fn, label=None):
     result is discarded, exactly like a wedge that eventually unwedges
     after the job gave up on it.
     """
+    # always-on flight breadcrumb: every spanned blocking point (syncs,
+    # collectives, batches) lands in the post-mortem ring even when no
+    # watchdog deadline is configured
+    _flight.rec("sync", point, label)
     cfg = _CFG
     if cfg is None:
         if _loaded_env:

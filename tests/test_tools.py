@@ -65,6 +65,29 @@ def test_diagnose_runs(capsys):
     assert "jax" in out
     # watchdog knobs + most-recent-crash-bundle report (docs/ROBUSTNESS.md)
     assert "Watchdog Knobs" in out and "MXNET_TPU_WATCHDOG" in out
+    # telemetry section (docs/OBSERVABILITY.md)
+    assert "Telemetry" in out and "MXNET_TPU_TELEMETRY" in out
+
+
+def test_diagnose_json_machine_readable(capsys):
+    """--json: one JSON document with every report section, for CI
+    scraping; the human text stays the default (covered above)."""
+    import json
+
+    import diagnose
+
+    diagnose.main(["--json"])
+    out = capsys.readouterr().out
+    report = json.loads(out)  # exactly one parseable document, no prose
+    for section in ("python", "framework", "dependencies", "hardware",
+                    "environment", "analysis", "compile_cache",
+                    "serving", "watchdog", "preempt", "telemetry"):
+        assert section in report, section
+    assert report["python"]["version"]
+    assert "jax" in report["dependencies"]
+    tele = report["telemetry"]
+    assert "metrics" in tele and "flight_tail" in tele
+    assert "device_memory" in tele
 
 
 def test_rec2idx_matches_writer(tmp_path):
@@ -223,6 +246,39 @@ def test_mxlint_serving_blocking_call_rule(tmp_path):
 
 
 @pytest.mark.lint
+def test_mxlint_print_call_rule(tmp_path):
+    """print-call: bare print() inside the mxnet_tpu/ package fires;
+    __main__ demo blocks, tools/-style scripts outside the package, and
+    noqa'd lines are exempt."""
+    import mxlint
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir(parents=True)
+    bad = pkg / "planted.py"
+    bad.write_text(
+        "def report(x):\n"
+        "    print('status:', x)\n"          # fires
+        "    print('ok')  # noqa: print-call\n"  # suppressed
+        "    return x\n"
+        "if __name__ == '__main__':\n"
+        "    print(report(1))\n")             # __main__ block: exempt
+    findings = [f for f in mxlint.run([str(bad)], root=str(tmp_path))
+                if f.rule == "print-call"]
+    assert len(findings) == 1 and findings[0].line == 2
+    assert "mxnet_tpu.log" in findings[0].message
+    # identical code OUTSIDE the package (tools/, scripts) is exempt
+    script = tmp_path / "tools" / "script.py"
+    script.parent.mkdir()
+    script.write_text("def f(x):\n    print(x)\n")
+    assert [f for f in mxlint.run([str(script)], root=str(tmp_path))
+            if f.rule == "print-call"] == []
+    # the telemetry package itself is print-free (structured export only)
+    findings = [f for f in mxlint.run(["mxnet_tpu/telemetry"])
+                if f.rule == "print-call"]
+    assert findings == [], findings
+
+
+@pytest.mark.lint
 def test_mxlint_baseline_gate_blocks_regressions(tmp_path):
     """Baseline semantics: within-count passes, one extra finding fails."""
     import mxlint
@@ -287,3 +343,10 @@ def test_chaos_smoke_recovers(tmp_path):
     crash = tmp_path / "crash"
     assert crash.is_dir() and any(
         "serving_batch" in f for f in os.listdir(crash))
+    # phase 7 verified the /metrics scrape; every bundle embeds a
+    # non-empty flight-recorder tail (telemetry acceptance)
+    import json
+
+    for bundle in os.listdir(crash):
+        with open(crash / bundle / "flight.json") as f:
+            assert json.load(f), f"empty flight tail in {bundle}"

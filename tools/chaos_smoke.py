@@ -25,6 +25,12 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     SERVING; then, in a subprocess, SIGTERM lands mid-load — admission
     stops, every admitted request is answered, and the process exits 75
     for the gang scheduler (``--serve-drill`` is that child's entry),
+  * the TELEMETRY pass (phase 7): a ``/metrics`` scrape on the serving
+    front end under ``loadgen`` traffic carries serving / compile /
+    watchdog / device-memory series consistent with the server's own
+    stats and loadgen's report, and the crash bundles written by the
+    injected hangs embed non-empty flight-recorder tails naming the
+    wedged points (``trainer.step`` with step events, ``serving.batch``),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -407,6 +413,100 @@ def main(argv=None):
             return 1
         print(f"  SIGTERM-under-load drill: {drill['answered']}/"
               f"{drill['admitted']} admitted requests answered, exit 75")
+
+    # phase 7: telemetry — a /metrics scrape on the serving front end
+    # under loadgen traffic must carry serving/compile/watchdog/memory
+    # series CONSISTENT with the server's own stats and loadgen's
+    # report; and the crash bundles written by the earlier injected
+    # hangs must embed a non-empty flight-recorder tail NAMING the
+    # wedged point (the post-mortem story with no profiler running)
+    import re as _re
+    import urllib.request
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import loadgen
+
+    from mxnet_tpu import compile as _compile
+
+    tcontainer = loadgen.build_demo_container(models=2, dim=8)
+    tserver = serving.ModelServer(tcontainer).start()
+    tserver.warmup()
+    tfront = serving.HttpFrontEnd(tserver).start()
+    lrep = loadgen.run_inproc(duration=1.0, mode="closed", concurrency=4,
+                              dim=8, server=tserver, warmup=False)
+    if not lrep["completed"]:
+        print(f"FAIL: loadgen completed nothing: {lrep}")
+        return 1
+    text = urllib.request.urlopen(tfront.url + "/metrics",
+                                  timeout=10).read().decode()
+
+    def metric(name, **labels):
+        pat = name + r"\{" if labels else name + r"[ {]"
+        for line in text.splitlines():
+            if not _re.match(pat, line):
+                continue
+            if all(f'{k}="{v}"' in line for k, v in labels.items()):
+                return float(line.rsplit(" ", 1)[1])
+        return None
+
+    sstats = tserver.stats()["models"]
+    scraped = {m: metric("mxtpu_serving_requests_total", model=m,
+                         outcome="completed") for m in sstats}
+    if any(scraped[m] != sstats[m]["completed"] for m in sstats):
+        print(f"FAIL: /metrics serving counters {scraped} disagree with "
+              f"server stats")
+        return 1
+    if int(sum(scraped.values())) != lrep["completed"]:
+        print(f"FAIL: scraped completions {sum(scraped.values())} != "
+              f"loadgen report {lrep['completed']}")
+        return 1
+    chits = metric("mxtpu_compile_cache_hits_total", site="serving")
+    if chits is None or \
+            chits != _compile.stats()["serving"]["hits"]:
+        print(f"FAIL: /metrics compile series {chits} disagree with "
+              f"compile.stats()")
+        return 1
+    stalls = metric("mxtpu_watchdog_stalls_total")
+    if not stalls or stalls < 2:  # phase 3 (trainer) + phase 6 (serving)
+        print(f"FAIL: watchdog stall series missing/low: {stalls}")
+        return 1
+    if metric("mxtpu_flight_ring_size") is None or \
+            not [l for l in text.splitlines()
+                 if l.startswith("mxtpu_device_memory_live_bytes")]:
+        print("FAIL: flight/memory series missing from /metrics")
+        return 1
+    tfront.close()
+    tserver.drain(timeout=10.0)
+    print(f"  /metrics scrape consistent: {int(sum(scraped.values()))} "
+          f"completions, {int(stalls)} stalls, compile hits {int(chits)}")
+
+    import json as _json2
+
+    crash_root = os.path.join(ckpt_dir, "crash")
+    for marker, want_point, want_step_events in (
+            ("trainer_step", "trainer.step", True),
+            ("serving_batch", "serving.batch", False)):
+        bundles = [os.path.join(crash_root, n)
+                   for n in os.listdir(crash_root) if marker in n]
+        if not bundles:
+            print(f"FAIL: no {marker} crash bundle found")
+            return 1
+        with open(os.path.join(max(bundles, key=os.path.getmtime),
+                               "flight.json")) as f:
+            ftail = _json2.load(f)
+        if not ftail:
+            print(f"FAIL: empty flight tail in the {marker} bundle")
+            return 1
+        if not any(e.get("point") == want_point for e in ftail):
+            print(f"FAIL: {marker} flight tail never names {want_point}")
+            return 1
+        if want_step_events and not any(
+                str(e.get("kind", "")).startswith("step.")
+                for e in ftail):
+            print(f"FAIL: {marker} flight tail carries no step events")
+            return 1
+    print("  flight-recorder tails in both crash bundles name the "
+          "wedged points")
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

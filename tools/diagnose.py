@@ -3,9 +3,16 @@
 package versions, hardware, environment variables; the script users attach
 to bug reports).
 
-    python tools/diagnose.py
+    python tools/diagnose.py            # human-readable report
+    python tools/diagnose.py --json     # one machine-readable JSON doc
+    python tools/diagnose.py --gc       # also prune the compile cache
+
+Every section both prints its human text and contributes a dict to the
+``--json`` document (CI scrapers consume the JSON; humans the text —
+same collection pass either way).
 """
 import importlib
+import json
 import os
 import platform
 import sys
@@ -15,96 +22,134 @@ import time
 # the framework checks need the package importable either way
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+_ECHO = True
+
+
+def _p(*args, **kwargs):
+    if _ECHO:
+        print(*args, **kwargs)
+
 
 def check_python():
-    print("----------Python Info----------")
-    print("Version      :", platform.python_version())
-    print("Compiler     :", platform.python_compiler())
-    print("Build        :", platform.python_build())
-    print("Arch         :", platform.architecture())
+    _p("----------Python Info----------")
+    out = {"version": platform.python_version(),
+           "compiler": platform.python_compiler(),
+           "build": list(platform.python_build()),
+           "arch": list(platform.architecture())}
+    _p("Version      :", out["version"])
+    _p("Compiler     :", out["compiler"])
+    _p("Build        :", tuple(out["build"]))
+    _p("Arch         :", tuple(out["arch"]))
+    return out
 
 
 def check_pip():
-    print("------------Pip Info-----------")
+    _p("------------Pip Info-----------")
     try:
         import pip
 
-        print("Version      :", pip.__version__)
+        _p("Version      :", pip.__version__)
+        return {"version": pip.__version__}
     except ImportError:
-        print("No corresponding pip install for current python.")
+        _p("No corresponding pip install for current python.")
+        return {"version": None}
 
 
 def check_framework():
-    print("---------Framework Info--------")
+    _p("---------Framework Info--------")
+    out = {}
     try:
         import mxnet_tpu as mx
 
-        print("Version      :", mx.__version__)
-        print("Directory    :", os.path.dirname(mx.__file__))
+        out["version"] = mx.__version__
+        out["directory"] = os.path.dirname(mx.__file__)
+        _p("Version      :", out["version"])
+        _p("Directory    :", out["directory"])
         from mxnet_tpu import runtime
 
         feats = runtime.Features()
         on = [name for name in feats.keys() if feats.is_enabled(name)]
-        print("Features     :", ", ".join(sorted(on)))
+        out["features"] = sorted(on)
+        _p("Features     :", ", ".join(sorted(on)))
     except ImportError as e:
-        print("framework import failed:", e)
+        out["error"] = str(e)
+        _p("framework import failed:", e)
+    return out
 
 
 def check_deps():
-    print("--------Dependency Info--------")
+    _p("--------Dependency Info--------")
+    out = {}
     for name in ("jax", "jaxlib", "numpy", "flax", "optax"):
         try:
             mod = importlib.import_module(name)
-            print(f"{name:<13}:", getattr(mod, "__version__", "unknown"))
+            out[name] = getattr(mod, "__version__", "unknown")
+            _p(f"{name:<13}:", out[name])
         except ImportError:
-            print(f"{name:<13}: not installed")
+            out[name] = None
+            _p(f"{name:<13}: not installed")
+    return out
 
 
 def check_hardware():
-    print("---------Hardware Info---------")
-    print("Machine      :", platform.machine())
-    print("Platform     :", platform.platform())
+    _p("---------Hardware Info---------")
+    out = {"machine": platform.machine(), "platform": platform.platform()}
+    _p("Machine      :", out["machine"])
+    _p("Platform     :", out["platform"])
     try:
         import jax
 
         t0 = time.time()
         devices = jax.devices()
-        print("Devices      :", devices, f"(probe {time.time() - t0:.2f}s)")
-        print("Processes    :", jax.process_count())
+        out["devices"] = [str(d) for d in devices]
+        out["probe_s"] = round(time.time() - t0, 2)
+        out["process_count"] = jax.process_count()
+        _p("Devices      :", devices, f"(probe {out['probe_s']:.2f}s)")
+        _p("Processes    :", out["process_count"])
     except Exception as e:  # tunnel down, etc.
-        print("Device probe failed:", e)
+        out["device_probe_error"] = f"{type(e).__name__}: {e}"
+        _p("Device probe failed:", e)
+    return out
 
 
 def check_environment():
-    print("----------Environment----------")
+    _p("----------Environment----------")
+    out = {}
     for k, v in sorted(os.environ.items()):
         if k.startswith(("MXNET_", "MXTPU_", "JAX_", "XLA_", "TPU_",
                          "DMLC_", "OMP_", "LD_", "PYTHON")):
-            print(f"{k}={v}")
+            out[k] = v
+            _p(f"{k}={v}")
+    return out
 
 
 def check_analysis():
     """The static-analysis knobs (docs/ANALYSIS.md) with effective state."""
-    print("---------Analysis Knobs--------")
-    verify = os.environ.get("MXNET_TPU_VERIFY", "<unset>")
-    sanitize = os.environ.get("MXNET_TPU_SANITIZE", "<unset>")
-    distcheck = os.environ.get("MXNET_TPU_DISTCHECK", "<unset>")
-    print(f"MXNET_TPU_VERIFY={verify}  "
-          "(graph verifier inside simple_bind; on unless 0)")
-    print(f"MXNET_TPU_SANITIZE={sanitize}  "
-          "(sync-hazard sanitizer; off unless 1)")
-    print(f"MXNET_TPU_DISTCHECK={distcheck}  "
-          "(distributed-correctness analyzer: ShardedTrainer auto-check, "
-          "donation poisoning, compile-cache tracking; on unless 0)")
+    _p("---------Analysis Knobs--------")
+    out = {"MXNET_TPU_VERIFY": os.environ.get("MXNET_TPU_VERIFY"),
+           "MXNET_TPU_SANITIZE": os.environ.get("MXNET_TPU_SANITIZE"),
+           "MXNET_TPU_DISTCHECK": os.environ.get("MXNET_TPU_DISTCHECK")}
+    _p(f"MXNET_TPU_VERIFY={out['MXNET_TPU_VERIFY'] or '<unset>'}  "
+       "(graph verifier inside simple_bind; on unless 0)")
+    _p(f"MXNET_TPU_SANITIZE={out['MXNET_TPU_SANITIZE'] or '<unset>'}  "
+       "(sync-hazard sanitizer; off unless 1)")
+    _p(f"MXNET_TPU_DISTCHECK={out['MXNET_TPU_DISTCHECK'] or '<unset>'}  "
+       "(distributed-correctness analyzer: ShardedTrainer auto-check, "
+       "donation poisoning, compile-cache tracking; on unless 0)")
     try:
         from mxnet_tpu.analysis import distcheck as _dc
         from mxnet_tpu.analysis import sanitize as _san
         from mxnet_tpu.analysis.verify import verify_enabled
 
-        print("effective     : verify=%s sanitize=%s distcheck=%s"
-              % (verify_enabled(), _san.ACTIVE, _dc.enabled()))
+        out["effective"] = {"verify": verify_enabled(),
+                            "sanitize": bool(_san.ACTIVE),
+                            "distcheck": _dc.enabled()}
+        _p("effective     : verify=%s sanitize=%s distcheck=%s"
+           % (verify_enabled(), _san.ACTIVE, _dc.enabled()))
     except ImportError as e:
-        print("analysis import failed:", e)
+        out["error"] = str(e)
+        _p("analysis import failed:", e)
+    return out
 
 
 def check_compile_cache(gc=False):
@@ -116,81 +161,95 @@ def check_compile_cache(gc=False):
     process; the on-disk census and last-warmup record persist. With
     ``gc=True`` (the ``--gc`` flag), stale-fingerprint and corrupt disk
     entries are pruned."""
-    print("--------Compile Cache----------")
+    _p("--------Compile Cache----------")
+    out = {"MXNET_TPU_CACHE_DIR": os.environ.get("MXNET_TPU_CACHE_DIR"),
+           "MXNET_TPU_COMPILE_SERVICE":
+               os.environ.get("MXNET_TPU_COMPILE_SERVICE")}
     try:
         from mxnet_tpu import compile as _compile
 
-        print(f"MXNET_TPU_CACHE_DIR="
-              f"{os.environ.get('MXNET_TPU_CACHE_DIR', '<unset>')}  "
-              "(persistent executable cache; memory-only when unset)")
-        print(f"MXNET_TPU_COMPILE_SERVICE="
-              f"{os.environ.get('MXNET_TPU_COMPILE_SERVICE', '<unset>')}  "
-              "(0 bypasses the service — raw jax.jit)")
+        _p(f"MXNET_TPU_CACHE_DIR="
+           f"{out['MXNET_TPU_CACHE_DIR'] or '<unset>'}  "
+           "(persistent executable cache; memory-only when unset)")
+        _p(f"MXNET_TPU_COMPILE_SERVICE="
+           f"{out['MXNET_TPU_COMPILE_SERVICE'] or '<unset>'}  "
+           "(0 bypasses the service — raw jax.jit)")
         svc = _compile.stats()
+        out["service"] = svc
         if svc:
-            print(f"{'service site':<16s} {'hits':>7s} {'misses':>7s} "
-                  f"{'disk':>6s} {'compiles':>9s} {'compile_ms':>11s} "
-                  f"{'load_ms':>8s}")
+            _p(f"{'service site':<16s} {'hits':>7s} {'misses':>7s} "
+               f"{'disk':>6s} {'compiles':>9s} {'compile_ms':>11s} "
+               f"{'load_ms':>8s}")
             for site, st in svc.items():
-                print(f"{site:<16s} {st['hits']:>7d} {st['misses']:>7d} "
-                      f"{st['disk_hits']:>6d} {st['compiles']:>9d} "
-                      f"{st['compile_ms']:>11.1f} {st['load_ms']:>8.1f}")
+                _p(f"{site:<16s} {st['hits']:>7d} {st['misses']:>7d} "
+                   f"{st['disk_hits']:>6d} {st['compiles']:>9d} "
+                   f"{st['compile_ms']:>11.1f} {st['load_ms']:>8.1f}")
         else:
-            print("service stats : none this process")
+            _p("service stats : none this process")
         rep = _compile.disk_report()
+        out["disk"] = rep
         if rep["dir"] is None:
-            print("disk cache    : disabled (set MXNET_TPU_CACHE_DIR)")
+            _p("disk cache    : disabled (set MXNET_TPU_CACHE_DIR)")
         else:
-            print(f"disk cache    : {rep['dir']}")
-            print(f"  fingerprint : {rep['fingerprint']}")
-            print(f"  entries     : {rep['entries']} "
-                  f"({rep['bytes']} bytes), xla-native "
-                  f"{rep['xla_entries']}")
+            _p(f"disk cache    : {rep['dir']}")
+            _p(f"  fingerprint : {rep['fingerprint']}")
+            _p(f"  entries     : {rep['entries']} "
+               f"({rep['bytes']} bytes), xla-native "
+               f"{rep['xla_entries']}")
             if rep["stale_entries"]:
-                print(f"  stale       : {rep['stale_entries']} entries "
-                      f"({rep['stale_bytes']} bytes) from other "
-                      "fingerprints — prune with --gc")
+                _p(f"  stale       : {rep['stale_entries']} entries "
+                   f"({rep['stale_bytes']} bytes) from other "
+                   "fingerprints — prune with --gc")
             if gc:
-                out = _compile.gc_cache()
-                print(f"  gc          : removed {out['removed_stale']} "
-                      f"stale + {out['removed_corrupt']} corrupt "
-                      f"({out['bytes_freed']} bytes freed)")
+                gced = _compile.gc_cache()
+                out["gc"] = gced
+                _p(f"  gc          : removed {gced['removed_stale']} "
+                   f"stale + {gced['removed_corrupt']} corrupt "
+                   f"({gced['bytes_freed']} bytes freed)")
         warm = _compile.last_warmup()
+        out["last_warmup"] = warm
         if warm is None:
-            print("last warmup   : none recorded")
+            _p("last warmup   : none recorded")
         else:
-            print(f"last warmup   : {warm.get('entries', 0)} entries — "
-                  f"{warm.get('compiled', 0)} compiled, "
-                  f"{warm.get('disk', 0)} from disk, "
-                  f"{warm.get('cached', 0)} cached, "
-                  f"{warm.get('pending', 0)} pending, "
-                  f"{len(warm.get('errors', []))} errors")
+            _p(f"last warmup   : {warm.get('entries', 0)} entries — "
+               f"{warm.get('compiled', 0)} compiled, "
+               f"{warm.get('disk', 0)} from disk, "
+               f"{warm.get('cached', 0)} cached, "
+               f"{warm.get('pending', 0)} pending, "
+               f"{len(warm.get('errors', []))} errors")
     except ImportError as e:
-        print("compile service import failed:", e)
+        out["error"] = str(e)
+        _p("compile service import failed:", e)
     try:
         from mxnet_tpu.analysis import distcheck as _dc
 
         stats = _dc.cache_stats()
+        out["cache_tracking"] = bool(_dc.CACHE_TRACK)
+        out["cache_stats"] = {f"{kind}:{site}": rec
+                              for (kind, site), rec in stats.items()}
         if not stats:
-            print("no cache activity recorded "
-                  "(tracking %s; MXNET_TPU_DISTCHECK=0 disables)"
-                  % ("on" if _dc.CACHE_TRACK else "off"))
+            _p("no cache activity recorded "
+               "(tracking %s; MXNET_TPU_DISTCHECK=0 disables)"
+               % ("on" if _dc.CACHE_TRACK else "off"))
         else:
-            print(f"{'site':<44s} {'hits':>8s} {'misses':>8s} "
-                  f"{'distinct':>9s}")
+            _p(f"{'site':<44s} {'hits':>8s} {'misses':>8s} "
+               f"{'distinct':>9s}")
             for (kind, site), rec in stats.items():
                 label = f"{kind}:{site}"[:44]
-                print(f"{label:<44s} {rec['hits']:>8d} "
-                      f"{rec['misses']:>8d} {rec['distinct_keys']:>9d}")
+                _p(f"{label:<44s} {rec['hits']:>8d} "
+                   f"{rec['misses']:>8d} {rec['distinct_keys']:>9d}")
         churn = _dc.check_churn()
+        out["churn"] = [str(i) for i in churn]
         if churn:
-            print("churn findings:")
+            _p("churn findings:")
             for i in churn:
-                print(" ", i)
+                _p(" ", i)
         else:
-            print("churn findings: none")
+            _p("churn findings: none")
     except ImportError as e:
-        print("distcheck import failed:", e)
+        out["distcheck_error"] = str(e)
+        _p("distcheck import failed:", e)
+    return out
 
 
 def check_serving():
@@ -198,108 +257,224 @@ def check_serving():
     admission rejects, tail latency) + the last drain event. Live stats
     only exist inside a serving process; the knobs and the drain record
     persist."""
-    print("---------Serving Knobs---------")
-    print(f"MXNET_TPU_SERVING={os.environ.get('MXNET_TPU_SERVING', '<unset>')}  "
-          "(buckets / max_queue / max_wait_ms / timeout_ms / stage — "
-          "docs/SERVING.md)")
+    _p("---------Serving Knobs---------")
+    out = {"MXNET_TPU_SERVING": os.environ.get("MXNET_TPU_SERVING")}
+    _p(f"MXNET_TPU_SERVING={out['MXNET_TPU_SERVING'] or '<unset>'}  "
+       "(buckets / max_queue / max_wait_ms / timeout_ms / stage — "
+       "docs/SERVING.md)")
     try:
         from mxnet_tpu import serving
 
-        print("effective     :", serving.describe())
+        out["effective"] = serving.describe()
+        _p("effective     :", out["effective"])
         live = serving.live_stats()
+        out["live_servers"] = live
         if not live:
-            print("live servers  : none in this process")
+            _p("live servers  : none in this process")
         for srv in live:
-            print(f"server {srv['name']!r}: started={srv['started']} "
-                  f"draining={srv['draining']} "
-                  f"uptime={srv['uptime_s']}s")
-            print(f"  {'model':<20s} {'queue':>6s} {'done':>8s} "
-                  f"{'rej':>6s} {'fail':>5s} {'stall':>5s} {'fill':>6s} "
-                  f"{'p50ms':>7s} {'p99ms':>7s}")
+            _p(f"server {srv['name']!r}: started={srv['started']} "
+               f"draining={srv['draining']} "
+               f"uptime={srv['uptime_s']}s")
+            _p(f"  {'model':<20s} {'queue':>6s} {'done':>8s} "
+               f"{'rej':>6s} {'fail':>5s} {'stall':>5s} {'fill':>6s} "
+               f"{'p50ms':>7s} {'p99ms':>7s}")
             for name, m in srv["models"].items():
-                print(f"  {name:<20s} {m['queue_depth']:>6d} "
-                      f"{m['completed']:>8d} {m['rejected']:>6d} "
-                      f"{m['failed']:>5d} {m['stalled_batches']:>5d} "
-                      f"{str(m['batch_fill_ratio']):>6s} "
-                      f"{str(m['p50_ms']):>7s} {str(m['p99_ms']):>7s}")
-                print(f"    bucket census: {m['bucket_census']}")
+                _p(f"  {name:<20s} {m['queue_depth']:>6d} "
+                   f"{m['completed']:>8d} {m['rejected']:>6d} "
+                   f"{m['failed']:>5d} {m['stalled_batches']:>5d} "
+                   f"{str(m['batch_fill_ratio']):>6s} "
+                   f"{str(m['p50_ms']):>7s} {str(m['p99_ms']):>7s}")
+                _p(f"    bucket census: {m['bucket_census']}")
             if srv.get("last_drain"):
-                print("  last drain  :", srv["last_drain"])
+                _p("  last drain  :", srv["last_drain"])
         from mxnet_tpu import preempt as _preempt
 
         ev = _preempt.last_drain()
+        out["last_drain_event"] = ev
         if ev is not None:
-            print("last drain evt:", ev.get("path"),
-                  f"(cause {ev.get('signal') or ev.get('reason')}, "
-                  f"exit {ev.get('exit_code')})")
+            _p("last drain evt:", ev.get("path"),
+               f"(cause {ev.get('signal') or ev.get('reason')}, "
+               f"exit {ev.get('exit_code')})")
     except ImportError as e:
-        print("serving import failed:", e)
+        out["error"] = str(e)
+        _p("serving import failed:", e)
+    return out
 
 
 def check_watchdog():
     """Watchdog knobs + the most recent crash bundle, if one exists
     (docs/ROBUSTNESS.md) — the first thing to read after a wedged run."""
-    print("---------Watchdog Knobs--------")
-    print(f"MXNET_TPU_WATCHDOG={os.environ.get('MXNET_TPU_WATCHDOG', '<unset>')}  "
-          "(hang deadlines; off unless set)")
-    print(f"MXNET_TPU_CRASH_DIR={os.environ.get('MXNET_TPU_CRASH_DIR', '<unset>')}  "
-          "(crash-bundle dir; default <tmpdir>/mxtpu_crash)")
+    _p("---------Watchdog Knobs--------")
+    out = {"MXNET_TPU_WATCHDOG": os.environ.get("MXNET_TPU_WATCHDOG"),
+           "MXNET_TPU_CRASH_DIR": os.environ.get("MXNET_TPU_CRASH_DIR")}
+    _p(f"MXNET_TPU_WATCHDOG={out['MXNET_TPU_WATCHDOG'] or '<unset>'}  "
+       "(hang deadlines; off unless set)")
+    _p(f"MXNET_TPU_CRASH_DIR={out['MXNET_TPU_CRASH_DIR'] or '<unset>'}  "
+       "(crash-bundle dir; default <tmpdir>/mxtpu_crash)")
     try:
         from mxnet_tpu import watchdog
 
-        cfg = watchdog.describe()
-        print("effective     :", cfg)
+        out["effective"] = watchdog.describe()
+        _p("effective     :", out["effective"])
         bundle = watchdog.latest_bundle()
+        out["latest_bundle"] = bundle
         if bundle is None:
-            print("crash bundles : none found in", watchdog.crash_dir())
-            return
-        print("latest bundle :", bundle)
-        import json
-
+            _p("crash bundles : none found in", watchdog.crash_dir())
+            return out
+        _p("latest bundle :", bundle)
         try:
             with open(os.path.join(bundle, "report.json")) as f:
                 rep = json.load(f)
-            print("  stalled at  : %s (%s) after %.1fs (deadline %gs)"
-                  % (rep.get("point"), rep.get("label") or "-",
-                     rep.get("elapsed_s", 0.0), rep.get("deadline_s", 0.0)))
-            print("  written     :", rep.get("time"))
-            print("  files       :", ", ".join(sorted(os.listdir(bundle))))
+            out["latest_bundle_report"] = {
+                "point": rep.get("point"), "label": rep.get("label"),
+                "elapsed_s": rep.get("elapsed_s"),
+                "deadline_s": rep.get("deadline_s"),
+                "time": rep.get("time")}
+            out["latest_bundle_files"] = sorted(os.listdir(bundle))
+            _p("  stalled at  : %s (%s) after %.1fs (deadline %gs)"
+               % (rep.get("point"), rep.get("label") or "-",
+                  rep.get("elapsed_s", 0.0), rep.get("deadline_s", 0.0)))
+            _p("  written     :", rep.get("time"))
+            _p("  files       :", ", ".join(sorted(os.listdir(bundle))))
         except (OSError, ValueError) as e:
-            print("  (report.json unreadable:", e, ")")
+            out["latest_bundle_error"] = str(e)
+            _p("  (report.json unreadable:", e, ")")
     except ImportError as e:
-        print("watchdog import failed:", e)
+        out["error"] = str(e)
+        _p("watchdog import failed:", e)
+    return out
 
 
 def check_preempt():
     """Preemption-drain knobs + the most recent drain event
     (docs/ROBUSTNESS.md "Preemption & elasticity") — how the last run
     ended matters for how to restart it."""
-    print("---------Preempt Knobs---------")
-    print(f"MXNET_TPU_PREEMPT={os.environ.get('MXNET_TPU_PREEMPT', '<unset>')}  "
-          "(auto-install SIGTERM/SIGINT drain handlers; off unless set)")
-    print(f"MXNET_TPU_PREEMPT_EXIT_CODE="
-          f"{os.environ.get('MXNET_TPU_PREEMPT_EXIT_CODE', '<unset>')}  "
-          "(drain exit code; default 75 = reschedule me)")
-    print(f"MXNET_TPU_PREEMPT_DIR="
-          f"{os.environ.get('MXNET_TPU_PREEMPT_DIR', '<unset>')}  "
-          "(drain-event dir; default: the crash dir)")
-    print(f"MXNET_TPU_PREEMPT_RESHARD="
-          f"{os.environ.get('MXNET_TPU_PREEMPT_RESHARD', '<unset>')}  "
-          "(0 forbids resuming checkpoints on a different topology)")
+    _p("---------Preempt Knobs---------")
+    out = {k: os.environ.get(k)
+           for k in ("MXNET_TPU_PREEMPT", "MXNET_TPU_PREEMPT_EXIT_CODE",
+                     "MXNET_TPU_PREEMPT_DIR", "MXNET_TPU_PREEMPT_RESHARD")}
+    _p(f"MXNET_TPU_PREEMPT={out['MXNET_TPU_PREEMPT'] or '<unset>'}  "
+       "(auto-install SIGTERM/SIGINT drain handlers; off unless set)")
+    _p(f"MXNET_TPU_PREEMPT_EXIT_CODE="
+       f"{out['MXNET_TPU_PREEMPT_EXIT_CODE'] or '<unset>'}  "
+       "(drain exit code; default 75 = reschedule me)")
+    _p(f"MXNET_TPU_PREEMPT_DIR="
+       f"{out['MXNET_TPU_PREEMPT_DIR'] or '<unset>'}  "
+       "(drain-event dir; default: the crash dir)")
+    _p(f"MXNET_TPU_PREEMPT_RESHARD="
+       f"{out['MXNET_TPU_PREEMPT_RESHARD'] or '<unset>'}  "
+       "(0 forbids resuming checkpoints on a different topology)")
     try:
         from mxnet_tpu import preempt
 
-        print("effective     :", preempt.describe())
+        out["effective"] = preempt.describe()
+        _p("effective     :", out["effective"])
         ev = preempt.last_drain()
+        out["last_drain"] = ev
         if ev is None:
-            print("drain events  : none found in", preempt.drain_dir())
-            return
-        print("last drain    :", ev.get("path"))
-        print("  cause       :", ev.get("signal") or ev.get("reason"))
-        print("  checkpoint  :", ev.get("final_checkpoint"))
-        print("  exit code   :", ev.get("exit_code"))
+            _p("drain events  : none found in", preempt.drain_dir())
+            return out
+        _p("last drain    :", ev.get("path"))
+        _p("  cause       :", ev.get("signal") or ev.get("reason"))
+        _p("  checkpoint  :", ev.get("final_checkpoint"))
+        _p("  exit code   :", ev.get("exit_code"))
     except ImportError as e:
-        print("preempt import failed:", e)
+        out["error"] = str(e)
+        _p("preempt import failed:", e)
+    return out
+
+
+def check_telemetry():
+    """Telemetry state (docs/OBSERVABILITY.md): knobs, the metrics
+    registry snapshot (post-collection, the same values ``/metrics``
+    serves), flight-recorder census, device-memory sample, last step
+    breakdown, and tracked-executable aggregates."""
+    _p("--------Telemetry--------------")
+    out = {"MXNET_TPU_TELEMETRY": os.environ.get("MXNET_TPU_TELEMETRY"),
+           "MXNET_TPU_FLIGHT": os.environ.get("MXNET_TPU_FLIGHT")}
+    _p(f"MXNET_TPU_TELEMETRY={out['MXNET_TPU_TELEMETRY'] or '<unset>'}  "
+       "(push instrumentation; on unless 0)")
+    _p(f"MXNET_TPU_FLIGHT={out['MXNET_TPU_FLIGHT'] or '<unset>'}  "
+       "(flight-recorder ring size; default 1024, 0 disables)")
+    try:
+        from mxnet_tpu import telemetry
+
+        desc = telemetry.describe()
+        out["effective"] = desc
+        _p("effective     :", {k: desc[k] for k in
+                               ("enabled", "flight_ring", "flight_events",
+                                "memory_sample_every")})
+        snap = telemetry.metrics_snapshot()
+        out["metrics"] = snap
+        _p(f"metrics       : {len(snap)} registered series families "
+           "(full values in --json / GET /metrics)")
+        from mxnet_tpu.telemetry import flight, memory, steps
+
+        tail = flight.tail(5)
+        out["flight_tail"] = tail
+        _p(f"flight        : {sum(flight.counts().values())} events "
+           f"({dict(flight.counts())})")
+        for ev in tail:
+            _p(f"  {ev['kind']:<16s} {ev['point']:<16s} "
+               f"{str(ev['label'] or '')[:40]}")
+        mem = memory.device_memory()
+        out["device_memory"] = mem
+        for r in mem:
+            _p(f"memory        : {r['device']} live={r['live_bytes']} "
+               f"peak={r['peak_bytes']} ({r['source']})")
+        last = steps.last()
+        out["last_step"] = last
+        if last:
+            _p(f"last step     : #{last['step']} "
+               f"{last['duration_ms']}ms phases={last['phases']}"
+               + (f" mfu_xla={last['mfu_xla']}"
+                  if last.get("mfu_xla") is not None else ""))
+        from mxnet_tpu.telemetry import memory as _mem
+
+        top = _mem.top_executables(5)
+        out["top_executables"] = top
+        for r in top:
+            _p(f"resident exe  : [{r['site']}] {r['resident_bytes']} B "
+               f"(temp {r['temp_bytes']}, out {r['output_bytes']})")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("telemetry import failed:", e)
+    return out
+
+
+SECTIONS = (
+    ("python", check_python),
+    ("pip", check_pip),
+    ("framework", check_framework),
+    ("dependencies", check_deps),
+    ("hardware", check_hardware),
+    ("environment", check_environment),
+    ("analysis", check_analysis),
+    ("compile_cache", check_compile_cache),
+    ("serving", check_serving),
+    ("watchdog", check_watchdog),
+    ("preempt", check_preempt),
+    ("telemetry", check_telemetry),
+)
+
+
+def collect(gc=False, echo=True):
+    """Run every section; returns the full report dict. ``echo=False``
+    collects silently (the --json path)."""
+    global _ECHO
+    prev, _ECHO = _ECHO, echo
+    report = {}
+    try:
+        for name, fn in SECTIONS:
+            try:
+                report[name] = fn(gc=gc) if name == "compile_cache" \
+                    else fn()
+            except Exception as e:  # one broken probe must not kill the rest
+                report[name] = {"error": f"{type(e).__name__}: {e}"}
+                _p(f"{name} check failed:", e)
+    finally:
+        _ECHO = prev
+    return report
 
 
 def main(argv=None):
@@ -310,18 +485,13 @@ def main(argv=None):
     ap.add_argument("--gc", action="store_true",
                     help="prune stale-fingerprint / corrupt entries from "
                          "the on-disk compile cache (MXNET_TPU_CACHE_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the whole report as one JSON document "
+                         "(CI scraping) instead of human text")
     args = ap.parse_args(argv if argv is not None else [])
-    check_python()
-    check_pip()
-    check_framework()
-    check_deps()
-    check_hardware()
-    check_environment()
-    check_analysis()
-    check_compile_cache(gc=args.gc)
-    check_serving()
-    check_watchdog()
-    check_preempt()
+    report = collect(gc=args.gc, echo=not args.json)
+    if args.json:
+        print(json.dumps(report, sort_keys=True, default=repr))
 
 
 if __name__ == "__main__":

@@ -44,6 +44,15 @@ partition-spec-literal
                   exact bug class the distcheck sharding verifier exists
                   for. Keep axis names in the vocabulary (or route
                   through ``parallel/``).
+print-call        a bare ``print()`` inside the ``mxnet_tpu/`` package:
+                  library state must flow through structured surfaces —
+                  ``mxnet_tpu.log`` (leveled, capturable) or
+                  ``mxnet_tpu.telemetry`` (scrapeable) — never stdout a
+                  fleet operator cannot collect or silence. ``tools/``,
+                  tests, and ``if __name__ == "__main__"`` demo blocks
+                  are exempt; the few user-facing table printers that ARE
+                  an API contract (``Block.summary``,
+                  ``visualization.print_summary``) are baselined.
 serving-blocking-call
                   a blocking call in ``serving/`` code outside a
                   ``watchdog.sync(...)`` span: device syncs
@@ -90,7 +99,7 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 RULES = ("bare-except", "host-sync", "raw-jax-compat", "raw-jit",
          "unseeded-random", "no-schema-doc", "unused-import",
          "mutable-default", "unbounded-sync", "partition-spec-literal",
-         "serving-blocking-call")
+         "serving-blocking-call", "print-call")
 
 # serving/ blocking-call vocabulary: device syncs (flagged regardless of
 # arguments) and waits that are unbounded only in their zero-arg form
@@ -157,6 +166,11 @@ class _Linter(ast.NodeVisitor):
         # serving/ code must never wait unboundedly outside watchdog.sync
         self.is_serving = "serving" in rel.replace(os.sep, "/").split("/")[:-1]
         self._serving_pending = []  # (node, message) resolved in finish()
+        # print-call applies only inside the mxnet_tpu package (tools/,
+        # tests and standalone scripts print by design)
+        self.in_package = rel.replace(os.sep, "/").split("/")[0] \
+            == "mxnet_tpu"
+        self._main_intervals = []  # `if __name__ == "__main__"` bodies
         self.pspec_aliases = set()  # local names bound to PartitionSpec
         # module-level import bookkeeping for unused-import
         self.imports = {}   # local name -> (lineno, col, "import x" repr)
@@ -183,8 +197,35 @@ class _Linter(ast.NodeVisitor):
                      "exception type")
         self.generic_visit(node)
 
+    def visit_If(self, node):
+        # `if __name__ == "__main__":` demo/smoke blocks are print-call
+        # exempt (they run as scripts, not as library code)
+        t = node.test
+        if isinstance(t, ast.Compare) and len(t.ops) == 1 \
+                and isinstance(t.ops[0], ast.Eq):
+            sides = [t.left] + list(t.comparators)
+            names = {s.id for s in sides if isinstance(s, ast.Name)}
+            consts = {s.value for s in sides
+                      if isinstance(s, ast.Constant)}
+            if "__name__" in names and "__main__" in consts:
+                self._main_intervals.append(
+                    (node.lineno, getattr(node, "end_lineno",
+                                          node.lineno)))
+        self.generic_visit(node)
+
     def visit_Call(self, node):
         func = node.func
+        if self.in_package and isinstance(func, ast.Name) \
+                and func.id == "print":
+            line = getattr(node, "lineno", 1)
+            if not any(lo <= line <= hi
+                       for lo, hi in self._main_intervals):
+                self.add(node, "print-call",
+                         "bare print() in library code goes to a stdout "
+                         "no fleet operator collects; use mxnet_tpu.log "
+                         "(leveled logging) or mxnet_tpu.telemetry "
+                         "(metrics/flight recorder) — tools/, tests and "
+                         "__main__ blocks are exempt")
         if isinstance(func, ast.Attribute):
             if func.attr in _SYNC_METHODS and not node.args \
                     and not node.keywords:
