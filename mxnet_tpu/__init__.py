@@ -25,6 +25,18 @@ from .base import (MXNetError, apply_platform_env as _ape,
 _ape()
 _midi()
 del _ape, _midi
+
+import os as _os
+
+if _os.environ.get("MXTPU_GANG_DIR"):
+    # launched by the elastic gang supervisor: arm the heartbeat channel
+    # + the PeerLostError->exit-76 excepthook (import-light; skipped
+    # entirely outside a supervised run)
+    from .elastic import maybe_install_from_env as _gang
+
+    _gang()
+    del _gang
+del _os
 from .context import (Context, cpu, tpu, gpu, cpu_pinned, num_tpus, num_gpus,
                       current_context)
 from . import engine
@@ -52,8 +64,8 @@ def __getattr__(name):
                 "test_utils", "amp", "parallel", "np", "npx", "visualization",
                 "contrib", "util", "runtime", "onnx", "operator", "library",
                 "log", "name", "attribute", "faults", "checkpoint",
-                "analysis", "watchdog", "preempt", "compile", "serving",
-                "telemetry"):
+                "analysis", "watchdog", "preempt", "elastic", "compile",
+                "serving", "telemetry"):
         import importlib
 
         try:

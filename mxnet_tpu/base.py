@@ -200,13 +200,26 @@ def probe_backend_or_fallback(skip_env="MXTPU_SKIP_PROBE"):
     return plat
 
 
-def maybe_init_distributed():
+# the gang generation this process last rendezvoused at (None = never):
+# an elastic supervisor restart hands workers a NEW generation + a NEW
+# coordinator address, and re-joining requires leaving the old epoch
+_dist_generation = None
+
+
+def maybe_init_distributed(generation=None):
     """Join the multi-host rendezvous when launched by tools/launch.py
     (parity: KVStoreDist workers connecting to the dmlc tracker via
     DMLC_* env). jax.distributed.initialize only works BEFORE the XLA
     backend spins up, so mxnet_tpu/__init__ calls this at import; the
     kvstore path calls it again as a fallback and warns loudly instead of
-    silently degrading to a single-worker group."""
+    silently degrading to a single-worker group.
+
+    Coordinator re-rendezvous (elastic gang restarts): a supervisor spawns
+    generation N+1 with a fresh ``MXTPU_GANG_GENERATION`` and a fresh
+    coordinator port, with surviving ranks renumbered densely. A process
+    already joined at an older generation (possible when a surviving
+    worker re-enters in place rather than being re-exec'd) leaves the dead
+    epoch via ``jax.distributed.shutdown()`` and joins the new one."""
     import logging
     import os
 
@@ -216,17 +229,39 @@ def maybe_init_distributed():
     num = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
     if num <= 1:
         return
+    if generation is None:
+        try:
+            generation = int(os.environ.get("MXTPU_GANG_GENERATION", "0"))
+        except ValueError:
+            generation = 0
+    global _dist_generation
     import jax
     from jax._src import distributed as _dist
 
+    log = logging.getLogger("mxnet_tpu")
     if getattr(_dist.global_state, "client", None) is not None:
-        return  # already joined
+        if not generation or generation == _dist_generation:
+            return  # already joined this incarnation
+        # gang restart: the old coordinator epoch is dead — leave it
+        # before rendezvousing at the new address
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:
+            log.error(
+                "gang generation %s -> %s: jax.distributed.shutdown "
+                "failed (%s) — this worker cannot re-rendezvous and "
+                "stays in its stale group", _dist_generation, generation,
+                e)
+            return
+        log.warning("gang: re-rendezvous at generation %s (coordinator "
+                    "%s, %d workers)", generation, coord, num)
     try:
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=num,
             process_id=int(os.environ.get("MXTPU_WORKER_ID", "0")))
+        _dist_generation = generation or None
     except RuntimeError as e:
-        logging.getLogger("mxnet_tpu").error(
+        log.error(
             "MXTPU_COORDINATOR=%s is set but jax.distributed could not "
             "initialize (%s) — this worker will run as an ISOLATED "
             "single-process group and dist_* stores will NOT aggregate. "

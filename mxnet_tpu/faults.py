@@ -35,6 +35,7 @@ Spec grammar (semicolon-separated entries)::
     <point>:<mode>[@<trigger>][:<arg>]
 
     mode     raise | delay | corrupt | nan | kill | hang | preempt
+             | peerloss
     trigger  N        fire on the N-th invocation only (1-based)
              N+       fire on every invocation from the N-th onward
              N,M,...  fire on the listed invocations
@@ -43,6 +44,7 @@ Spec grammar (semicolon-separated entries)::
              (default: 1 — fire on the first invocation)
     arg      delay: sleep seconds (default 0.05)
              hang: wedge seconds (default 3600 — "forever" at test scale)
+             peerloss: the gang rank to SIGKILL (required)
              raise/corrupt/nan/kill: unused
 
 Examples::
@@ -52,6 +54,7 @@ Examples::
     MXNET_TPU_FAULTS="trainer.step:nan@3+"         # NaN grads from step 3
     MXNET_TPU_FAULTS="trainer.step:kill@5"         # SIGKILL on 5th step
     MXNET_TPU_FAULTS="trainer.step:preempt@6"      # SIGTERM on 6th step
+    MXNET_TPU_FAULTS="trainer.step:peerloss@6:1"   # SIGKILL gang rank 1
 
 Modes at a point ``faults.point(name, payload=None)``:
 
@@ -74,6 +77,12 @@ Modes at a point ``faults.point(name, payload=None)``:
              the "stuck collective / wedged fetch" scenario the watchdog
              (mxnet_tpu.watchdog) exists to detect; every watchdog path
              is deterministically testable with it
+    peerloss SIGKILL the gang peer holding rank `arg` (pid looked up
+             through its heartbeat file in MXTPU_GANG_DIR, see
+             mxnet_tpu.elastic.kill_peer) and CONTINUE — the "a peer
+             host just vanished" scenario the elastic gang supervisor
+             exists to recover from, seedable and deterministic like
+             every other fault; naming the *own* rank is a self-SIGKILL
 
 :func:`retry` is the reusable exponential-backoff wrapper used by the io
 decode path and the model-zoo fetch path; injected faults are retryable
@@ -147,7 +156,7 @@ def _parse(spec, seed):
         else:
             mode, trig_tok = mode_tok, "1"
         if mode not in ("raise", "delay", "corrupt", "nan", "kill", "hang",
-                        "preempt"):
+                        "preempt", "peerloss"):
             raise ValueError(f"unknown fault mode {mode!r} in {entry!r}")
         # per-point sub-seed keeps streams independent yet reproducible
         out[name] = _PointSpec(mode, _parse_trigger(trig_tok),
@@ -271,6 +280,14 @@ def point(name, payload=None):
         # finishes); without them the interpreter dies like a real
         # unhandled preemption
         os.kill(os.getpid(), signal.SIGTERM)
+        return payload
+    if spec.mode == "peerloss":
+        from . import elastic as _elastic
+
+        # SIGKILL a named gang peer and continue — this process then
+        # observes the loss the real way (PeerLostError / supervisor)
+        _elastic.kill_peer(int(spec.arg) if spec.arg is not None
+                           else None)
         return payload
     if spec.mode == "corrupt" and isinstance(payload, (bytes, bytearray)):
         return _corrupt_bytes(payload, spec.rng)

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 from .. import optimizer as opt_mod
 from ..ndarray import NDArray
+from ..preempt import PEERLOST_EXIT_CODE
+from ..telemetry import flight as _flight
 from ..watchdog import StallError
 from .base import KVStoreBase
 
@@ -52,7 +54,15 @@ class PeerLostError(StallError):
     gang coordinates: ``op`` (the collective), ``rank``, ``num_workers``.
     A gang supervisor catching this can tear down and restart the group
     elastically instead of letting every survivor wedge forever.
+
+    ``exit_code`` (76, the ladder's ``peer-lost`` rung) is what a worker
+    that cannot recover should exit with; the gang excepthook installed
+    by ``mxnet_tpu.elastic`` maps an *uncaught* PeerLostError onto it
+    automatically, so the supervisor sees a reschedulable ladder code
+    instead of the interpreter's generic 1.
     """
+
+    exit_code = PEERLOST_EXIT_CODE
 
     def __init__(self, op, rank, num_workers, stall):
         super().__init__(stall.point, stall.label, stall.elapsed,
@@ -60,6 +70,8 @@ class PeerLostError(StallError):
         self.op = op
         self.rank = rank
         self.num_workers = num_workers
+        _flight.rec("gang.peer_lost", stall.point,
+                    f"{op} rank {rank}/{num_workers}")
         self.args = (
             f"kvstore {op!r}: peer lost — rank {rank}/{num_workers} "
             f"waited {stall.elapsed:.1f}s (deadline {stall.deadline:g}s) "

@@ -21,12 +21,20 @@ with nothing written; with this module the run *drains*:
    bundles, and exits with :func:`exit_code` (default 75, ``EX_TEMPFAIL``)
    so gang supervisors / wrappers know to *reschedule*, not fail the job.
 
-Exit codes, so wrappers can tell the failure modes apart::
+Exit codes — the **ladder** gang supervisors (``mxnet_tpu.elastic``,
+``tools/launch.py --supervise``) and wrappers dispatch on::
 
     75   graceful preemption drain (this module; reschedule + resume)
+    76   peer lost (EX_PROTOCOL) — a kvstore collective raised
+         PeerLostError and nobody recovered; the gang excepthook
+         (elastic.install_excepthook) maps it onto the process exit code
     86   watchdog stall abort (mxnet_tpu.watchdog.ABORT_EXIT_CODE)
     137  SIGKILL — a hard preemption with no grace; resume from the last
          periodic checkpoint (CheckpointManager falls back past torn files)
+
+:data:`EXIT_LADDER` names them; :func:`classify_exit` and
+:func:`most_severe` are the shared decision helpers (``tools/launch.py``
+keeps an import-light copy of the severity table — keep them in sync).
 
 Environment knobs (all optional; see ``tools/diagnose.py``)::
 
@@ -54,12 +62,60 @@ import time
 from . import log as _log
 from .telemetry import flight as _flight
 
-__all__ = ["DrainRequested", "DRAIN_EXIT_CODE", "install", "installed",
+__all__ = ["DrainRequested", "DRAIN_EXIT_CODE", "PEERLOST_EXIT_CODE",
+           "EXIT_LADDER", "canonical_exit", "classify_exit",
+           "exit_severity", "most_severe", "install", "installed",
            "uninstall", "maybe_install_from_env", "requested", "request",
            "clear", "event", "drain", "exit_code", "drain_dir",
            "last_drain", "describe"]
 
-DRAIN_EXIT_CODE = 75  # EX_TEMPFAIL: transient failure, please reschedule
+DRAIN_EXIT_CODE = 75     # EX_TEMPFAIL: transient failure, please reschedule
+PEERLOST_EXIT_CODE = 76  # EX_PROTOCOL: a gang peer died under a collective
+
+#: the exit-code ladder, least to most severe; anything unlisted is an
+#: "error" — a real bug, NOT a reschedule
+EXIT_LADDER = {0: "ok", DRAIN_EXIT_CODE: "drain",
+               PEERLOST_EXIT_CODE: "peer-lost", 86: "watchdog-abort",
+               137: "killed"}
+
+# severity: ok < drain < peer-lost < watchdog-abort < killed < error
+_SEVERITY = {code: i for i, code in enumerate(EXIT_LADDER)}
+_UNKNOWN_SEVERITY = len(EXIT_LADDER)
+
+
+def canonical_exit(rc):
+    """Normalise a ``Popen.returncode`` (negative = killed by signal N)
+    to the shell convention ``128 + N`` (so SIGKILL is always 137)."""
+    if rc is None:
+        return None
+    rc = int(rc)
+    return 128 - rc if rc < 0 else rc
+
+
+def classify_exit(rc) -> str:
+    """The ladder name for an exit code: ``ok`` / ``drain`` /
+    ``peer-lost`` / ``watchdog-abort`` / ``killed`` / ``error``."""
+    return EXIT_LADDER.get(canonical_exit(rc), "error")
+
+
+def exit_severity(rc) -> int:
+    """Ladder position (higher = worse); unknown codes rank worst."""
+    return _SEVERITY.get(canonical_exit(rc), _UNKNOWN_SEVERITY)
+
+
+def most_severe(codes):
+    """The most severe exit code of an iterable (0 when empty) — what a
+    launcher should propagate for a gang, instead of whichever child it
+    happened to ``wait()`` on last."""
+    best, best_sev = 0, -1
+    for rc in codes:
+        rc = canonical_exit(rc)
+        if rc is None:
+            continue
+        sev = _SEVERITY.get(rc, _UNKNOWN_SEVERITY)
+        if sev > best_sev:
+            best, best_sev = rc, sev
+    return best
 
 _logger = _log.get_logger("mxnet_tpu.preempt")
 
@@ -298,6 +354,12 @@ def drain(save=None, exit=True, code=None, directory=None):
     ev = event() or {"reason": "drain() without a pending request",
                      "t_wall": time.time(), "pid": os.getpid()}
     ev["exit_code"] = int(code if code is not None else exit_code())
+    if os.environ.get("MXTPU_GANG_DIR"):
+        # supervised run: the gang coordinates make the drain record
+        # attributable in the supervisor's post-mortem
+        ev["gang"] = {"dir": os.environ["MXTPU_GANG_DIR"],
+                      "rank": os.environ.get("MXTPU_WORKER_ID"),
+                      "generation": os.environ.get("MXTPU_GANG_GENERATION")}
     hook = save
     if hook is None:
         from . import watchdog as _watchdog

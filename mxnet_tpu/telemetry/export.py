@@ -216,6 +216,37 @@ def _collect_preempt():
                         1.0 if mod.requested() else 0.0)
 
 
+def _collect_gang():
+    mod = sys.modules.get("mxnet_tpu.elastic")
+    if mod is None:
+        return
+    st = mod.GANG_STATS
+    if st.get("state") == "idle":
+        return  # neither supervising nor supervised in this process
+    _registry.gauge("mxtpu_gang_generation",
+                    "Current gang incarnation (bumps on every "
+                    "coordinated restart)").set(st.get("generation", 0))
+    _registry.gauge("mxtpu_gang_state_code",
+                    "Gang state machine position "
+                    "(mxnet_tpu.elastic.STATE_CODES)").set(
+                        mod.STATE_CODES.get(st.get("state"), -1))
+    _registry.gauge("mxtpu_gang_workers_alive",
+                    "Worker processes currently alive under the "
+                    "supervisor").set(st.get("workers_alive", 0))
+    restarts = _registry.counter("mxtpu_gang_restarts_total",
+                                 "Gang coordinated restarts by trigger",
+                                 labels=("reason",))
+    for reason, n in st.get("restarts", {}).items():
+        restarts.set_total(n, reason)
+    _registry.counter("mxtpu_gang_degraded_seconds_total",
+                      "Wall-clock spent DEGRADED (a rank lost, gang "
+                      "draining/restarting)").set_total(
+                          st.get("degraded_s", 0.0))
+    _registry.counter("mxtpu_gang_postmortems_total",
+                      "Structured give-up bundles written").set_total(
+                          st.get("postmortems", 0))
+
+
 def _ensure_defaults():
     global _defaults_installed
     if _defaults_installed:
@@ -228,6 +259,7 @@ def _ensure_defaults():
     register_collector("memory", _collect_memory)
     register_collector("flight", _collect_flight)
     register_collector("preempt", _collect_preempt)
+    register_collector("gang", _collect_gang)
 
 
 # ------------------------------------------------------ standalone server ---

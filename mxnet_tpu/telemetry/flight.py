@@ -24,6 +24,9 @@ Recorded event kinds (the coarse seams, never the per-op hot path):
     ``preempt.request`` / ``preempt.drain`` preemption lifecycle
     ``io.error``                    prefetch worker failure
     ``oom``                         RESOURCE_EXHAUSTED surfaced
+    ``gang.*``                      elastic gang lifecycle (state, spawn,
+                                    exit, restart, peer_lost, peer_kill,
+                                    heartbeat_lost, postmortem)
 
 Memory contract: the ring is a preallocated list of fixed slot lists
 written **in place** — after the first lap no list/dict/tuple is

@@ -24,23 +24,27 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import faults, gluon, preempt, watchdog
+from mxnet_tpu import elastic, faults, gluon, preempt, watchdog
 from mxnet_tpu.checkpoint import CheckpointManager
 from mxnet_tpu.kvstore import PeerLostError
 from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 
 @pytest.fixture(autouse=True)
 def _clean():
     """Every test starts and ends with no armed faults, no preempt
-    handlers/flag, and the ambient watchdog config."""
+    handlers/flag, no gang worker plumbing, and the ambient watchdog
+    config."""
     faults.reset()
     preempt.uninstall()
     yield
     faults.reset()
     preempt.uninstall()
+    elastic.stop_heartbeat()
+    elastic.uninstall_excepthook()
     watchdog.configure_from_env()
 
 
@@ -444,3 +448,361 @@ def test_sigterm_drain_then_resharded_resume_across_device_counts(tmp_path):
             continue
         np.testing.assert_allclose(ref[k], got[k], rtol=1e-4, atol=1e-5,
                                    err_msg=k)
+
+
+# ============================================= gang supervisor (ISSUE 10) ---
+
+def _py(body):
+    return [sys.executable, "-c", body]
+
+
+def _supervise(cmd, tmp_path, n=2, **kw):
+    kw.setdefault("poll", 0.05)
+    kw.setdefault("backoff", 0.01)
+    kw.setdefault("grace", 2.0)
+    return elastic.GangSupervisor(cmd, num_workers=n,
+                                  run_dir=str(tmp_path / "run"), **kw)
+
+
+def test_exit_ladder_helpers():
+    assert preempt.canonical_exit(-9) == 137  # Popen SIGKILL convention
+    assert preempt.classify_exit(75) == "drain"
+    assert preempt.classify_exit(76) == "peer-lost"
+    assert preempt.classify_exit(86) == "watchdog-abort"
+    assert preempt.classify_exit(-9) == "killed"
+    assert preempt.classify_exit(3) == "error"
+    # severity: ok < drain < peer-lost < abort < killed < real error
+    sevs = [preempt.exit_severity(c) for c in (0, 75, 76, 86, 137, 1)]
+    assert sevs == sorted(sevs) and len(set(sevs)) == len(sevs)
+    assert preempt.most_severe([0, 75, 0]) == 75
+    assert preempt.most_severe([75, -9, 86]) == 137
+    assert preempt.most_severe([137, 1, 75]) == 1  # a real bug outranks
+    assert preempt.most_severe([]) == 0
+    assert PeerLostError.exit_code == preempt.PEERLOST_EXIT_CODE == 76
+
+
+def test_supervisor_all_ok_is_done(tmp_path):
+    sup = _supervise(_py("import sys; sys.exit(0)"), tmp_path)
+    assert sup.run() == 0
+    assert sup.state == "done" and sup.generation == 1
+    assert sup.restarts_used == 0
+    summary = json.loads((tmp_path / "run" / "gang.json").read_text())
+    assert summary["state"] == "done"
+
+
+def test_supervisor_restart_on_drain_code(tmp_path):
+    """Exit 75 at generation 1 -> gang-wide restart at generation 2."""
+    body = ("import os, sys; sys.exit("
+            "75 if os.environ['MXTPU_GANG_GENERATION'] == '1' else 0)")
+    sup = _supervise(_py(body), tmp_path)
+    assert sup.run() == 0
+    assert sup.state == "done" and sup.generation == 2
+    assert sup.restarts_used == 1
+    assert "drain" in sup.history[0]["reason"]
+    states = [s for _, s in sup.state_history]
+    for want in ("degraded", "rescheduling", "resuming", "done"):
+        assert want in states, states
+    # ranks were NOT shrunk: 75 is a clean drain, the slot survives
+    assert len(sup.slots) == 2
+
+
+def test_supervisor_restart_on_watchdog_abort_notes_bundles(tmp_path):
+    """Exit 86 restarts too, and the incarnation record carries the crash
+    bundles the aborting worker left behind."""
+    run = tmp_path / "run"
+    (run / "crash" / "bundle-test-p1-1-trainer_step").mkdir(parents=True)
+    body = ("import os, sys; sys.exit("
+            "86 if os.environ['MXTPU_GANG_GENERATION'] == '1' else 0)")
+    sup = _supervise(_py(body), tmp_path, n=1)
+    assert sup.run() == 0
+    assert sup.generation == 2 and sup.restarts_used == 1
+    assert "watchdog-abort" in sup.history[0]["reason"]
+    assert any("bundle-test" in b
+               for b in sup.history[0]["crash_bundles"])
+
+
+def test_supervisor_kill_shrinks_census_and_renumbers(tmp_path):
+    """A SIGKILLed rank (137) is a lost slot under shrink_on_kill: the
+    next generation runs with fewer, densely renumbered ranks at a fresh
+    coordinator epoch; survivors are drained (SIGTERM) first."""
+    out = tmp_path / "census"
+    out.mkdir()
+    body = (
+        "import os, sys, time, signal, pathlib\n"
+        "gen = os.environ['MXTPU_GANG_GENERATION']\n"
+        "rank = os.environ['MXTPU_WORKER_ID']\n"
+        "pathlib.Path(%r, 'gen%%s-rank%%s' %% (gen, rank)).write_text(\n"
+        "    os.environ['MXTPU_NUM_WORKERS'] + ' '\n"
+        "    + os.environ['MXTPU_COORDINATOR'])\n"
+        "if gen == '1' and rank == '1':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "time.sleep(0.4)\n"
+        "sys.exit(0)\n" % str(out))
+    sup = _supervise(_py(body), tmp_path, shrink_on_kill=True)
+    assert sup.run() == 0
+    assert sup.state == "done" and sup.generation == 2
+    assert len(sup.slots) == 1
+    assert sup.history[0]["shrunk"] == [{"rank": 1, "host": "local"}]
+    files = sorted(os.listdir(out))
+    assert files == ["gen1-rank0", "gen1-rank1", "gen2-rank0"]
+    n1, coord1 = (out / "gen1-rank0").read_text().split()
+    n2, coord2 = (out / "gen2-rank0").read_text().split()
+    assert (n1, n2) == ("2", "1")
+    assert coord1 != coord2  # new generation == new coordinator epoch
+
+
+def test_supervisor_budget_exhaustion_writes_postmortem(tmp_path):
+    sup = _supervise(_py("import sys; sys.exit(86)"), tmp_path, n=1,
+                     max_restarts=1)
+    assert sup.run() == 1
+    assert sup.state == "failed" and sup.generation == 2
+    assert sup.postmortem_path and os.path.isfile(sup.postmortem_path)
+    pm = json.loads(open(sup.postmortem_path).read())
+    assert "restart budget exhausted (1/1)" in pm["reason"]
+    assert [g["exits"] for g in pm["generations"]] == [{"0": 86}] * 2
+    for key in ("heartbeats", "crash_bundles", "drain_events",
+                "state_history", "supervisor_flight_tail"):
+        assert key in pm
+
+
+def test_supervisor_fatal_exit_no_restart(tmp_path):
+    """A non-ladder exit is a real bug: no restart, post-mortem, the
+    child's code propagates."""
+    sup = _supervise(_py("import sys; sys.exit(3)"), tmp_path, n=1)
+    assert sup.run() == 3
+    assert sup.state == "failed" and sup.generation == 1
+    assert sup.restarts_used == 0
+    assert "error" in sup.history[0]["reason"]
+    assert sup.postmortem_path and os.path.isfile(sup.postmortem_path)
+
+
+def test_supervisor_heartbeat_dead_worker_is_killed(tmp_path):
+    """Slow-vs-dead: a live process whose heartbeats stop is declared
+    dead (SIGKILL) instead of being trusted forever."""
+    body = (
+        "import json, os, time\n"
+        "d = os.environ['MXTPU_GANG_DIR']\n"
+        "rec = {'rank': 0, 'pid': os.getpid(), 't_wall': time.time(),\n"
+        "       'generation': int(os.environ['MXTPU_GANG_GENERATION'])}\n"
+        "json.dump(rec, open(os.path.join(d, 'rank-0.json'), 'w'))\n"
+        "time.sleep(30)\n")
+    sup = _supervise(_py(body), tmp_path, n=1, max_restarts=0,
+                     dead_after=0.6)
+    assert sup.run() == 1  # budget 0: first loss already exhausts it
+    assert sup.state == "failed"
+    assert sup.history[0]["liveness_killed"] == [0]
+    assert "heartbeat-lost" in sup.history[0]["reason"]
+
+
+def test_heartbeat_roundtrip(tmp_path):
+    hb = elastic.start_heartbeat(tmp_path, rank=3, generation=2,
+                                 interval=0.05)
+    assert hb is elastic.start_heartbeat(tmp_path, 3, 2)  # idempotent
+    time.sleep(0.15)
+    beats = elastic.read_heartbeats(tmp_path)
+    assert 3 in beats
+    rec = beats[3]
+    assert rec["pid"] == os.getpid() and rec["generation"] == 2
+    assert rec["state"] == "running" and rec["age_s"] < 5.0
+    assert "flight_tail" in rec
+    elastic.stop_heartbeat()
+
+
+def test_kill_peer_and_peerloss_fault_mode(tmp_path, monkeypatch):
+    """The seedable gang drill: 'peerloss' SIGKILLs the named rank via
+    its heartbeat file."""
+    sleeper = subprocess.Popen([sys.executable, "-c",
+                                "import time; time.sleep(60)"])
+    try:
+        (tmp_path / "rank-1.json").write_text(
+            json.dumps({"rank": 1, "pid": sleeper.pid,
+                        "generation": 1, "t_wall": time.time()}))
+        monkeypatch.setenv("MXTPU_GANG_DIR", str(tmp_path))
+        faults.configure("p:peerloss@2:1")
+        faults.point("p")                      # 1st invocation: no fire
+        assert sleeper.poll() is None
+        assert faults.point("p", "payload") == "payload"  # fires, returns
+        assert sleeper.wait(timeout=10) == -signal.SIGKILL
+    finally:
+        if sleeper.poll() is None:
+            sleeper.kill()
+    # a peerloss without a target or without a gang is a loud error
+    with pytest.raises(RuntimeError, match="no target rank"):
+        elastic.kill_peer(None)
+    with pytest.raises(RuntimeError, match="no heartbeat for rank 7"):
+        elastic.kill_peer(7, run_dir=str(tmp_path))
+
+
+def test_excepthook_maps_exit_code(monkeypatch, capsys):
+    codes = []
+    monkeypatch.setattr(elastic, "_exit_fn", codes.append)
+    prev = sys.excepthook
+    elastic.install_excepthook()
+    try:
+        class _Lost(RuntimeError):
+            exit_code = 76
+
+        sys.excepthook(_Lost, _Lost("peer gone"), None)
+        assert codes == [76]
+        assert "peer gone" in capsys.readouterr().err  # traceback printed
+        sys.excepthook(RuntimeError, RuntimeError("plain"), None)
+        assert codes == [76]  # no exit_code attr: normal handling only
+    finally:
+        elastic.uninstall_excepthook()
+    assert sys.excepthook is prev
+
+
+def test_gang_metrics_exported(tmp_path):
+    """mxtpu_gang_generation / restart counters ride the standard
+    /metrics scrape path."""
+    body = ("import os, sys; sys.exit("
+            "75 if os.environ['MXTPU_GANG_GENERATION'] == '1' else 0)")
+    sup = _supervise(_py(body), tmp_path, n=1)
+    assert sup.run() == 0
+    from mxnet_tpu.telemetry import export
+
+    text = export.render_prometheus()
+    assert f"mxtpu_gang_generation {sup.generation}" in text
+    assert 'mxtpu_gang_restarts_total{reason="drain"}' in text
+    assert "mxtpu_gang_state_code" in text
+    snap = export.metrics_snapshot()
+    assert snap["mxtpu_gang_generation"]["series"][0]["value"] == \
+        sup.generation
+
+
+def test_maybe_init_distributed_re_rendezvous(monkeypatch):
+    """A new gang generation means a new coordinator epoch: an
+    already-joined process shuts its old client down and re-initializes
+    at the new address; the same generation is a no-op."""
+    import jax
+    from jax._src import distributed as _dist
+
+    from mxnet_tpu import base
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(("init", kw)))
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.append(("shutdown",)))
+    monkeypatch.setattr(_dist.global_state, "client", object(),
+                        raising=False)
+    monkeypatch.setenv("MXTPU_COORDINATOR", "127.0.0.1:9999")
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "2")
+    monkeypatch.setenv("MXTPU_WORKER_ID", "1")
+    monkeypatch.setenv("MXTPU_GANG_GENERATION", "3")
+    monkeypatch.setattr(base, "_dist_generation", 1)
+    base.maybe_init_distributed()
+    assert calls[0] == ("shutdown",)
+    assert calls[1][0] == "init"
+    assert calls[1][1] == {"coordinator_address": "127.0.0.1:9999",
+                           "num_processes": 2, "process_id": 1}
+    assert base._dist_generation == 3
+    calls.clear()
+    base.maybe_init_distributed()  # same generation: already joined
+    assert calls == []
+
+
+def test_launch_local_propagates_most_severe(tmp_path):
+    import launch
+
+    drain = ("import os, sys; "
+             "sys.exit([0, 75][int(os.environ['MXTPU_WORKER_ID'])])")
+    assert launch.launch_local(2, _py(drain), grace=5.0) == 75
+    err = ("import os, sys; "
+           "sys.exit([1, 75][int(os.environ['MXTPU_WORKER_ID'])])")
+    assert launch.launch_local(2, _py(err), grace=5.0) == 1
+    assert launch.most_severe([0, None, -9, 75]) == 137
+
+
+def test_launch_ssh_command_quoting():
+    import launch
+
+    argv = launch._ssh_command("host1", {"A": "x y", "B": "1"},
+                               ["python", "train.py", "--name", "a b"],
+                               cwd="/tmp/w d")
+    assert argv[:4] == ["ssh", "-o", "StrictHostKeyChecking=no", "-tt"]
+    assert argv[4] == "host1"
+    remote = argv[5]
+    assert "cd '/tmp/w d'" in remote
+    assert "exec env" in remote and "A='x y'" in remote
+    assert remote.endswith("python train.py --name 'a b'")
+
+
+# ------------------------------------- supervised kill-and-recover drill ---
+
+GANG_CHILD = os.path.join(REPO, "tests", "_gang_child.py")
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+
+def _gang_env(extra=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    for k in ("MXNET_TPU_FAULTS", "XLA_FLAGS", "MXTPU_GANG_DIR",
+              "MXTPU_COORDINATOR", "MXTPU_NUM_WORKERS",
+              "MXTPU_WORKER_ID", "MXTPU_GANG_GENERATION"):
+        env.pop(k, None)
+    env.update(extra or {})
+    return env
+
+
+@pytest.mark.skipif(not hasattr(os, "kill"), reason="needs POSIX signals")
+def test_gang_supervisor_kill_and_recover_resharded(tmp_path):
+    """The acceptance drill: under ``tools/launch.py --supervise -n 2``,
+    SIGKILLing one worker mid-epoch (seeded ``peerloss`` fault at rank
+    0's step 6) auto-recovers with ZERO human intervention — the
+    supervisor drains the survivor (its checkpoint lands at the exact
+    step), shrinks the census 2 -> 1, bumps to generation 2 at a fresh
+    coordinator epoch, and the resumed worker reshards 4 -> 2 devices and
+    matches the uninterrupted run's loss trajectory within 1e-4."""
+    ref_out = tmp_path / "ref.npz"
+    proc = subprocess.run(
+        [sys.executable, GANG_CHILD],
+        env=_gang_env({"GC_DEVICES": "4", "GC_TOTAL": "12",
+                       "GC_EPOCH": "4",
+                       "GC_CKPT_DIR": str(tmp_path / "refck"),
+                       "GC_OUT": str(ref_out)}),
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+
+    run_dir = tmp_path / "run"
+    out = tmp_path / "out.npz"
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "--supervise", "-n", "2",
+         "--run-dir", str(run_dir), "--shrink-on-kill",
+         "--max-restarts", "3", "--backoff", "0.1", "--grace", "60",
+         "--poll", "0.05", sys.executable, GANG_CHILD],
+        env=_gang_env({"GC_BASE_DEVICES": "2", "GC_TOTAL": "12",
+                       "GC_EPOCH": "4", "GC_STEP_SLEEP": "0.25",
+                       "GC_OUT": str(out),
+                       "GC_FAULTS_GEN1": "trainer.step:peerloss@6:1"}),
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+    summary = json.loads((run_dir / "gang.json").read_text())
+    assert summary["state"] == "done"
+    assert summary["generation"] == 2 and summary["restarts_used"] == 1
+    assert "killed" in summary["history"][0]["reason"]
+    assert summary["history"][0]["shrunk"] == [{"rank": 1,
+                                                "host": "local"}]
+
+    ref, got = dict(np.load(ref_out)), dict(np.load(out))
+    start = int(got["__start__"])
+    assert 0 < start < 12          # resumed mid-run, not from scratch
+    assert int(got["__generation__"]) == 2
+    assert int(got["__devices__"]) == 2  # resharded from the ref's 4
+    np.testing.assert_allclose(ref["__losses__"][start:],
+                               got["__losses__"], rtol=1e-4, atol=1e-5)
+    for k in ref:
+        if k.startswith("__"):
+            continue
+        np.testing.assert_allclose(ref[k], got[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+    # the survivor's drain was recorded with its gang coordinates
+    drains = [n for n in os.listdir(run_dir / "ckpt")
+              if n.startswith("drain-")]
+    assert drains, "no drain event recorded by the drained survivor"
+    ev = json.loads((run_dir / "ckpt" / sorted(drains)[-1]).read_text())
+    assert ev["gang"]["generation"] == "1"
+    assert ev["final_checkpoint"] == "written"

@@ -65,6 +65,8 @@ def test_diagnose_runs(capsys):
     assert "jax" in out
     # watchdog knobs + most-recent-crash-bundle report (docs/ROBUSTNESS.md)
     assert "Watchdog Knobs" in out and "MXNET_TPU_WATCHDOG" in out
+    # gang supervision knobs (docs/ROBUSTNESS.md "Gang supervision")
+    assert "Gang" in out and "MXNET_TPU_GANG_MAX_RESTARTS" in out
     # telemetry section (docs/OBSERVABILITY.md)
     assert "Telemetry" in out and "MXNET_TPU_TELEMETRY" in out
 
@@ -81,13 +83,50 @@ def test_diagnose_json_machine_readable(capsys):
     report = json.loads(out)  # exactly one parseable document, no prose
     for section in ("python", "framework", "dependencies", "hardware",
                     "environment", "analysis", "compile_cache",
-                    "serving", "watchdog", "preempt", "telemetry"):
+                    "serving", "watchdog", "preempt", "gang",
+                    "telemetry"):
         assert section in report, section
     assert report["python"]["version"]
     assert "jax" in report["dependencies"]
     tele = report["telemetry"]
     assert "metrics" in tele and "flight_tail" in tele
     assert "device_memory" in tele
+
+
+def test_diagnose_gang_report_reads_run_dir(tmp_path, capsys,
+                                            monkeypatch):
+    """The Gang section reports the run dir's gang.json (generation,
+    per-incarnation restart reasons), per-rank last heartbeats, and any
+    post-mortem bundle."""
+    import json
+    import time
+
+    import diagnose
+
+    summary = {"state": "failed", "generation": 3, "restarts_used": 2,
+               "max_restarts": 2,
+               "history": [{"generation": 1, "exits": {"0": 137},
+                            "reason": "rank 0 exited 137 (killed)"},
+                           {"generation": 2, "exits": {"0": 86},
+                            "reason": "rank 0 exited 86 "
+                                      "(watchdog-abort)"},
+                           {"generation": 3, "exits": {"0": 86},
+                            "reason": "rank 0 exited 86 "
+                                      "(watchdog-abort)"}]}
+    (tmp_path / "gang.json").write_text(json.dumps(summary))
+    (tmp_path / "rank-0.json").write_text(json.dumps(
+        {"rank": 0, "generation": 3, "state": "running", "steps": 7,
+         "pid": 12345, "t_wall": time.time() - 4.0}))
+    (tmp_path / "postmortem-x-p1.json").write_text("{}")
+    monkeypatch.setenv("MXNET_TPU_GANG_DIR", str(tmp_path))
+
+    out = diagnose.check_gang()
+    text = capsys.readouterr().out
+    assert out["summary"]["generation"] == 3
+    assert out["heartbeats"][0]["steps"] == 7
+    assert out["postmortems"] == ["postmortem-x-p1.json"]
+    assert "restarts 2/2" in text and "watchdog-abort" in text
+    assert "rank 0 beat" in text and "postmortem-x-p1.json" in text
 
 
 def test_rec2idx_matches_writer(tmp_path):
@@ -323,7 +362,9 @@ def test_chaos_smoke_recovers(tmp_path):
     gracefully and resumes resharded on half the simulated devices, and
     the phase-6 serving drill passes (wedged serving batch -> bundle +
     continued service; subprocess SIGTERM under load -> all admitted
-    requests answered, exit 75) — exit code 0."""
+    requests answered, exit 75), and the phase-8 gang drill recovers a
+    supervised 2-worker run from a mid-epoch SIGKILL (generation bump,
+    resharded resume, loss parity) — exit code 0."""
     import chaos_smoke
 
     from mxnet_tpu import faults, preempt
@@ -350,3 +391,7 @@ def test_chaos_smoke_recovers(tmp_path):
     for bundle in os.listdir(crash):
         with open(crash / bundle / "flight.json") as f:
             assert json.load(f), f"empty flight tail in {bundle}"
+    # phase 8 left the supervised gang's summary: a 1-restart recovery
+    with open(tmp_path / "gang" / "run" / "gang.json") as f:
+        summary = json.load(f)
+    assert summary["state"] == "done" and summary["generation"] == 2

@@ -31,6 +31,13 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     stats and loadgen's report, and the crash bundles written by the
     injected hangs embed non-empty flight-recorder tails naming the
     wedged points (``trainer.step`` with step events, ``serving.batch``),
+  * the GANG drill (phase 8): a supervised 2-worker run under
+    ``tools/launch.py --supervise`` loses rank 1 to a seeded SIGKILL
+    (the ``peerloss`` fault) mid-epoch — the elastic supervisor drains
+    the survivor, shrinks the census 2 -> 1, restarts at generation 2 on
+    a fresh coordinator epoch, and the resharded resume matches the
+    uninterrupted run's loss trajectory within 1e-4, zero human
+    intervention (``--skip-gang-drill`` for harnesses that cannot spawn),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -153,6 +160,96 @@ def serve_drill(seed=0):
     return 1  # unreachable: drain() exits
 
 
+def gang_drill(root=None):
+    """Phase 8: the elastic gang acceptance drill, as subprocesses.
+
+    An uninterrupted 4-device reference run first, then a supervised
+    2-worker gang (``launch.py --supervise -n 2``) whose rank 0 SIGKILLs
+    rank 1 at step 6 through the seeded ``peerloss`` fault. Success =
+    the supervisor recovered without help: generation 2, census shrunk
+    to the survivor, resharded resume, and the post-kill loss trajectory
+    within 1e-4 of the reference. Both runs are wall-clock bounded."""
+    import json as _json
+    import subprocess
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = os.path.join(repo, "tests", "_gang_child.py")
+    launch = os.path.join(repo, "tools", "launch.py")
+    root = root or tempfile.mkdtemp(prefix="chaos_gang_")
+    os.makedirs(root, exist_ok=True)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    # a clean slate: the drill seeds its own faults/gang/rendezvous env
+    for k in ("MXNET_TPU_FAULTS", "XLA_FLAGS", "MXTPU_GANG_DIR",
+              "MXTPU_COORDINATOR", "MXTPU_NUM_WORKERS",
+              "MXTPU_WORKER_ID", "MXTPU_GANG_GENERATION"):
+        env.pop(k, None)
+
+    ref_out = os.path.join(root, "ref.npz")
+    proc = subprocess.run(
+        [sys.executable, child],
+        env={**env, "GC_DEVICES": "4", "GC_TOTAL": "12", "GC_EPOCH": "4",
+             "GC_CKPT_DIR": os.path.join(root, "refck"),
+             "GC_OUT": ref_out},
+        capture_output=True, text=True, timeout=240)
+    if proc.returncode != 0:
+        print(f"FAIL: gang reference run exited {proc.returncode}:\n"
+              f"{proc.stderr[-2000:]}")
+        return 1
+
+    run_dir = os.path.join(root, "run")
+    out = os.path.join(root, "out.npz")
+    proc = subprocess.run(
+        [sys.executable, launch, "--supervise", "-n", "2",
+         "--run-dir", run_dir, "--shrink-on-kill", "--max-restarts", "3",
+         "--backoff", "0.1", "--grace", "60", "--poll", "0.05",
+         sys.executable, child],
+        env={**env, "GC_BASE_DEVICES": "2", "GC_TOTAL": "12",
+             "GC_EPOCH": "4", "GC_STEP_SLEEP": "0.25", "GC_OUT": out,
+             "GC_FAULTS_GEN1": "trainer.step:peerloss@6:1"},
+        capture_output=True, text=True, timeout=240)
+    if proc.returncode != 0:
+        print(f"FAIL: supervised gang exited {proc.returncode}:\n"
+              f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        return 1
+
+    with open(os.path.join(run_dir, "gang.json")) as f:
+        summary = _json.load(f)
+    if summary["state"] != "done" or summary["generation"] != 2 \
+            or summary["restarts_used"] != 1:
+        print(f"FAIL: gang summary is not a 1-restart recovery: "
+              f"{ {k: summary.get(k) for k in ('state', 'generation', 'restarts_used')} }")
+        return 1
+    gen1 = summary["history"][0]
+    if "killed" not in (gen1.get("reason") or "") or \
+            gen1.get("shrunk") != [{"rank": 1, "host": "local"}]:
+        print(f"FAIL: generation 1 did not lose rank 1 to a kill: "
+              f"reason={gen1.get('reason')!r} shrunk={gen1.get('shrunk')}")
+        return 1
+
+    ref, got = dict(np.load(ref_out)), dict(np.load(out))
+    start = int(got["__start__"])
+    if not 0 < start < 12 or int(got["__generation__"]) != 2 \
+            or int(got["__devices__"]) != 2:
+        print(f"FAIL: resume was not a mid-run generation-2 reshard: "
+              f"start={start} gen={int(got['__generation__'])} "
+              f"devices={int(got['__devices__'])}")
+        return 1
+    worst = float(np.max(np.abs(ref["__losses__"][start:]
+                                - got["__losses__"])))
+    if worst > 1e-4:
+        print(f"FAIL: resumed loss trajectory diverges: "
+              f"max |delta| = {worst:g} > 1e-4")
+        return 1
+    print(f"  gang drill: rank 1 SIGKILLed at step 6 -> generation 2 "
+          f"resumed at step {start} on 2 devices, loss parity "
+          f"{worst:.2e} (run dir {run_dir})")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--epochs", type=int, default=2)
@@ -166,6 +263,9 @@ def main(argv=None):
     parser.add_argument("--skip-serve-drill", action="store_true",
                         help="skip the phase-6 subprocess half (in-process "
                              "CI harnesses that cannot spawn)")
+    parser.add_argument("--skip-gang-drill", action="store_true",
+                        help="skip the phase-8 supervised gang drill "
+                             "(two subprocess runs; same spawn caveat)")
     args = parser.parse_args(argv)
 
     if args.serve_drill:
@@ -507,6 +607,15 @@ def main(argv=None):
             return 1
     print("  flight-recorder tails in both crash bundles name the "
           "wedged points")
+
+    # phase 8: elastic gang supervision — a supervised 2-worker gang
+    # loses a rank to a seeded SIGKILL mid-epoch and must recover on
+    # its own: census shrink, generation bump, resharded resume, loss
+    # parity with the uninterrupted reference within 1e-4
+    if not args.skip_gang_drill:
+        rc = gang_drill(root=os.path.join(ckpt_dir, "gang"))
+        if rc:
+            return rc
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

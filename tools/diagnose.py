@@ -384,6 +384,79 @@ def check_preempt():
     return out
 
 
+def check_gang():
+    """Elastic gang supervision (docs/ROBUSTNESS.md "Gang supervision &
+    elasticity"): restart-budget knobs, the last run's gang.json summary
+    (generation, state, per-incarnation restart reasons), per-rank last
+    heartbeats, and any post-mortem bundles left in the run dir."""
+    _p("---------Gang------------------")
+    out = {k: os.environ.get(k)
+           for k in ("MXNET_TPU_GANG_DIR", "MXNET_TPU_GANG_MAX_RESTARTS",
+                     "MXNET_TPU_GANG_BACKOFF", "MXNET_TPU_GANG_GRACE",
+                     "MXNET_TPU_GANG_DEAD_S", "MXNET_TPU_GANG_SHRINK",
+                     "MXTPU_GANG_DIR", "MXTPU_GANG_GENERATION")}
+    _p(f"MXNET_TPU_GANG_DIR={out['MXNET_TPU_GANG_DIR'] or '<unset>'}  "
+       "(shared run dir; default: a fresh tempdir per supervisor)")
+    _p(f"MXNET_TPU_GANG_MAX_RESTARTS="
+       f"{out['MXNET_TPU_GANG_MAX_RESTARTS'] or '<unset>'}  "
+       "(restart budget; default 5, then a structured post-mortem)")
+    _p(f"MXNET_TPU_GANG_BACKOFF={out['MXNET_TPU_GANG_BACKOFF'] or '<unset>'}"
+       "  (first restart delay; default 1.0s, doubling to _CAP=30)")
+    _p(f"MXNET_TPU_GANG_GRACE={out['MXNET_TPU_GANG_GRACE'] or '<unset>'}  "
+       "(SIGTERM->SIGKILL drain deadline; default 10s)")
+    _p(f"MXNET_TPU_GANG_DEAD_S={out['MXNET_TPU_GANG_DEAD_S'] or '<unset>'}  "
+       "(heartbeat-silence kill threshold; default 60s, 0 disables)")
+    _p(f"MXNET_TPU_GANG_SHRINK={out['MXNET_TPU_GANG_SHRINK'] or '<unset>'}  "
+       "(1: killed/lost slots leave the next census — reshard smaller)")
+    run_dir = out["MXTPU_GANG_DIR"] or out["MXNET_TPU_GANG_DIR"]
+    try:
+        from mxnet_tpu import elastic
+
+        out["effective"] = elastic.describe()
+        st = out["effective"]["stats"]
+        _p(f"this process  : {st['state']} (generation "
+           f"{st['generation']}, {st['restarts_total']} restart(s), "
+           f"{st['postmortems']} post-mortem(s))")
+        if run_dir is None:
+            _p("run dir       : <none> (not in/over a supervised run)")
+            return out
+        summary_path = os.path.join(run_dir, "gang.json")
+        try:
+            with open(summary_path) as f:
+                summary = json.load(f)
+        except (OSError, ValueError) as e:
+            out["summary_error"] = str(e)
+            _p(f"run dir       : {run_dir} (no readable gang.json: {e})")
+            return out
+        out["summary"] = summary
+        _p(f"last run      : {summary_path}")
+        _p(f"  state       : {summary['state']}  generation "
+           f"{summary['generation']}  restarts "
+           f"{summary['restarts_used']}/{summary['max_restarts']}")
+        for rec in summary.get("history", []):
+            exits = ", ".join(f"r{r}={c}" for r, c in
+                              sorted(rec.get("exits", {}).items()))
+            _p(f"  gen {rec['generation']:<4d}: "
+               f"{rec.get('reason') or 'completed'}"
+               f"{'  [' + exits + ']' if exits else ''}")
+        beats = elastic.read_heartbeats(run_dir)
+        out["heartbeats"] = beats
+        for rank in sorted(beats):
+            hb = beats[rank]
+            _p(f"  rank {rank} beat: {hb.get('age_s')}s ago "
+               f"({hb.get('state')}, gen {hb.get('generation')}, "
+               f"step {hb.get('steps')}, pid {hb.get('pid')})")
+        pms = sorted(n for n in os.listdir(run_dir)
+                     if n.startswith("postmortem-"))
+        out["postmortems"] = pms
+        if pms:
+            _p(f"  post-mortem : {os.path.join(run_dir, pms[-1])}")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("elastic import failed:", e)
+    return out
+
+
 def check_telemetry():
     """Telemetry state (docs/OBSERVABILITY.md): knobs, the metrics
     registry snapshot (post-collection, the same values ``/metrics``
@@ -454,6 +527,7 @@ SECTIONS = (
     ("serving", check_serving),
     ("watchdog", check_watchdog),
     ("preempt", check_preempt),
+    ("gang", check_gang),
     ("telemetry", check_telemetry),
 )
 

@@ -12,15 +12,63 @@ launcher therefore
     (MXTPU_COORDINATOR / MXTPU_NUM_WORKERS / MXTPU_WORKER_ID — consumed by
     ``mxnet_tpu.kvstore`` dist stores),
   * ssh mode (``-H hostfile``): runs one process per host line via ssh with
-    the same env, coordinator = first host.
+    the same env, coordinator = first host (shlex-quoted, ``-tt`` so the
+    remote process group dies with the local client),
+  * supervised mode (``--supervise``): wraps either in the **elastic gang
+    supervisor** (``mxnet_tpu.elastic``) — the dmlc-tracker scheduler
+    role. Workers get heartbeat/generation env on top of the rendezvous
+    env; a worker exiting with a ladder code (75 drain / 76 peer-lost /
+    86 watchdog abort / 137 kill) triggers a gang-wide coordinated restart
+    at generation N+1 resuming from the last good checkpoint, resharded
+    onto the surviving census; an exhausted restart budget writes a
+    structured post-mortem. See docs/ROBUSTNESS.md "Gang supervision".
 
     python tools/launch.py -n 4 python train.py --kv-store dist_sync
+    python tools/launch.py --supervise -n 2 python train.py
+
+Signal handling (all modes): the first SIGINT/SIGTERM forwards SIGTERM to
+every child — a graceful drain, their ``mxnet_tpu.preempt`` handlers
+finish the step and checkpoint — then escalates to SIGKILL after a grace
+deadline; a second signal kills immediately. The launcher exits with the
+children's **most severe** exit code (ladder order: 0 < 75 < 76 < 86 <
+137 < anything else), never a later child's masking 0.
+
+This module stays import-light (no mxnet_tpu / jax) so bare spawning is
+instant; ``--supervise`` imports the framework lazily.
 """
 import argparse
 import os
+import shlex
 import signal
 import subprocess
 import sys
+import time
+
+# import-light copy of mxnet_tpu.preempt's exit ladder (launching must not
+# pay a framework import; keep in sync with preempt.EXIT_LADDER)
+_SEVERITY = {0: 0, 75: 1, 76: 2, 86: 3, 137: 4}
+
+
+def _canon(rc):
+    """Popen returncode -> shell convention (killed by N -> 128 + N)."""
+    if rc is None:
+        return None
+    return 128 - rc if rc < 0 else rc
+
+
+def most_severe(codes):
+    """The most severe child exit code (0 for an empty/None-only list):
+    ok < drain(75) < peer-lost(76) < watchdog-abort(86) < killed(137) <
+    any other nonzero (a real bug outranks every reschedulable code)."""
+    best, best_sev = 0, -1
+    for rc in codes:
+        rc = _canon(rc)
+        if rc is None:
+            continue
+        sev = _SEVERITY.get(rc, len(_SEVERITY))
+        if sev > best_sev:
+            best, best_sev = rc, sev
+    return best
 
 
 def _worker_env(base, coordinator, num_workers, worker_id):
@@ -34,44 +82,93 @@ def _worker_env(base, coordinator, num_workers, worker_id):
     return env
 
 
-def launch_local(num_workers, command, coordinator_port=9357):
+def _send_quietly(proc, sig):
+    if proc.poll() is not None:
+        return  # already exited: signalling would race a reused pid
+    try:
+        proc.send_signal(sig)
+    except (ProcessLookupError, OSError):
+        pass
+
+
+def _wait_all(procs, grace=15.0):
+    """Wait for every child, with signal forwarding: first SIGINT/SIGTERM
+    -> SIGTERM to all children (graceful drain) + a grace deadline after
+    which stragglers are SIGKILLed; a second signal -> SIGKILL now.
+    Returns the most severe child exit code."""
+    state = {"signals": 0, "deadline": None}
+
+    def _forward(signum, frame):
+        state["signals"] += 1
+        hard = state["signals"] > 1
+        for p in procs:
+            _send_quietly(p, signal.SIGKILL if hard else signal.SIGTERM)
+        if state["deadline"] is None:
+            state["deadline"] = time.monotonic() + grace
+
+    prev = {}
+    try:
+        for s in (signal.SIGINT, signal.SIGTERM):
+            prev[s] = signal.signal(s, _forward)
+    except ValueError:
+        prev = {}  # not the main thread: no forwarding, just wait
+    try:
+        while any(p.poll() is None for p in procs):
+            if state["deadline"] is not None and \
+                    time.monotonic() >= state["deadline"]:
+                for p in procs:
+                    _send_quietly(p, signal.SIGKILL)
+                state["deadline"] = None
+            time.sleep(0.05)
+    finally:
+        for s, h in prev.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, TypeError):
+                pass
+    return most_severe(p.returncode for p in procs)
+
+
+def launch_local(num_workers, command, coordinator_port=9357, grace=15.0):
     coordinator = f"127.0.0.1:{coordinator_port}"
     procs = []
     for rank in range(num_workers):
         env = _worker_env(os.environ, coordinator, num_workers, rank)
         procs.append(subprocess.Popen(command, env=env))
-
-    def _kill(signum, frame):
-        for p in procs:
-            p.terminate()
-
-    signal.signal(signal.SIGINT, _kill)
-    signal.signal(signal.SIGTERM, _kill)
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+    return _wait_all(procs, grace=grace)
 
 
-def launch_ssh(hostfile, command, coordinator_port=9357):
+def _ssh_command(host, env, command, cwd=None, ssh_options=()):
+    """One remote worker's ssh argv: every env value and command arg is
+    shlex-quoted (an arg with spaces survives the remote shell), the env
+    rides inside the remote command (ssh forwards none), and ``-tt``
+    forces a tty so the remote process group is torn down when the local
+    ssh client is killed — the remote half of signal forwarding."""
+    assigns = " ".join(f"{k}={shlex.quote(str(v))}"
+                       for k, v in sorted(env.items()))
+    remote = (f"cd {shlex.quote(cwd or os.getcwd())} && exec env "
+              f"{assigns} "
+              + " ".join(shlex.quote(str(c)) for c in command))
+    return (["ssh", "-o", "StrictHostKeyChecking=no", "-tt"]
+            + list(ssh_options) + [host, remote])
+
+
+def _read_hostfile(hostfile):
     with open(hostfile) as f:
         hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
     if not hosts:
         raise SystemExit("hostfile is empty")
+    return hosts
+
+
+def launch_ssh(hostfile, command, coordinator_port=9357, grace=15.0):
+    hosts = _read_hostfile(hostfile)
     coordinator = f"{hosts[0]}:{coordinator_port}"
     procs = []
     for rank, host in enumerate(hosts):
-        env_prefix = " ".join(
-            f"{k}={v}" for k, v in _worker_env(
-                {}, coordinator, len(hosts), rank).items())
-        remote = f"cd {os.getcwd()} && {env_prefix} {' '.join(command)}"
-        procs.append(subprocess.Popen(["ssh", "-o",
-                                       "StrictHostKeyChecking=no", host,
-                                       remote]))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+        env = _worker_env({}, coordinator, len(hosts), rank)
+        procs.append(subprocess.Popen(_ssh_command(host, env, command)))
+    return _wait_all(procs, grace=grace)
 
 
 def main(argv=None):
@@ -83,15 +180,79 @@ def main(argv=None):
                    help="one host per line; launches one worker per host "
                         "over ssh (coordinator = first host)")
     p.add_argument("-p", "--port", type=int, default=9357,
-                   help="coordinator port")
+                   help="coordinator port (supervised gangs use "
+                        "port + generation - 1)")
+    p.add_argument("--grace", type=float, default=None,
+                   help="SIGTERM->SIGKILL escalation deadline, seconds "
+                        "(default 15; MXNET_TPU_GANG_GRACE under "
+                        "--supervise)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run under the elastic gang supervisor: ladder "
+                        "exits (75/76/86/137) trigger a coordinated "
+                        "restart at generation N+1 resuming from the "
+                        "last good checkpoint (docs/ROBUSTNESS.md)")
+    p.add_argument("--run-dir", default=None,
+                   help="[supervise] shared gang dir (heartbeats, "
+                        "gang.json, post-mortems, crash bundles); "
+                        "default MXNET_TPU_GANG_DIR or a fresh tempdir")
+    p.add_argument("--max-restarts", type=int, default=None,
+                   help="[supervise] restart budget "
+                        "(MXNET_TPU_GANG_MAX_RESTARTS, default 5)")
+    p.add_argument("--backoff", type=float, default=None,
+                   help="[supervise] first restart delay, doubles per "
+                        "restart (MXNET_TPU_GANG_BACKOFF, default 1.0)")
+    p.add_argument("--dead-after", type=float, default=None,
+                   help="[supervise] heartbeat-silence kill threshold "
+                        "(MXNET_TPU_GANG_DEAD_S, default 60; 0 off)")
+    p.add_argument("--poll", type=float, default=0.2,
+                   help="[supervise] monitor poll period, seconds")
+    p.add_argument("--shrink-on-kill", action="store_true", default=None,
+                   help="[supervise] drop hard-lost slots (exit 137 / "
+                        "ssh lost / heartbeat-dead) from the next "
+                        "generation's census — the resumed gang reshards "
+                        "onto the smaller mesh (MXNET_TPU_GANG_SHRINK)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="[supervise] expose the supervisor's /metrics "
+                        "(mxtpu_gang_*) on this port (0 = pick free)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command to launch")
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
+
+    if args.supervise:
+        # only the supervisor pays the framework import; plain spawning
+        # stays instant
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from mxnet_tpu import elastic
+
+        sup = elastic.GangSupervisor(
+            args.command,
+            num_workers=None if args.hostfile else args.num_workers,
+            hosts=_read_hostfile(args.hostfile) if args.hostfile else None,
+            run_dir=args.run_dir, coordinator_port=args.port,
+            max_restarts=args.max_restarts, backoff=args.backoff,
+            grace=args.grace, dead_after=args.dead_after, poll=args.poll,
+            shrink_on_kill=args.shrink_on_kill)
+        server = None
+        if args.metrics_port is not None:
+            from mxnet_tpu.telemetry.export import MetricsServer
+
+            server = MetricsServer(port=args.metrics_port).start()
+            print(f"gang metrics: {server.url}/metrics", flush=True)
+        try:
+            return sup.run()
+        finally:
+            if server is not None:
+                server.close()
+
+    grace = 15.0 if args.grace is None else args.grace
     if args.hostfile:
-        return launch_ssh(args.hostfile, args.command, args.port)
-    return launch_local(args.num_workers, args.command, args.port)
+        return launch_ssh(args.hostfile, args.command, args.port,
+                          grace=grace)
+    return launch_local(args.num_workers, args.command, args.port,
+                        grace=grace)
 
 
 if __name__ == "__main__":
