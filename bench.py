@@ -104,10 +104,15 @@ def main(argv=None):
                          "2-model container; runs on any host)")
     ap.add_argument("--serve-only", action="store_true",
                     help="emit ONLY the serving metric")
+    ap.add_argument("--dataplane-only", action="store_true",
+                    help="emit ONLY the host data-plane metric")
     args = ap.parse_args(argv)
 
     if args.serve_only:
         bench_serve()
+        return
+    if args.dataplane_only:
+        bench_dataplane()
         return
 
     import mxnet_tpu as mx
@@ -120,8 +125,11 @@ def main(argv=None):
 
     # a downed TPU tunnel hangs the first backend touch forever; probe
     # (subprocess, 90s deadline) unless the platform is already pinned.
-    # BENCH_SKIP_PROBE=1 skips the probe's extra backend spin-up.
-    probe_backend_or_fallback(skip_env="BENCH_SKIP_PROBE")
+    # reprobe=True additionally re-tests a CPU pin that an EARLIER run's
+    # timeout latched (MXTPU_PLATFORM_FALLBACK marks it), so the first
+    # run with the tunnel back up records a real TPU line with no env
+    # surgery. BENCH_SKIP_PROBE=1 skips the probe's backend spin-up.
+    probe_backend_or_fallback(skip_env="BENCH_SKIP_PROBE", reprobe=True)
 
     batch = int(os.environ.get("BENCH_BATCH", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
@@ -196,6 +204,11 @@ def main(argv=None):
     # item-1 trajectory); BENCH_SKIP_SERVE=1 opts out
     if args.serve or not os.environ.get("BENCH_SKIP_SERVE"):
         bench_serve()
+    # the host data-plane line tracks the streaming input pipeline
+    # (native fused decode+augment img/s + trainer data_wait);
+    # BENCH_SKIP_DATAPLANE=1 opts out
+    if not os.environ.get("BENCH_SKIP_DATAPLANE"):
+        bench_dataplane()
 
 
 def bench_train(ctx, batch, dtype, iters, model):
@@ -338,6 +351,98 @@ def bench_serve():
         "recompiles_during_run": rep.get("recompiles_during_run"),
         "platform": jax.devices()[0].platform,
     }
+    print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_dataplane():
+    """Host data-plane metric (the streaming input pipeline of the
+    native OMP decode+augment loop): img/s and img/s/core of the fused
+    native path vs the bit-compatible Python fallback, per-thread
+    scaling — AND the starvation check: a small conv net trained
+    through PrefetchingIter(ImageRecordIter) at a batch size that
+    starves a record-at-a-time pipeline, reporting the mean/max
+    ``data_wait`` step phase (PR 9 gauge; ~0 = the host kept up).
+    Env knobs: BENCH_DATAPLANE_IMAGES (192), BENCH_DATAPLANE_STEPS (12),
+    BENCH_SKIP_DATAPLANE opts out of the default emission."""
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmark"))
+    import iter_bench
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss, nn
+    from mxnet_tpu.io import ImageRecordIter, PrefetchingIter
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+    from mxnet_tpu.telemetry import steps as _tsteps
+
+    n_img = int(os.environ.get("BENCH_DATAPLANE_IMAGES", 192))
+    threads = os.cpu_count() or 1
+    aug = iter_bench.run_augment(num_images=n_img, src_size=96,
+                                 batch_size=32, data_shape=(3, 64, 64),
+                                 epochs=2, threads=threads)
+
+    # starvation check: feed a compiled train step from the pipeline and
+    # read back the per-step data_wait phase the prefetcher recorded
+    steps_n = int(os.environ.get("BENCH_DATAPLANE_STEPS", 12))
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(32, 3, padding=1, activation="relu"),
+            nn.GlobalAvgPool2D(),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 3, 64, 64)))
+    trainer = ShardedTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9},
+        mesh=DeviceMesh({"dp": 1}), nan_guard=False)
+    with tempfile.TemporaryDirectory() as d:
+        rec = iter_bench.build_rec(os.path.join(d, "dp"), n_img, 96)
+        it = PrefetchingIter(ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 64, 64), batch_size=32,
+            shuffle=True, rand_crop=True, rand_mirror=True,
+            color_jitter=0.2, seed=0, preprocess_threads=threads,
+            num_parts=1, part_index=0))
+        warmup = 2  # first steps pay compile + pipeline spin-up
+        hist_before = None
+        done = 0
+        while done < steps_n + warmup:
+            try:
+                batch = it.next()
+            except StopIteration:
+                it.reset()
+                continue
+            trainer.step(batch.data[0],
+                         batch.label[0]).wait_to_read()
+            done += 1
+            if done == warmup:
+                hist_before = len(_tsteps.history())
+        waits = [r["phases"].get("data_wait", 0.0)
+                 for r in _tsteps.history()[hist_before:]]
+    line = {
+        "metric": "dataplane_native_augment",
+        "value": aug["value"],
+        "unit": "img/s",
+        "img_s_per_core": aug["img_s_per_core"],
+        "python_img_s": aug["python_img_s"],
+        "speedup_vs_python": aug["speedup_vs_python"],
+        "thread_scaling": aug["thread_scaling"],
+        "scaling_1_to_4": aug["scaling_1_to_4"],
+        "native_augment": aug["native_augment"],
+        "threads": aug["threads"],
+        "cores": aug["cores"],
+        # the starvation check: mean/max data_wait per step (ms). ~0 =
+        # the prefetched native pipeline kept the step fed
+        "train_steps": len(waits),
+        "train_data_wait_ms_mean":
+            round(sum(waits) / len(waits), 3) if waits else None,
+        "train_data_wait_ms_max":
+            round(max(waits), 3) if waits else None,
+    }
+    iter_bench._persist(line)
     print(json.dumps(_compile_fields(line)), flush=True)
 
 

@@ -10,6 +10,17 @@ Prints ONE JSON line: {"metric": "image_record_iter", "value": img/s,
 "unit": "img/s", ...}.
 
     python benchmark/iter_bench.py --num-images 512 --batch-size 128
+
+``--augment`` benches the STREAMING DATA PLANE instead: the fused
+native decode+rand-crop+mirror+color-jitter loop vs the bit-compatible
+pure-Python fallback, reporting img/s, img/s/core, and per-thread
+scaling (1 -> N threads of the native loop):
+
+    python benchmark/iter_bench.py --augment
+
+Either mode also drops its result JSON into
+``$TMPDIR/mxtpu_iter_bench.json`` so ``tools/diagnose.py`` ("Data
+Plane" report) can show the host's last measured numbers.
 """
 import argparse
 import io as _io
@@ -23,11 +34,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+LAST_RESULT_PATH = os.path.join(tempfile.gettempdir(),
+                                "mxtpu_iter_bench.json")
+
 
 def build_rec(path, num_images, src_hw):
     from PIL import Image
 
-    import mxnet_tpu as mx
     from mxnet_tpu import recordio
 
     rs = np.random.RandomState(0)
@@ -42,48 +55,157 @@ def build_rec(path, num_images, src_hw):
     return path + ".rec"
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--num-images", type=int, default=512)
-    p.add_argument("--src-size", type=int, default=256)
-    p.add_argument("--batch-size", type=int, default=128)
-    p.add_argument("--data-shape", type=str, default="3,224,224")
-    p.add_argument("--epochs", type=int, default=3)
-    p.add_argument("--preprocess-threads", type=int,
-                   default=os.cpu_count() or 4)
-    args = p.parse_args()
+def _persist(result):
+    """Best-effort: leave the last result where tools/diagnose.py finds
+    it (the "Data Plane" report)."""
+    try:
+        with open(LAST_RESULT_PATH, "w") as f:
+            json.dump(dict(result, time=time.time()), f)
+    except OSError:
+        pass
 
+
+def _time_epochs(it, epochs):
+    """Sustained img/s over `epochs` full passes (first pass pre-warmed
+    by the caller)."""
+    n = 0
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        it.reset()
+        for batch in it:
+            batch.data[0].wait_to_read()
+            n += batch.data[0].shape[0]
+    return n / (time.perf_counter() - t0)
+
+
+def run_plain(num_images=512, src_size=256, batch_size=128,
+              data_shape=(3, 224, 224), epochs=3, threads=None):
+    """The classic decode-only bench; returns the result dict."""
     import mxnet_tpu as mx
     from mxnet_tpu import native
 
-    shape = tuple(int(d) for d in args.data_shape.split(","))
+    threads = threads or os.cpu_count() or 4
     with tempfile.TemporaryDirectory() as d:
-        rec = build_rec(os.path.join(d, "bench"), args.num_images,
-                        args.src_size)
+        rec = build_rec(os.path.join(d, "bench"), num_images, src_size)
         it = mx.io.ImageRecordIter(
-            path_imgrec=rec, data_shape=shape,
-            batch_size=args.batch_size, shuffle=True,
+            path_imgrec=rec, data_shape=tuple(data_shape),
+            batch_size=batch_size, shuffle=True,
             rand_crop=True, rand_mirror=True,
-            preprocess_threads=args.preprocess_threads)
+            preprocess_threads=threads)
         # warm epoch (native lib build, file cache)
         for batch in it:
             batch.data[0].wait_to_read()
-        n = 0
-        t0 = time.perf_counter()
-        for _ in range(args.epochs):
-            it.reset()
-            for batch in it:
-                batch.data[0].wait_to_read()
-                n += batch.data[0].shape[0]
-        dt = time.perf_counter() - t0
-        print(json.dumps({
+        rate = _time_epochs(it, epochs)
+        return {
             "metric": "image_record_iter",
-            "value": round(n / dt, 1),
+            "value": round(rate, 1),
             "unit": "img/s",
             "native_decode": native.available(),
-            "threads": args.preprocess_threads,
-            "data_shape": list(shape),
-        }), flush=True)
+            "threads": threads,
+            "data_shape": list(data_shape),
+        }
+
+
+def run_augment(num_images=256, src_size=256, batch_size=64,
+                data_shape=(3, 224, 224), epochs=2, threads=None,
+                color_jitter=0.2):
+    """The data-plane bench: fused native decode+augment vs the Python
+    fallback, with per-thread scaling of the native loop. Returns the
+    result dict (one JSON line when run as a script)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import native
+
+    threads = threads or os.cpu_count() or 4
+
+    def make(n_threads, prefetch=2):
+        return mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=tuple(data_shape),
+            batch_size=batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, color_jitter=color_jitter, seed=7,
+            preprocess_threads=n_threads, prefetch_buffer=prefetch)
+
+    with tempfile.TemporaryDirectory() as d:
+        rec = build_rec(os.path.join(d, "bench"), num_images, src_size)
+        it = make(threads)
+        for batch in it:  # warm: native build, page cache, pools
+            batch.data[0].wait_to_read()
+        native_rate = _time_epochs(it, epochs)
+        it.close()
+
+        # per-thread scaling of the fused native loop (sync iterator so
+        # the OMP team size is the only variable)
+        scaling = {}
+        for t in sorted({1, 2, 4, threads}):
+            if t > (os.cpu_count() or 1) and t != threads:
+                continue
+            ts = make(t, prefetch=0)
+            for _ in range(2):  # short warm
+                ts.next()
+            ts.reset()
+            scaling[str(t)] = round(_time_epochs(ts, 1), 1)
+            ts.close()
+
+        # bit-compatible pure-Python fallback (PIL threads + numpy
+        # augmenter) at the same thread count
+        orig = native.decode_augment_batch
+        native.decode_augment_batch = lambda *a, **k: None
+        try:
+            itp = make(threads)
+            for batch in itp:
+                batch.data[0].wait_to_read()
+            python_rate = _time_epochs(itp, 1)
+            itp.close()
+        finally:
+            native.decode_augment_batch = orig
+
+        cores = os.cpu_count() or 1
+        line = {
+            "metric": "iter_bench_augment",
+            "value": round(native_rate, 1),
+            "unit": "img/s",
+            "img_s_per_core": round(native_rate / cores, 1),
+            "python_img_s": round(python_rate, 1),
+            "speedup_vs_python": round(native_rate / python_rate, 2)
+            if python_rate else None,
+            "thread_scaling": scaling,
+            "scaling_1_to_4": round(scaling["4"] / scaling["1"], 2)
+            if "1" in scaling and "4" in scaling else None,
+            "native_augment": native.status()["augment"],
+            "threads": threads,
+            "cores": cores,
+            "data_shape": list(data_shape),
+        }
+        return line
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-images", type=int, default=None)
+    p.add_argument("--src-size", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--data-shape", type=str, default="3,224,224")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--preprocess-threads", type=int, default=None)
+    p.add_argument("--augment", action="store_true",
+                   help="bench the fused native decode+augment loop vs "
+                        "the Python fallback, with per-thread scaling")
+    args = p.parse_args()
+
+    shape = tuple(int(d) for d in args.data_shape.split(","))
+    if args.augment:
+        line = run_augment(num_images=args.num_images or 256,
+                           src_size=args.src_size,
+                           batch_size=args.batch_size or 64,
+                           data_shape=shape, epochs=args.epochs or 2,
+                           threads=args.preprocess_threads)
+    else:
+        line = run_plain(num_images=args.num_images or 512,
+                         src_size=args.src_size,
+                         batch_size=args.batch_size or 128,
+                         data_shape=shape, epochs=args.epochs or 3,
+                         threads=args.preprocess_threads)
+    _persist(line)
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

@@ -120,7 +120,7 @@ def apply_platform_env():
         pass  # backend already initialised — keep its platform
 
 
-def ensure_live_backend(timeout_s=90, retries=1):
+def ensure_live_backend(timeout_s=90, retries=1, reprobe=False):
     """Probe the default JAX backend in a subprocess under a deadline,
     pinning the CPU platform if (and only if) the probe HANGS.
 
@@ -136,12 +136,43 @@ def ensure_live_backend(timeout_s=90, retries=1):
     and silently measuring the wrong platform would be worse than
     failing loudly. Must run before anything touches the XLA backend in
     this process; if the fallback cannot be applied because a backend is
-    already live, raises instead of claiming success."""
+    already live, raises instead of claiming success.
+
+    ``reprobe=True`` un-latches an inherited fallback: a pin that an
+    EARLIER timeout exported (``MXTPU_PLATFORM_FALLBACK`` marks it —
+    a deliberate user pin is always honoured) is re-tested against the
+    default backend, so the first run after the tunnel comes back up
+    records real-device numbers with no env surgery (bench.py passes
+    it on every run)."""
     import os
     import subprocess
     import sys
 
     pinned = os.environ.get("MXTPU_PLATFORM")
+    if pinned and reprobe and os.environ.get("MXTPU_PLATFORM_FALLBACK"):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("MXTPU_PLATFORM", "MXTPU_PROBE_OK")}
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True, env=env)
+        except subprocess.TimeoutExpired:
+            return pinned  # still down; keep the latched fallback
+        if proc.returncode != 0:
+            return pinned
+        # the default backend is reachable again: release the latch for
+        # this process (config pin, if we can — nothing has touched the
+        # backend yet on the entry-point path) and for every child
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", None)
+        except Exception:
+            return pinned  # a backend is already live here; stay honest
+        os.environ.pop("MXTPU_PLATFORM", None)
+        os.environ.pop("MXTPU_PLATFORM_FALLBACK", None)
+        os.environ["MXTPU_PROBE_OK"] = "1"
+        return "default"
     if pinned:
         return pinned
     if os.environ.get("MXTPU_PROBE_OK"):
@@ -171,32 +202,41 @@ def ensure_live_backend(timeout_s=90, retries=1):
                     "call ensure_live_backend before any backend touch"
                 ) from exc
             # only after the fallback is actually in effect: make it
-            # visible to child processes too
+            # visible to child processes too — MARKED as a fallback (not
+            # a deliberate pin), so a later reprobe=True run may release
+            # it once the tunnel is back
             os.environ["MXTPU_PLATFORM"] = "cpu"
+            os.environ["MXTPU_PLATFORM_FALLBACK"] = "1"
             return "cpu-fallback"
     raise RuntimeError(
         f"JAX backend probe failed (crash, not a hang):\n{last_err}")
 
 
-def probe_backend_or_fallback(skip_env="MXTPU_SKIP_PROBE"):
+def probe_backend_or_fallback(skip_env="MXTPU_SKIP_PROBE", reprobe=False):
     """Entry-point guard for examples/benchmarks: run the liveness probe
     (unless `skip_env` is set or MXTPU_PLATFORM pins a platform) and
     log a loud warning when a downed tunnel forced the CPU fallback.
     Returns ensure_live_backend's platform string, or "skipped". Call it
     in main() AFTER argument parsing and BEFORE the first backend
-    touch."""
+    touch. ``reprobe=True`` additionally re-tests a fallback-latched
+    CPU pin from an earlier run (never a deliberate user pin), so each
+    run gets a fresh shot at the real device."""
     import os
 
     # MXTPU_SKIP_PROBE always works; callers may add their own knob too
     # (bench.py keeps BENCH_SKIP_PROBE for compatibility)
     if os.environ.get(skip_env) or os.environ.get("MXTPU_SKIP_PROBE"):
         return "skipped"
-    plat = ensure_live_backend()
-    if plat == "cpu-fallback":
-        from . import log as _log
+    plat = ensure_live_backend(reprobe=reprobe)
+    from . import log as _log
 
+    if plat == "cpu-fallback":
         _log.get_logger("mxnet_tpu.base").warning(
             "default backend unreachable; running on CPU")
+    elif plat == "default" and reprobe:
+        _log.get_logger("mxnet_tpu.base").info(
+            "default backend reachable; any stale CPU-fallback latch "
+            "released")
     return plat
 
 

@@ -1,8 +1,10 @@
 """Data IO (parity: python/mxnet/io/)."""
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, MNISTIter, CSVIter, LibSVMIter,
-                 ImageRecordIter, DeviceStager)
+                 ImageRecordIter, TokenRecordIter, DeviceStager,
+                 write_token_shard)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
-           "ImageRecordIter", "DeviceStager"]
+           "ImageRecordIter", "TokenRecordIter", "DeviceStager",
+           "write_token_shard"]

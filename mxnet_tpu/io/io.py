@@ -23,7 +23,8 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "DeviceStager"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "DeviceStager",
+           "TokenRecordIter", "write_token_shard"]
 
 
 class DeviceStager:
@@ -98,6 +99,151 @@ class DeviceStager:
         if getattr(raw, "sharding", None) == sh:
             return raw
         return jax.device_put(raw, sh)
+
+
+def _gang_shard(num_parts, part_index):
+    """Resolve the reader shard: explicit arguments win; otherwise the
+    gang coordinates from the distributed init env (``tools/launch.py``
+    exports MXTPU_NUM_WORKERS / MXTPU_WORKER_ID, and an elastic restart
+    renumbers them densely — so a shrunk gang automatically
+    re-partitions the reader shards on the next construction)."""
+    import os
+
+    if num_parts is None:
+        num_parts = int(os.environ.get("MXTPU_NUM_WORKERS", "1") or 1)
+        if part_index is None:
+            part_index = int(os.environ.get("MXTPU_WORKER_ID", "0") or 0)
+    num_parts = max(1, int(num_parts))
+    part_index = int(part_index or 0)
+    if not 0 <= part_index < num_parts:
+        raise ValueError(f"part_index {part_index} is outside "
+                         f"num_parts {num_parts}")
+    return num_parts, part_index
+
+
+class _ShardedEpochMixin:
+    """Deterministic epoch machinery shared by the record-backed readers
+    (:class:`ImageRecordIter`, :class:`TokenRecordIter`):
+
+    * the epoch's GLOBAL record order is a pure function of
+      ``(seed, epoch)`` — every gang rank computes the same shuffle from
+      the same seed, no rank-to-rank coordination;
+    * rank ``part_index`` of ``num_parts`` reads block-cyclic slices:
+      its k-th batch is global records
+      ``[(k*num_parts + part_index) * batch_size, ... + batch_size)`` of
+      the epoch order, so the union of the rank streams tiles the epoch
+      exactly (no overlap) and a resized gang (PR 10 shrink) simply
+      re-partitions the same global stream;
+    * the consumed position serializes as a GLOBAL record position
+      (:meth:`state_dict` / :meth:`load_state_dict`), so mid-epoch
+      resume composes with resharding: a checkpoint cut at global
+      position G resumes at G on any gang whose global batch
+      (``batch_size * num_parts``) divides G.
+    """
+
+    def _init_epoch_state(self, seed, shuffle, num_parts, part_index):
+        self._seed = int(seed) & 0x7FFFFFFF
+        self._shuffle = bool(shuffle)
+        self._num_parts, self._part_index = _gang_shard(num_parts,
+                                                        part_index)
+        self._epoch = -1     # reset() (called by __init__) opens epoch 0
+        self._step = 0       # producer cursor: batches staged this epoch
+        self._consumed = 0   # consumer cursor: batches handed out
+        self._order = []
+
+    def _epoch_rng(self, *extra):
+        """An RNG keyed by (seed, epoch, *extra) — O(1) to reconstruct at
+        any stream position, which is what makes mid-epoch resume exact
+        without replaying the epoch."""
+        key = [self._seed, self._epoch & 0x7FFFFFFF]
+        key += [int(x) & 0x7FFFFFFF for x in extra]
+        return _np.random.RandomState(_np.array(key, dtype=_np.uint32))
+
+    def _keys(self):  # the full record-id universe; readers override
+        raise NotImplementedError
+
+    def _set_epoch_order(self):
+        order = list(self._keys())
+        if self._shuffle:
+            self._epoch_rng().shuffle(order)
+        self._order = order
+
+    def _begin_epoch(self):
+        self._epoch += 1
+        self._step = 0
+        self._consumed = 0
+        self._set_epoch_order()
+
+    def _steps_per_epoch(self):
+        gb = self.batch_size * self._num_parts
+        n = len(self._order)
+        return -(-n // gb) if self._round_batch else n // gb
+
+    def _next_keys(self):
+        """This rank's next batch as ``(global epoch position, record
+        keys)``, or None at epoch end. round_batch wraps the final
+        partial global batch to the epoch start (parity: the reference's
+        round_batch fill-from-the-beginning)."""
+        if self._step >= self._steps_per_epoch():
+            return None
+        n = len(self._order)
+        g0 = (self._step * self._num_parts + self._part_index) \
+            * self.batch_size
+        keys = [self._order[(g0 + j) % n] for j in range(self.batch_size)]
+        self._step += 1
+        return g0, keys
+
+    def _halt_pipeline(self):
+        """Stop any producer machinery before the position moves
+        (readers with a prefetch thread override)."""
+
+    # ------------------------------------------------- mid-epoch resume ---
+    def state_dict(self, consumed=None):
+        """JSON-able position snapshot: ``(seed, epoch, consumed global
+        record position)``. The stream is a pure function of those — so
+        restoring onto a FRESH iterator, even one with a different
+        ``num_parts`` after a gang reshard, reproduces the remaining
+        global batch stream (records AND augmentation draws) exactly.
+        ``consumed`` overrides the delivered-batch count (the
+        PrefetchingIter wrapper excludes batches staged but not yet
+        handed out)."""
+        consumed = self._consumed if consumed is None else int(consumed)
+        return {"kind": type(self).__name__,
+                "seed": self._seed,
+                "epoch": self._epoch,
+                "consumed": consumed,
+                "batch_size": self.batch_size,
+                "num_parts": self._num_parts,
+                "global_pos":
+                    consumed * self.batch_size * self._num_parts}
+
+    def load_state_dict(self, state):
+        import warnings
+
+        if "global_pos" in state:
+            pos = int(state["global_pos"])
+        else:
+            pos = int(state["consumed"]) \
+                * int(state.get("batch_size", self.batch_size)) \
+                * int(state.get("num_parts", 1))
+        if int(state.get("seed", self._seed)) != self._seed:
+            warnings.warn(
+                f"{type(self).__name__}.load_state_dict: checkpoint was "
+                f"cut with seed {state.get('seed')} but this iterator "
+                f"uses seed {self._seed}; the shuffle/augmentation "
+                "stream will NOT match the original run", stacklevel=2)
+        gb = self.batch_size * self._num_parts
+        if pos % gb:
+            raise ValueError(
+                f"checkpointed data position ({pos} records into the "
+                "epoch) does not fall on this gang's global batch "
+                f"boundary (batch_size {self.batch_size} x num_parts "
+                f"{self._num_parts} = {gb}); resume with a geometry "
+                "whose global batch divides the saved position")
+        self._halt_pipeline()
+        self._epoch = int(state["epoch"])
+        self._step = self._consumed = pos // gb
+        self._set_epoch_order()
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -295,6 +441,29 @@ class NDArrayIter(DataIter):
                          index=None, provide_data=self.provide_data,
                          provide_label=self.provide_label)
 
+    # ------------------------------------------------- mid-epoch resume ---
+    def state_dict(self, consumed=None):
+        """JSON-able snapshot of the exact iteration position (cursor,
+        epoch order, roll_over carry): restoring it on a fresh iterator
+        reproduces the remaining batch stream bit-exactly. ``consumed``
+        (batches delivered this epoch) overrides the cursor — the
+        PrefetchingIter wrapper uses it to exclude staged-but-undelivered
+        batches."""
+        cursor = self.cursor if consumed is None \
+            else -self.batch_size + int(consumed) * self.batch_size
+        return {"kind": "NDArrayIter", "cursor": int(cursor),
+                "idx": [int(i) for i in self.idx],
+                "order": [int(i) for i in self._order],
+                "residual": [int(i) for i in self._residual]}
+
+    def load_state_dict(self, state):
+        self.idx = _np.asarray(state["idx"], dtype=self.idx.dtype)
+        self._order = _np.asarray(state["order"], dtype=self.idx.dtype)
+        self._residual = _np.asarray(state["residual"],
+                                     dtype=self.idx.dtype)
+        self.num_batch_data = len(self._order)
+        self.cursor = int(state["cursor"])
+
 
 class ResizeIter(DataIter):
     """Resize an iterator to `size` batches per epoch (parity:
@@ -398,6 +567,7 @@ class PrefetchingIter(DataIter):
         self._lock = threading.Lock()
         self._next_batches = [None] * self.n_iter
         self._started = False
+        self._delivered = 0  # batches handed to the consumer this epoch
         self._error = None  # sticky deferred error, cleared by reset()
         self._stager = DeviceStager(device=device, mesh=mesh,
                                     shardings=shardings)
@@ -506,6 +676,7 @@ class PrefetchingIter(DataIter):
                 raise
         for it in self.iters:
             it.reset()
+        self._delivered = 0
         self._fetch()
         self._started = True
 
@@ -547,6 +718,7 @@ class PrefetchingIter(DataIter):
         except BaseException as e:
             self._error = e
             raise
+        self._delivered += 1
         if self.n_iter == 1:
             return batches[0]
         return DataBatch(
@@ -578,6 +750,34 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    # ------------------------------------------------- mid-epoch resume ---
+    def state_dict(self):
+        """Snapshot at the CONSUMER's position: batches staged inside the
+        prefetcher but not yet handed out are excluded (they replay after
+        a load), so a checkpoint cut between training steps resumes at
+        exactly the next unseen batch. Requires the wrapped iterators to
+        implement ``state_dict(consumed=...)``."""
+        return {"kind": "PrefetchingIter", "delivered": self._delivered,
+                "iters": [it.state_dict(consumed=self._delivered)
+                          for it in self.iters]}
+
+    def load_state_dict(self, state):
+        """Restore a consumer-position snapshot (best applied to a fresh
+        or reset iterator): any staged batch is dropped and the prefetch
+        restages from the restored position on the next ``next()``."""
+        try:
+            self._join()
+        except BaseException:
+            pass
+        self._threads = []
+        self._error = None
+        self._next_batches = [None] * self.n_iter
+        self._started = False
+        self.current_batch = None
+        for it, s in zip(self.iters, state["iters"]):
+            it.load_state_dict(s)
+        self._delivered = int(state["delivered"])
 
 
 def _read_mnist_images(path):
@@ -718,16 +918,27 @@ class LibSVMIter(DataIter):
         return DataBatch(data=[csr], label=[label], pad=pad, index=None)
 
 
-class ImageRecordIter(DataIter):
+class ImageRecordIter(_ShardedEpochMixin, DataIter):
     """Batched image iterator over .rec databases (parity:
     `src/io/iter_image_recordio_2.cc:880` MXNET_REGISTER_IO_ITER
     ImageRecordIter).
 
     Decodes each packed image, resizes to `data_shape`, and assembles
-    NCHW float32 batches. The JPEG decode + resize runs OMP-parallel in
-    the native C++ library when built (PIL threads as fallback) and the
-    u8->f32 channel-normalization inner loop likewise, matching the
-    reference's C++ ProcessImage path.
+    NCHW float32 batches. The streaming data plane runs the whole
+    per-record pipeline — JPEG decode, resize, rand-crop, mirror, color
+    jitter — FUSED inside the native OMP worker loop when the C++
+    library is built (parity: the augmenter chain inside
+    iter_image_recordio_2.cc's ParseChunk), producing training-ready HWC
+    rows with no per-record Python pass; the pure-Python fallback (PIL
+    threads + vectorized numpy augmenter) is bit-compatible at seed
+    parity. The u8->f32 channel normalization likewise runs native.
+
+    Determinism contract: the shuffle order is a pure function of
+    ``(seed, epoch)`` and every image's augmentation draws of
+    ``(seed, epoch, global epoch position)`` — so the stream replays
+    identically after a mid-epoch :meth:`state_dict` resume and
+    re-partitions consistently across gang ranks (``num_parts`` /
+    ``part_index``, defaulting to the distributed-init env).
 
     Channel order is RGB, matching the reference ImageRecordIter (its
     ProcessImage swaps cv2's BGR to RGB for 3-channel data_shapes);
@@ -739,8 +950,9 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  round_batch=True, seed=0, rand_crop=False,
-                 rand_mirror=False, preprocess_threads=4,
-                 prefetch_buffer=2, **kwargs):
+                 rand_mirror=False, color_jitter=0.0,
+                 num_parts=None, part_index=None,
+                 preprocess_threads=4, prefetch_buffer=2, **kwargs):
         from .. import recordio as _recordio
 
         super().__init__(batch_size)
@@ -750,9 +962,6 @@ class ImageRecordIter(DataIter):
                 if path_imgrec.endswith(".rec") else path_imgrec + ".idx"
         self._rec = _recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
                                                 "r")
-        self._order = list(self._rec.keys)
-        self._shuffle = shuffle
-        self._rng = _np.random.RandomState(seed)
         self._label_width = label_width
         self._mean = _np.asarray([mean_r, mean_g, mean_b], _np.float32)
         self._std = _np.asarray([std_r, std_g, std_b], _np.float32)
@@ -760,12 +969,13 @@ class ImageRecordIter(DataIter):
         self._round_batch = round_batch
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
+        self._color_jitter = float(color_jitter)
         self._threads = max(int(preprocess_threads), 1)
         self._prefetch = max(int(prefetch_buffer), 0)
-        self._cursor = 0
         self._queue = None
         self._producer = None
         self._executor = None
+        self._init_epoch_state(seed, shuffle, num_parts, part_index)
         self.provide_data = [DataDesc("data",
                                       (batch_size,) + self._data_shape)]
         lshape = (batch_size,) if label_width == 1 \
@@ -773,11 +983,15 @@ class ImageRecordIter(DataIter):
         self.provide_label = [DataDesc("label", lshape)]
         self.reset()
 
+    def _keys(self):
+        return list(self._rec.keys)
+
+    def _halt_pipeline(self):
+        self._stop_producer()
+
     def reset(self):
         self._stop_producer()
-        self._cursor = 0
-        if self._shuffle:
-            self._rng.shuffle(self._order)
+        self._begin_epoch()
 
     # -------------------------------------------------- decode pipeline ---
     def _decode_size(self):
@@ -812,8 +1026,80 @@ class ImageRecordIter(DataIter):
             return _np.stack(list(self._executor.map(one, bufs)))
         return _np.stack([one(b) for b in bufs])
 
-    def _produce(self, keys):
-        """keys -> one assembled DataBatch (decode, augment, normalize)."""
+    # ------------------------------------------------------- augmenters ---
+    def _augmenting(self):
+        return bool(self._rand_crop or self._rand_mirror
+                    or self._color_jitter)
+
+    def _aug_params(self, start, n):
+        """Per-image augmentation draws. Each image's params come from an
+        RNG keyed by (seed, epoch, absolute epoch position) — never from
+        a shared sequential stream — so the draw for record position p is
+        identical whether the epoch is replayed from the top, resumed
+        mid-epoch, or re-partitioned across a resized gang."""
+        c, h, w = self._data_shape
+        dh, dw = self._decode_size()
+        ys = _np.zeros(n, _np.int32)
+        xs = _np.zeros(n, _np.int32)
+        mir = _np.zeros(n, _np.uint8)
+        jit = _np.ones((n, 3), _np.float32)
+        for i in range(n):
+            rng = self._epoch_rng(start + i)
+            if self._rand_crop:
+                ys[i] = rng.randint(0, dh - h + 1)
+                xs[i] = rng.randint(0, dw - w + 1)
+            if self._rand_mirror:
+                mir[i] = rng.rand() < 0.5
+            if self._color_jitter:
+                jit[i] = rng.uniform(1.0 - self._color_jitter,
+                                     1.0 + self._color_jitter, 3)
+        return ys, xs, mir, jit
+
+    def _augment_one(self, img, y, x, m, j):
+        """Crop/mirror/jitter ONE decoded (dh, dw) image — arithmetic
+        kept bit-identical to the native augment_into (float32 multiply,
+        +0.5, truncate, clamp 255)."""
+        c, h, w = self._data_shape
+        img = img[y:y + h, x:x + w]
+        if m:
+            img = img[:, ::-1]
+        if self._color_jitter:
+            img = _np.minimum(img.astype(_np.float32) * j + 0.5,
+                              255.0).astype(_np.uint8)
+        return img
+
+    def _augment_py(self, batch, ys, xs, mir, jit):
+        """The pure-Python augmenter over a decoded (n, dh, dw, 3) batch
+        — the bit-compatible fallback for the native fused loop."""
+        c, h, w = self._data_shape
+        out = _np.empty((batch.shape[0], h, w, 3), _np.uint8)
+        for i in range(batch.shape[0]):
+            out[i] = self._augment_one(batch[i], ys[i], xs[i],
+                                       mir[i], jit[i])
+        return out
+
+    @staticmethod
+    def _count_records(n, used_native):
+        """Coarse per-batch telemetry: which decode path carried the
+        records (the pull collectors export it; a scrape shows a host
+        silently running the slow path)."""
+        try:
+            from ..telemetry import registry as _registry
+
+            _registry.counter(
+                "mxtpu_dataplane_records_total",
+                "Records decoded by the streaming data plane",
+                labels=("path",)).inc(n,
+                                      "native" if used_native
+                                      else "python")
+        except Exception:
+            pass
+
+    def _produce(self, start, keys):
+        """(epoch position, keys) -> one assembled DataBatch. The decode
+        AND every augmentation run fused inside the native OMP worker
+        loop when built; records the native decoder rejects are retried
+        through PIL with the SAME per-image augmentation params."""
         from .. import faults as _faults
         from .. import native
         from .. import recordio as _recordio
@@ -831,12 +1117,25 @@ class ImageRecordIter(DataIter):
             labels.append(label[:self._label_width])
         c, h, w = self._data_shape
         dh, dw = self._decode_size()
-        decoded = native.decode_jpeg_batch(bufs, dh, dw,
-                                           n_threads=self._threads)
+        aug = self._aug_params(start, len(keys)) if self._augmenting() \
+            else None
+        if aug is None:
+            decoded = native.decode_jpeg_batch(bufs, dh, dw,
+                                               n_threads=self._threads)
+        else:
+            ys, xs, mir, jit = aug
+            decoded = native.decode_augment_batch(
+                bufs, dh, dw, h, w, ys, xs, mir,
+                jit if self._color_jitter else None,
+                n_threads=self._threads)
+        used_native = False
         if decoded is None or len(decoded[1]) == len(bufs):
             # no native lib, or payloads are not JPEG at all: PIL path
             batch_u8 = self._decode_batch_py(bufs, dh, dw)
+            if aug is not None:
+                batch_u8 = self._augment_py(batch_u8, *aug)
         else:
+            used_native = True
             batch_u8, bad = decoded
             if bad:
                 # mixed batches: the native libjpeg path rejects non-JPEG
@@ -857,25 +1156,21 @@ class ImageRecordIter(DataIter):
                 still_bad = []
                 for i in bad:
                     try:
-                        batch_u8[i] = decode_one(bufs[i])
+                        img = decode_one(bufs[i])
                     except Exception:
                         still_bad.append(i)
+                        continue
+                    if aug is not None:
+                        img = self._augment_one(img, aug[0][i], aug[1][i],
+                                                aug[2][i], aug[3][i])
+                    batch_u8[i] = img
                 if still_bad:
                     import warnings
 
                     warnings.warn(
                         f"ImageRecordIter: {len(still_bad)} corrupt "
                         "image(s) in batch zero-filled", stacklevel=2)
-        if self._rand_crop:
-            n = batch_u8.shape[0]
-            ys = self._rng.randint(0, dh - h + 1, n)
-            xs = self._rng.randint(0, dw - w + 1, n)
-            batch_u8 = _np.stack([batch_u8[i, ys[i]:ys[i] + h,
-                                           xs[i]:xs[i] + w]
-                                  for i in range(n)])
-        if self._rand_mirror:
-            flip = self._rng.rand(batch_u8.shape[0]) < 0.5
-            batch_u8[flip] = batch_u8[flip, :, ::-1]
+        self._count_records(len(keys), used_native)
         chw = native.normalize_batch(batch_u8, self._mean, self._std,
                                      scale=self._scale)
         label_arr = _np.stack(labels)
@@ -883,18 +1178,6 @@ class ImageRecordIter(DataIter):
             label_arr = label_arr.reshape(-1)
         return DataBatch(data=[_array(chw)], label=[_array(label_arr)],
                          pad=0, index=None)
-
-    def _next_keys(self):
-        if self._cursor >= len(self._order):
-            return None
-        end = self._cursor + self.batch_size
-        if end > len(self._order) and not self._round_batch:
-            return None
-        keys = self._order[self._cursor:end]
-        if len(keys) < self.batch_size:  # wrap (round_batch)
-            keys = keys + self._order[:self.batch_size - len(keys)]
-        self._cursor += self.batch_size
-        return keys
 
     # ------------------------------------------------------- prefetch ----
     def _stop_producer(self):
@@ -945,12 +1228,12 @@ class ImageRecordIter(DataIter):
         q = self._queue
 
         def run():
-            for keys in key_lists:
+            for start, keys in key_lists:
                 it = wself()
                 if it is None or it._drain:
                     return
                 try:
-                    item = it._produce(keys)
+                    item = it._produce(start, keys)
                 except BaseException as e:  # surface at next(), not hang
                     q.put(e)
                     return
@@ -962,20 +1245,119 @@ class ImageRecordIter(DataIter):
         self._producer.start()
 
     def next(self):
+        from ..telemetry import steps as _tsteps
+
         if self._prefetch:
             # overlap host decode of the NEXT batches with device compute
             # (parity: iter_prefetcher.h wrapped around the parser)
             if self._producer is None:
                 self._start_producer()
+            # time the consumer actually blocks on the decode pipeline =
+            # the data_wait phase of the next step (~0 when the producer
+            # kept ahead of compute)
+            t0 = time.perf_counter()
             item = self._queue.get()
+            _tsteps.phase("data_wait", (time.perf_counter() - t0) * 1e3)
             if item is None:
                 self._producer = None
                 raise StopIteration
             if isinstance(item, BaseException):
                 self._producer = None
                 raise item
+            self._consumed += 1
             return item
-        keys = self._next_keys()
-        if keys is None:
+        nk = self._next_keys()
+        if nk is None:
             raise StopIteration
-        return self._produce(keys)
+        batch = self._produce(*nk)
+        self._consumed += 1
+        return batch
+
+
+class TokenRecordIter(_ShardedEpochMixin, DataIter):
+    """Fixed-length token blocks from a RecordIO shard, through the same
+    native reader as the image path (the text half of the streaming data
+    plane — feeds the LLM training recipe).
+
+    Each record's payload is one block of ``seq_len + 1`` little-endian
+    tokens of `dtype` (pack corpora with :func:`write_token_shard`);
+    batches yield ``data = block[:, :-1]`` and ``label = block[:, 1:]``
+    (next-token targets). Sharding (block-cyclic over gang ranks, auto
+    from the distributed-init env), the deterministic ``(seed, epoch)``
+    shuffle, and the ``state_dict``/``load_state_dict`` mid-epoch-resume
+    grammar are IDENTICAL to :class:`ImageRecordIter` — one state format
+    for both modalities, so CheckpointManager persistence and gang
+    resharding compose unchanged. Wrap in :class:`PrefetchingIter` for
+    background fetch + device staging."""
+
+    def __init__(self, path_rec, seq_len, batch_size=32, shuffle=False,
+                 seed=0, dtype=_np.int32, round_batch=False,
+                 num_parts=None, part_index=None, **kwargs):
+        from .. import native
+
+        super().__init__(batch_size)
+        self._path = path_rec
+        self._seq_len = int(seq_len)
+        self._dtype = _np.dtype(dtype)
+        self._round_batch = round_batch
+        # index the shard once (native single-pass scan when built); each
+        # gang rank then READS only its own slice of the record index
+        self._offsets, self._lengths = native.recordio_scan(path_rec)
+        want = (self._seq_len + 1) * self._dtype.itemsize
+        bad = [int(i) for i, ln in enumerate(self._lengths)
+               if int(ln) != want]
+        if bad:
+            raise ValueError(
+                f"{path_rec!r}: record(s) {bad[:5]} are not fixed-length "
+                f"token blocks of {self._seq_len + 1} x "
+                f"{self._dtype.name} ({want} bytes) — pack shards with "
+                "io.write_token_shard")
+        self._init_epoch_state(seed, shuffle, num_parts, part_index)
+        self.provide_data = [DataDesc("data", (batch_size, self._seq_len),
+                                      self._dtype)]
+        self.provide_label = [DataDesc("label",
+                                       (batch_size, self._seq_len),
+                                       self._dtype)]
+        self.reset()
+
+    def _keys(self):
+        return list(range(len(self._offsets)))
+
+    def reset(self):
+        self._begin_epoch()
+
+    def next(self):
+        from .. import faults as _faults
+        from .. import native
+        from ..ndarray import array as _array
+
+        nk = self._next_keys()
+        if nk is None:
+            raise StopIteration
+        _faults.point("io.decode")
+        _start, keys = nk
+        payloads = native.recordio_read(
+            self._path, self._offsets[keys], self._lengths[keys])
+        blocks = _np.stack([_np.frombuffer(p, self._dtype)
+                            for p in payloads])
+        self._consumed += 1
+        return DataBatch(data=[_array(blocks[:, :-1], dtype=self._dtype)],
+                         label=[_array(blocks[:, 1:], dtype=self._dtype)],
+                         pad=0, index=None)
+
+
+def write_token_shard(path, tokens, seq_len, dtype=_np.int32):
+    """Pack a flat token stream into a RecordIO shard of fixed-length
+    blocks for :class:`TokenRecordIter`: consecutive windows of
+    ``seq_len + 1`` tokens with stride ``seq_len`` (every position is
+    predicted exactly once by the data/label shift); a tail short of a
+    full block is dropped. Native single-pass framing when built.
+    Returns the number of blocks written."""
+    from .. import native
+
+    tokens = _np.ascontiguousarray(tokens, dtype)
+    payloads = [tokens[s:s + seq_len + 1].tobytes()
+                for s in range(0, len(tokens) - seq_len, seq_len)]
+    with open(path, "wb") as f:
+        f.write(native.recordio_pack(payloads))
+    return len(payloads)

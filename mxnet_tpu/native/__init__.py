@@ -11,7 +11,8 @@ API:
   recordio_scan(path) -> (offsets, lengths)   # index a .rec without .idx
   recordio_read(path, offsets, lengths) -> list[bytes]
   normalize_batch(u8_hwc, mean, std) -> f32 chw
-  available() -> bool
+  decode_jpeg_batch / decode_augment_batch  # OMP decode(+augment) loops
+  available() -> bool, status() -> dict      # why the native path is off
 """
 from __future__ import annotations
 
@@ -22,14 +23,16 @@ import sys
 
 import numpy as _np
 
-__all__ = ["available", "recordio_scan", "recordio_read",
-           "normalize_batch", "recordio_pack"]
+__all__ = ["available", "status", "recordio_scan", "recordio_read",
+           "normalize_batch", "recordio_pack", "decode_jpeg_batch",
+           "decode_augment_batch"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "mxtpu_io.cc")
 _LIB_PATH = os.path.join(_HERE, "libmxtpu_io.so")
 _lib = None
 _tried = False
+_error = None  # why the probe failed (cached; surfaced ONCE, see _load)
 
 
 def _build():
@@ -43,6 +46,37 @@ def _build():
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                "-DMXTPU_NO_JPEG", _SRC, "-o", _LIB_PATH]
         subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _record_failure(exc):
+    """Cache WHY the native path is off and surface it exactly once —
+    a warning + telemetry counter instead of the old silent per-call
+    degradation (every later call sees the cached probe result;
+    tools/diagnose.py's "Data Plane" report prints the reason)."""
+    global _error
+    if isinstance(exc, subprocess.CalledProcessError):
+        stderr = (exc.stderr or b"").decode(errors="replace").strip()
+        _error = f"build failed (rc {exc.returncode}): {stderr[-400:]}"
+    else:
+        _error = f"{type(exc).__name__}: {exc}"
+    try:
+        from .. import log as _log
+
+        _log.get_logger("mxnet_tpu.native").warning(
+            "native IO library unavailable (%s); RecordIO/decode fall "
+            "back to pure Python — see tools/diagnose.py 'Data Plane'",
+            _error)
+    except Exception:
+        pass
+    try:
+        from ..telemetry import registry as _registry
+
+        _registry.counter(
+            "mxtpu_native_unavailable_total",
+            "Native IO library probe/build failures (Python fallback "
+            "active)").inc()
+    except Exception:
+        pass
 
 
 def _load():
@@ -82,15 +116,46 @@ def _load():
                 ctypes.c_int, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        if hasattr(lib, "mxtpu_decode_augment_batch"):
+            lib.mxtpu_decode_augment_batch.restype = ctypes.c_longlong
+            lib.mxtpu_decode_augment_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_longlong,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
         _lib = lib
-    except Exception:
+    except Exception as e:
         _lib = None
+        _record_failure(e)
     return _lib
 
 
 def available():
     """True when the native library is built and loadable."""
     return _load() is not None
+
+
+def status():
+    """The data-plane probe result, for tools/diagnose.py and tests:
+    availability of the lib and of each optional capability, plus the
+    cached failure reason when the native path is off."""
+    lib = _load()
+    return {
+        "available": lib is not None,
+        "lib_path": _LIB_PATH,
+        "built": os.path.exists(_LIB_PATH),
+        "jpeg": bool(lib is not None
+                     and hasattr(lib, "mxtpu_decode_jpeg_batch")),
+        "augment": bool(lib is not None
+                        and hasattr(lib, "mxtpu_decode_augment_batch")),
+        "error": _error,
+    }
 
 
 def recordio_scan(path):
@@ -224,6 +289,66 @@ def recordio_pack(payloads):
     return bytes(out)
 
 
+def _blob_offsets(bufs):
+    """Concatenate payloads + per-record (offsets, lengths) for the OMP
+    decode entry points."""
+    n = len(bufs)
+    offsets = _np.zeros(n, _np.uint64)
+    lengths = _np.zeros(n, _np.uint64)
+    pos = 0
+    for i, b in enumerate(bufs):
+        offsets[i] = pos
+        lengths[i] = len(b)
+        pos += len(b)
+    blob = _np.frombuffer(b"".join(bufs), _np.uint8)
+    return blob, offsets, lengths
+
+
+def decode_augment_batch(bufs, dh, dw, oh, ow, crop_y=None, crop_x=None,
+                         mirror=None, jitter=None, n_threads=0):
+    """Fused decode + augmentation (the streaming-data-plane hot path):
+    decode each JPEG to (dh, dw), crop to (oh, ow) at per-image
+    (crop_y[i], crop_x[i]), mirror where mirror[i], scale channels by
+    jitter[i] — one pass per worker thread, producing training-ready
+    HWC rows with no intermediate Python copy (parity: the augmenter
+    chain inside iter_image_recordio_2.cc's OMP ParseChunk loop).
+    Returns (batch, failed_idx) like :func:`decode_jpeg_batch`, or None
+    when the native path is unavailable (caller falls back to the
+    bit-compatible Python augmenter)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "mxtpu_decode_augment_batch"):
+        return None
+    n = len(bufs)
+    blob, offsets, lengths = _blob_offsets(bufs)
+    out = _np.empty((n, oh, ow, 3), _np.uint8)
+    failed = _np.full(n, -1, _np.int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    cy = (_np.ascontiguousarray(crop_y, _np.int32)
+          if crop_y is not None else None)
+    cx = (_np.ascontiguousarray(crop_x, _np.int32)
+          if crop_x is not None else None)
+    mir = (_np.ascontiguousarray(mirror, _np.uint8)
+           if mirror is not None else None)
+    jit = (_np.ascontiguousarray(jitter, _np.float32)
+           if jitter is not None else None)
+    lib.mxtpu_decode_augment_batch(
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n, dh, dw, oh, ow,
+        cy.ctypes.data_as(i32p) if cy is not None else None,
+        cx.ctypes.data_as(i32p) if cx is not None else None,
+        mir.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if mir is not None else None,
+        jit.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        if jit is not None else None,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        int(n_threads))
+    bad = [int(i) for i in failed if i >= 0]
+    return out, bad
+
+
 def decode_jpeg_batch(bufs, out_h, out_w, n_threads=0):
     """Decode a list of JPEG byte strings into an (N, out_h, out_w, 3)
     uint8 HWC array, resized bilinearly, OMP-parallel in C++ (parity:
@@ -234,17 +359,9 @@ def decode_jpeg_batch(bufs, out_h, out_w, n_threads=0):
     if lib is None or not hasattr(lib, "mxtpu_decode_jpeg_batch"):
         return None
     n = len(bufs)
-    blob = b"".join(bufs)
-    offsets = _np.zeros(n, _np.uint64)
-    lengths = _np.zeros(n, _np.uint64)
-    pos = 0
-    for i, b in enumerate(bufs):
-        offsets[i] = pos
-        lengths[i] = len(b)
-        pos += len(b)
+    blob_arr, offsets, lengths = _blob_offsets(bufs)
     out = _np.empty((n, out_h, out_w, 3), _np.uint8)
     failed = _np.full(n, -1, _np.int64)
-    blob_arr = _np.frombuffer(blob, _np.uint8)
     lib.mxtpu_decode_jpeg_batch(
         blob_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),

@@ -213,6 +213,27 @@ bool decode_resize_one(const uint8_t* buf, uint64_t len, int oh, int ow,
   return true;
 }
 
+// Fused in-loop augmentation: crop + horizontal mirror + per-channel
+// multiplicative color jitter from a decoded (dh, dw) RGB image into the
+// final (oh, ow) training-ready HWC row. The arithmetic (float32 mul,
+// +0.5, truncate, clamp 255) is kept EXACTLY equal to the pure-Python
+// fallback in io/io.py (_augment_py), so the two paths are bit-compatible
+// given identical decoded pixels.
+void augment_into(const uint8_t* src, int dw, int cy, int cx, int oh,
+                  int ow, int mirror, const float* jit, uint8_t* out) {
+  for (int y = 0; y < oh; ++y) {
+    const uint8_t* srow = src + (size_t(cy + y) * dw + cx) * 3;
+    uint8_t* drow = out + size_t(y) * ow * 3;
+    for (int x = 0; x < ow; ++x) {
+      const uint8_t* sp = srow + (mirror ? (ow - 1 - x) : x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        const float v = float(sp[c]) * jit[c] + 0.5f;
+        drow[x * 3 + c] = v >= 255.0f ? 255 : uint8_t(v);
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -246,6 +267,52 @@ long long mxtpu_decode_jpeg_batch(const uint8_t* blob,
 #endif
       { failed[nfail++] = i; }
     }
+  }
+  if (nfail < n) failed[nfail] = -1;
+  return ok;
+}
+
+// The streaming-data-plane hot path (parity: the augmenter chain that
+// iter_image_recordio_2.cc runs INSIDE its OMP ParseChunk loop): decode
+// `n` JPEGs to an oversized (dh, dw) scratch, then crop to (oh, ow) at
+// per-image (crop_y[i], crop_x[i]), mirror when mirror[i], and apply the
+// per-image per-channel jitter factors jitter[i*3..] — all fused in one
+// worker-thread pass producing the training-ready HWC row directly into
+// `out` (n, oh, ow, 3). NULL crop/mirror/jitter mean offset 0 / no flip /
+// factor 1. Returns the number decoded; failures zero-fill + are listed
+// in `failed` (-1 terminated) for the caller's per-record PIL retry.
+long long mxtpu_decode_augment_batch(
+    const uint8_t* blob, const uint64_t* offsets, const uint64_t* lengths,
+    long long n, int dh, int dw, int oh, int ow, const int32_t* crop_y,
+    const int32_t* crop_x, const uint8_t* mirror, const float* jitter,
+    uint8_t* out, long long* failed, int n_threads) {
+  long long ok = 0;
+  long long nfail = 0;
+  static const float kOnes[3] = {1.0f, 1.0f, 1.0f};
+#ifdef _OPENMP
+  const int team = n_threads > 0 ? n_threads : omp_get_max_threads();
+#pragma omp parallel for schedule(dynamic) reduction(+:ok) num_threads(team)
+#endif
+  for (long long i = 0; i < n; ++i) {
+    uint8_t* dst = out + static_cast<size_t>(i) * oh * ow * 3;
+    uint8_t* scratch =
+        static_cast<uint8_t*>(malloc(static_cast<size_t>(dh) * dw * 3));
+    const bool good = scratch != nullptr &&
+        decode_resize_one(blob + offsets[i], lengths[i], dh, dw, scratch);
+    if (good) {
+      augment_into(scratch, dw, crop_y ? crop_y[i] : 0,
+                   crop_x ? crop_x[i] : 0, oh, ow,
+                   mirror ? mirror[i] : 0,
+                   jitter ? jitter + i * 3 : kOnes, dst);
+      ++ok;
+    } else {
+      memset(dst, 0, static_cast<size_t>(oh) * ow * 3);
+#ifdef _OPENMP
+#pragma omp critical
+#endif
+      { failed[nfail++] = i; }
+    }
+    free(scratch);
   }
   if (nfail < n) failed[nfail] = -1;
   return ok;

@@ -849,8 +849,9 @@ class ShardedTrainer:
             "host": _ckpt.host_metadata(),
         }
 
-    def _remember_manager(self, manager, epoch):
-        """Track the newest manager/epoch and (re-)register the shared
+    def _remember_manager(self, manager, epoch, data_iter=None):
+        """Track the newest manager/epoch (and the data iterator whose
+        position rides in the checkpoint) and (re-)register the shared
         final-checkpoint hook (``watchdog.set_last_resort``) that both a
         watchdog ``action:abort`` and a preemption drain invoke. A hook
         the USER installed explicitly is never clobbered — only ours
@@ -859,6 +860,8 @@ class ShardedTrainer:
 
         self._ckpt_manager = manager
         self._ckpt_epoch = int(epoch)
+        if data_iter is not None:
+            self._ckpt_data_iter = data_iter
         prev = _watchdog.last_resort()
         if prev is None or getattr(prev, "_mxtpu_trainer_hook", False):
             hook = self._final_checkpoint
@@ -879,9 +882,11 @@ class ShardedTrainer:
         from .. import preempt as _preempt
 
         meta = {"drain": _preempt.event() or True}
-        return self.save_checkpoint(mgr, self._ckpt_epoch + 1, meta=meta)
+        return self.save_checkpoint(
+            mgr, self._ckpt_epoch + 1, meta=meta,
+            data_iter=getattr(self, "_ckpt_data_iter", None))
 
-    def save_checkpoint(self, manager, epoch, meta=None):
+    def save_checkpoint(self, manager, epoch, meta=None, data_iter=None):
         """Write trainer state through a :class:`~mxnet_tpu.checkpoint.
         CheckpointManager` — atomic write, CRC-checksummed manifest entry,
         keep-N rotation, and a ``meta.topology`` record (mesh shape,
@@ -889,13 +894,22 @@ class ShardedTrainer:
         checkpoint topology-portable. Collective across processes; only
         the writer rank touches disk. Also registers this manager as the
         preemption-drain/last-resort target. Returns the manager's
-        {name: path} map (None on non-writer ranks)."""
+        {name: path} map (None on non-writer ranks).
+
+        ``data_iter``: an iterator with the ``state_dict()`` grammar
+        (ImageRecordIter / TokenRecordIter / PrefetchingIter) — its exact
+        stream position is recorded as ``meta.data_state`` and, once
+        passed, rides in every later drain/last-resort checkpoint too, so
+        a mid-epoch preemption resumes at the next unseen batch with the
+        identical shuffle + augmentation stream."""
         from ..ndarray import utils as nd_utils
 
         payload = self._state_payload()
         meta = dict(meta or {})
         meta.setdefault("topology", self.topology_meta())
-        self._remember_manager(manager, epoch)
+        if data_iter is not None and "data_state" not in meta:
+            meta["data_state"] = data_iter.state_dict()
+        self._remember_manager(manager, epoch, data_iter)
         if not self._is_writer_rank():
             return None
         return manager.save(
@@ -919,7 +933,7 @@ class ShardedTrainer:
                          f"{ch.get('process_count')}")
         return diffs
 
-    def resume(self, manager, reshard=None):
+    def resume(self, manager, reshard=None, data_iter=None):
         """Restore the latest good checkpoint recorded by `manager`
         (corrupt files are detected by checksum and skipped in favour of
         the previous good epoch). Returns the manifest entry — epoch,
@@ -982,7 +996,14 @@ class ShardedTrainer:
                     "reduction order (bit-exact only on the saved "
                     "topology)", stacklevel=2)
         self.load_states(paths["states"])
-        self._remember_manager(manager, entry["epoch"])
+        data_state = (entry.get("meta") or {}).get("data_state")
+        if data_iter is not None and data_state is not None:
+            # restore the exact stream position the checkpoint was cut at
+            # — load_state_dict re-partitions it when this gang's
+            # num_parts differs from the saving gang's (resharded resume)
+            data_iter.load_state_dict(data_state)
+        self._remember_manager(manager, entry["epoch"],
+                               data_iter=data_iter)
         return entry
 
     def load_states(self, fname):

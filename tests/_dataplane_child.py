@@ -1,0 +1,85 @@
+"""Subprocess half of the data-plane kill-and-resume drill.
+
+Iterates an AUGMENTED ImageRecordIter (fused native decode+rand-crop+
+mirror+color-jitter, prefetch producer running), persisting the
+iterator's ``state_dict`` through a CheckpointManager after every
+consumed batch, and either
+
+* SIGKILLs itself mid-epoch after ``DP_KILL_AFTER`` batches (no exit
+  handler runs — the hard-preemption scenario), or
+* resumes from the manager's last good entry (``DP_RESUME=1``) and
+  writes the REMAINING stream's checksums, or
+* runs the epoch uninterrupted (the reference stream).
+
+Output npz: per-batch CRC32 of the augmented pixel bytes + labels
+(proof the resumed stream is bit-exact, augmentation included), and
+``__start__`` = the batch index the run began at.
+
+Env: DP_REC, DP_CKPT, DP_OUT, DP_KILL_AFTER, DP_RESUME, DP_BATCH,
+DP_PARTS, DP_PART, DP_SHAPE (default 3,24,24).
+"""
+import json
+import os
+import signal
+import sys
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint
+
+
+def main():
+    rec = os.environ["DP_REC"]
+    ckpt_dir = os.environ["DP_CKPT"]
+    out = os.environ.get("DP_OUT")
+    kill_after = int(os.environ.get("DP_KILL_AFTER", "0") or 0)
+    resume = os.environ.get("DP_RESUME") == "1"
+    batch = int(os.environ.get("DP_BATCH", "4"))
+    parts = int(os.environ.get("DP_PARTS", "1"))
+    part = int(os.environ.get("DP_PART", "0"))
+    shape = tuple(int(x) for x in
+                  os.environ.get("DP_SHAPE", "3,24,24").split(","))
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=shape, batch_size=batch,
+        shuffle=True, rand_crop=True, rand_mirror=True, color_jitter=0.2,
+        seed=3, round_batch=False, preprocess_threads=2,
+        prefetch_buffer=2, num_parts=parts, part_index=part)
+    manager = checkpoint.CheckpointManager(ckpt_dir, prefix="dp", keep=3)
+
+    start = 0
+    if resume:
+        entry, paths = manager.load()
+        with open(paths["iter"]) as f:
+            state = json.load(f)
+        it.load_state_dict(state)
+        start = it._consumed
+
+    crcs, labels = [], []
+    n = start
+    for b in it:
+        data = np.ascontiguousarray(b.data[0].asnumpy())
+        lab = np.ascontiguousarray(b.label[0].asnumpy())
+        crcs.append(zlib.crc32(data.tobytes())
+                    ^ zlib.crc32(lab.tobytes()))
+        labels.extend(int(x) for x in lab.reshape(-1))
+        n += 1
+        state = json.dumps(it.state_dict()).encode()
+        manager.save(n, {"iter": state})
+        if kill_after and n >= kill_after:
+            # hard preemption INSIDE the streaming loop: the prefetch
+            # producer is mid-decode on the next batches right now
+            os.kill(os.getpid(), signal.SIGKILL)
+    if out:
+        np.savez(out, crcs=np.asarray(crcs, np.uint64),
+                 labels=np.asarray(labels, np.int64),
+                 __start__=np.asarray(start))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

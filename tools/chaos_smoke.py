@@ -38,6 +38,15 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     a fresh coordinator epoch, and the resharded resume matches the
     uninterrupted run's loss trajectory within 1e-4, zero human
     intervention (``--skip-gang-drill`` for harnesses that cannot spawn),
+  * the DATA-PLANE drill (phase 9): a non-JPEG record inside the
+    AUGMENTED native decode loop falls back to PIL per-record with the
+    SAME augmentation draws (bit-identical to an all-PIL run), an
+    injected ``io.decode`` fault surfaces typed and the iterator's
+    ``state_dict`` recovers at the exact position, and — in a
+    subprocess — a mid-epoch SIGKILL inside the streaming loop resumes
+    from the CheckpointManager-persisted iterator state with the
+    identical remaining batch stream (``--skip-dataplane-drill`` skips
+    the subprocess half),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -266,6 +275,9 @@ def main(argv=None):
     parser.add_argument("--skip-gang-drill", action="store_true",
                         help="skip the phase-8 supervised gang drill "
                              "(two subprocess runs; same spawn caveat)")
+    parser.add_argument("--skip-dataplane-drill", action="store_true",
+                        help="skip the phase-9 SIGKILL-resume subprocess "
+                             "half (in-process checks still run)")
     args = parser.parse_args(argv)
 
     if args.serve_drill:
@@ -616,6 +628,135 @@ def main(argv=None):
         rc = gang_drill(root=os.path.join(ckpt_dir, "gang"))
         if rc:
             return rc
+
+    # phase 9: the streaming data plane — (a) a non-JPEG record inside
+    # the AUGMENTED native decode loop is retried through PIL with the
+    # SAME per-image augmentation draws (bit-identical to an all-PIL
+    # run); (b) an injected io.decode fault surfaces typed and a fresh
+    # iterator restored from state_dict continues at the exact position;
+    # (c) subprocess: SIGKILL mid-epoch inside the loop, resume from the
+    # CheckpointManager-persisted state, identical remaining stream
+    import io as _pio
+    import zlib as _zlib
+
+    from PIL import Image as _Image
+
+    import mxnet_tpu.recordio as _recordio
+    from mxnet_tpu import native as _native
+
+    dp_root = os.path.join(ckpt_dir, "dataplane")
+    os.makedirs(dp_root, exist_ok=True)
+    dp_rec_path = os.path.join(dp_root, "dp.rec")
+    dp_rs = np.random.RandomState(args.seed)
+    dp_rec = _recordio.MXIndexedRecordIO(os.path.join(dp_root, "dp.idx"),
+                                         dp_rec_path, "w")
+    for i in range(24):
+        arr = dp_rs.randint(0, 255, (32, 32, 3), np.uint8)
+        buf = _pio.BytesIO()
+        # record 5: a PNG — valid image, but the native libjpeg loop
+        # rejects it, forcing the per-record PIL retry path
+        _Image.fromarray(arr).save(buf, "PNG" if i == 5 else "JPEG",
+                                   **({} if i == 5 else {"quality": 95}))
+        dp_rec.write_idx(i, _recordio.pack(
+            _recordio.IRHeader(0, float(i), i, 0), buf.getvalue()))
+    dp_rec.close()
+    dp_kw = dict(path_imgrec=dp_rec_path, data_shape=(3, 24, 24),
+                 batch_size=4, shuffle=True, rand_crop=True,
+                 rand_mirror=True, color_jitter=0.2, seed=args.seed,
+                 round_batch=False, prefetch_buffer=0,
+                 num_parts=1, part_index=0)
+    native_stream = [b.data[0].asnumpy()
+                     for b in mx.io.ImageRecordIter(**dp_kw)]
+    orig_aug = _native.decode_augment_batch
+    _native.decode_augment_batch = lambda *a, **k: None
+    try:
+        pil_stream = [b.data[0].asnumpy()
+                      for b in mx.io.ImageRecordIter(**dp_kw)]
+    finally:
+        _native.decode_augment_batch = orig_aug
+    if len(native_stream) != len(pil_stream) or any(
+            not np.array_equal(a, b)
+            for a, b in zip(native_stream, pil_stream)):
+        print("FAIL: augmented native loop (with PIL per-record retry) "
+              "diverges from the all-PIL fallback")
+        return 1
+    if _native.status()["augment"]:
+        print("  augmented native loop == PIL fallback bit-exact "
+              "(PNG record retried in-loop)")
+
+    faults.configure("io.decode:raise@2", seed=args.seed)
+    dp_it = mx.io.ImageRecordIter(**dp_kw)
+    dp_states, dp_seen, dp_fault = [dp_it.state_dict()], [], None
+    try:
+        for b in dp_it:
+            dp_seen.append(b.data[0].asnumpy())
+            dp_states.append(dp_it.state_dict())
+    except faults.InjectedFault as e:
+        dp_fault = e
+    faults.reset()
+    if dp_fault is None:
+        print("FAIL: the injected io.decode fault never fired")
+        return 1
+    dp_resume = mx.io.ImageRecordIter(**dp_kw)
+    dp_resume.load_state_dict(dp_states[len(dp_seen)])
+    dp_rest = [b.data[0].asnumpy() for b in dp_resume]
+    want = native_stream[len(dp_seen):]
+    if len(dp_rest) != len(want) or any(
+            not np.array_equal(a, b) for a, b in zip(dp_rest, want)):
+        print("FAIL: post-fault state_dict resume is not at the exact "
+              "position")
+        return 1
+    print(f"  io.decode fault at batch {len(dp_seen) + 1} -> typed "
+          f"InjectedFault; state_dict resume replayed the remaining "
+          f"{len(dp_rest)} batches bit-exact")
+
+    if not args.skip_dataplane_drill:
+        import subprocess as _sp
+
+        child = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests", "_dataplane_child.py")
+        denv = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "DP_REC": dp_rec_path,
+                "DP_CKPT": os.path.join(dp_root, "ck"),
+                "DP_BATCH": "4"}
+        denv.pop("MXNET_TPU_FAULTS", None)
+        ref_out = os.path.join(dp_root, "ref.npz")
+        proc = _sp.run([sys.executable, child],
+                       env={**denv, "DP_OUT": ref_out,
+                            "DP_CKPT": os.path.join(dp_root, "refck")},
+                       capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            print(f"FAIL: dataplane reference run exited "
+                  f"{proc.returncode}:\n{proc.stderr[-1500:]}")
+            return 1
+        proc = _sp.run([sys.executable, child],
+                       env={**denv, "DP_KILL_AFTER": "2"},
+                       capture_output=True, text=True, timeout=120)
+        if proc.returncode != -9:  # SIGKILL, no cleanup ran
+            print(f"FAIL: kill child exited {proc.returncode}, "
+                  f"want SIGKILL:\n{proc.stderr[-1500:]}")
+            return 1
+        res_out = os.path.join(dp_root, "res.npz")
+        proc = _sp.run([sys.executable, child],
+                       env={**denv, "DP_RESUME": "1", "DP_OUT": res_out},
+                       capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            print(f"FAIL: dataplane resume run exited "
+                  f"{proc.returncode}:\n{proc.stderr[-1500:]}")
+            return 1
+        ref_np, res_np = dict(np.load(ref_out)), dict(np.load(res_out))
+        start9 = int(res_np["__start__"])
+        if start9 != 2:
+            print(f"FAIL: resume started at batch {start9}, want 2")
+            return 1
+        if not np.array_equal(res_np["crcs"], ref_np["crcs"][start9:]):
+            print("FAIL: resumed stream checksums diverge from the "
+                  "uninterrupted run")
+            return 1
+        print(f"  SIGKILL at batch {start9} -> resume replayed batches "
+              f"{start9 + 1}..{len(ref_np['crcs'])} bit-exact "
+              "(augmentation stream included)")
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

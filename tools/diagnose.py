@@ -457,6 +457,66 @@ def check_gang():
     return out
 
 
+def check_dataplane():
+    """The streaming data plane: native library status (and, when the
+    native path is off, the cached probe/build failure explaining WHY —
+    the once-surfaced warning's detail), decode thread environment, and
+    the host's last measured iter_bench numbers."""
+    _p("---------Data Plane------------")
+    out = {"cores": os.cpu_count(),
+           "OMP_NUM_THREADS": os.environ.get("OMP_NUM_THREADS")}
+    try:
+        from mxnet_tpu import native
+
+        st = native.status()
+        out["native"] = st
+        _p(f"native lib    : {'available' if st['available'] else 'OFF'} "
+           f"({st['lib_path']})")
+        _p(f"  capabilities: jpeg={st['jpeg']} "
+           f"fused-augment={st['augment']} built={st['built']}")
+        if st["error"]:
+            _p(f"  why off     : {st['error']}")
+        _p(f"decode threads: {out['cores']} core(s), "
+           f"OMP_NUM_THREADS={out['OMP_NUM_THREADS'] or '<unset>'} "
+           "(ImageRecordIter preprocess_threads bounds the OMP team)")
+        shard = {"MXTPU_NUM_WORKERS":
+                 os.environ.get("MXTPU_NUM_WORKERS"),
+                 "MXTPU_WORKER_ID": os.environ.get("MXTPU_WORKER_ID")}
+        out["shard_env"] = shard
+        _p(f"reader shard  : num_parts="
+           f"{shard['MXTPU_NUM_WORKERS'] or '<unset>'} part_index="
+           f"{shard['MXTPU_WORKER_ID'] or '<unset>'} (gang env; "
+           "explicit iterator args override)")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("native import failed:", e)
+    try:
+        import tempfile
+
+        path = os.path.join(tempfile.gettempdir(),
+                            "mxtpu_iter_bench.json")
+        with open(path) as f:
+            last = json.load(f)
+        out["last_iter_bench"] = last
+        age = time.time() - last.get("time", 0)
+        _p(f"last bench    : {last.get('metric')} = {last.get('value')} "
+           f"{last.get('unit')} "
+           f"(threads {last.get('threads')}, {age / 3600:.1f}h ago)")
+        if last.get("img_s_per_core") is not None:
+            _p(f"  per core    : {last['img_s_per_core']} img/s/core, "
+               f"python fallback {last.get('python_img_s')} img/s, "
+               f"scaling {last.get('thread_scaling')}")
+        if last.get("train_data_wait_ms_mean") is not None:
+            _p(f"  data_wait   : mean {last['train_data_wait_ms_mean']}"
+               f"ms / max {last['train_data_wait_ms_max']}ms under the "
+               "bench train loop")
+    except (OSError, ValueError):
+        out["last_iter_bench"] = None
+        _p("last bench    : none recorded (run benchmark/iter_bench.py "
+           "--augment or bench.py)")
+    return out
+
+
 def check_telemetry():
     """Telemetry state (docs/OBSERVABILITY.md): knobs, the metrics
     registry snapshot (post-collection, the same values ``/metrics``
@@ -528,6 +588,7 @@ SECTIONS = (
     ("watchdog", check_watchdog),
     ("preempt", check_preempt),
     ("gang", check_gang),
+    ("dataplane", check_dataplane),
     ("telemetry", check_telemetry),
 )
 
