@@ -88,6 +88,7 @@ import time
 from . import log as _log
 from . import preempt as _preempt
 from . import watchdog as _watchdog
+from .telemetry import fleet as _fleet
 from .telemetry import flight as _flight
 
 __all__ = ["GangSupervisor", "RESTARTABLE_EXITS", "STATES", "STATE_CODES",
@@ -199,6 +200,18 @@ class _Heartbeater:
             if not self._warned:  # a broken shared dir must not spam
                 self._warned = True
                 _logger.warning("gang: heartbeat write failed: %s", e)
+            return
+        try:
+            # the telemetry shard rides the same cadence: this rank's
+            # post-collection metrics + step records + span/flight tails
+            # (the fleet scrape, straggler verdict and merged gang trace
+            # all read these; telemetry-off skips the write entirely)
+            _fleet.write_shard(self.run_dir, self.rank, self.generation)
+        except Exception as e:
+            if not self._warned:
+                self._warned = True
+                _logger.warning("gang: telemetry shard write failed: %s",
+                                e)
 
     def start(self):
         self.beat()  # announce immediately: the supervisor wants our pid
@@ -409,6 +422,15 @@ class GangSupervisor:
         self.cwd = cwd
         self._popen = popen or subprocess.Popen
 
+        # fleet aggregation: any /metrics scrape in this process (the
+        # launch.py --metrics-port MetricsServer) now folds the rank
+        # shards into mxtpu_fleet_* / mxtpu_gang_straggler_* series; the
+        # monitor loop feeds the SAME detector so the straggler verdict
+        # (and its flight event) exists even when nobody scrapes
+        _fleet.install(self.run_dir)
+        self._straggler = _fleet.detector()
+        self._straggler_at = 0.0
+
         self.state = IDLE
         self.state_history = []        # [(t_wall, state)]
         self.generation = 0
@@ -451,6 +473,7 @@ class GangSupervisor:
                 "restarts_used": self.restarts_used,
                 "max_restarts": self.max_restarts,
                 "slots": [dict(s) for s in self.slots],
+                "straggler": self._straggler.last,
                 "run_dir": self.run_dir,
                 "coordinator_port": self.coordinator_port,
                 "shrink_on_kill": self.shrink_on_kill,
@@ -579,6 +602,22 @@ class GangSupervisor:
                     rank, age, hb.get("state"), hb.get("steps"),
                     self.dead_after)
 
+    def _check_straggler(self):
+        """Feed the fleet straggler detector from the monitor loop
+        (throttled: shard reads are cheap but not free at a 0.2s poll).
+        A persistent straggler records its ``gang.straggler`` flight
+        event here even when no scrape endpoint is mounted."""
+        now = time.monotonic()
+        if now - self._straggler_at < 1.0:
+            return
+        self._straggler_at = now
+        try:
+            self._straggler.update(
+                _fleet.read_shards(self.run_dir,
+                                   generation=self.generation))
+        except Exception:
+            pass  # telemetry must never take down supervision
+
     def _watch(self):
         """Monitor one generation. Returns ("done",), ("stop",),
         ("restart", reason) or ("fatal", code)."""
@@ -612,6 +651,7 @@ class GangSupervisor:
                 first_cycle = False
                 self._set_state(RUNNING)
             self._check_heartbeats()
+            self._check_straggler()
             time.sleep(self.poll)
 
     # ---------------------------------------------------------- teardown --

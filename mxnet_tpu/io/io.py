@@ -98,7 +98,13 @@ class DeviceStager:
         sh = self.sharding_for(getattr(raw, "ndim", 0), is_label)
         if getattr(raw, "sharding", None) == sh:
             return raw
-        return jax.device_put(raw, sh)
+        from ..telemetry import trace as _trace
+
+        if not _trace.enabled():
+            return jax.device_put(raw, sh)
+        with _trace.span("io.h2d", kind="h2d",
+                         nbytes=int(getattr(raw, "nbytes", 0))):
+            return jax.device_put(raw, sh)
 
 
 def _gang_shard(num_parts, part_index):

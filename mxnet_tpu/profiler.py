@@ -22,9 +22,10 @@ import threading
 import time
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
-           "dumps", "reset", "Domain", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope", "record_skip_step", "record_stall",
-           "record_cache", "record_compile", "record_serving"]
+           "dumps", "reset", "trace_info", "Domain", "Task", "Frame",
+           "Event", "Counter", "Marker", "scope", "record_skip_step",
+           "record_stall", "record_cache", "record_compile",
+           "record_serving"]
 
 _lock = threading.Lock()
 _RECORDING = False       # master flag: a session is active and not paused
@@ -48,6 +49,7 @@ _config = {
 _events = []  # chrome trace events
 _aggregate = {}  # name -> [count, total_us, min_us, max_us]
 _epoch = time.perf_counter()
+_epoch_mono = time.monotonic()  # same instant: the cross-clock anchor
 _device_trace_active = False
 
 
@@ -261,6 +263,15 @@ def record_counter(name, value):
         _events.append({"name": name, "cat": "counter", "ph": "C",
                         "pid": os.getpid(), "tid": 0, "ts": _now_us(),
                         "dur": 0, "args": {name: value}})
+
+
+def trace_info():
+    """The recorded chrome events plus the monotonic instant matching
+    the profiler's perf_counter epoch — so ``telemetry.trace.dump()``
+    can re-base profiler events onto the span/flight timeline (both
+    clocks are CLOCK_MONOTONIC-backed on the platforms we run on)."""
+    with _lock:
+        return {"epoch_mono": _epoch_mono, "events": list(_events)}
 
 
 def dump(finished=True, profile_process="worker"):

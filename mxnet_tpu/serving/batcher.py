@@ -24,6 +24,13 @@ Admission control: ``submit`` fast-rejects with
 queue-depth bound is hit (429 semantics — shed load, don't queue
 unboundedly) and with :class:`ServerDrainingError` once a drain started.
 
+Tracing: when :mod:`mxnet_tpu.telemetry.trace` is on, every request
+carries a :class:`~mxnet_tpu.telemetry.trace.RequestTrace` on its
+future — the collector/runner stamp pipeline marks (popped, padded,
+staged, compiled-call begin/end) and fulfilment commits the five-phase
+queue_wait / batch_collect / h2d / compute / respond breakdown
+(``ServingFuture.breakdown()``; docs/OBSERVABILITY.md "Tracing").
+
 Robustness: a hung batch (wedged device, poisoned input) blows its
 watchdog deadline → crash bundle + StallError; the batch's requests fail
 with a :class:`RequestError` carrying the cause and the batcher KEEPS
@@ -41,6 +48,7 @@ from collections import deque
 import numpy as _np
 
 from . import config as _config
+from ..telemetry import trace as _trace
 from .errors import (RequestError, RequestTimeout, ServerBusyError,
                      ServerDrainingError)
 from .metrics import ModelMetrics
@@ -54,7 +62,7 @@ class ServingFuture:
     ``timeout_ms`` default applies."""
 
     __slots__ = ("model", "t_submit", "t_done", "_event", "_result",
-                 "_error")
+                 "_error", "_trace")
 
     def __init__(self, model):
         self.model = model
@@ -63,6 +71,7 @@ class ServingFuture:
         self._event = threading.Event()
         self._result = None
         self._error = None
+        self._trace = None
 
     def done(self):
         return self._event.is_set()
@@ -86,6 +95,17 @@ class ServingFuture:
         if self.t_done is None:
             return None
         return (self.t_done - self.t_submit) * 1e3
+
+    @property
+    def request_id(self):
+        """The propagated trace/request id (None with tracing off)."""
+        return self._trace.request_id if self._trace is not None else None
+
+    def breakdown(self):
+        """The five-phase per-request breakdown (queue_wait /
+        batch_collect / h2d / compute / respond, milliseconds) once the
+        request finished — None before completion or with tracing off."""
+        return self._trace.breakdown if self._trace is not None else None
 
     def _fulfill(self, result):
         self.t_done = time.monotonic()
@@ -202,6 +222,10 @@ class BucketBatcher:
         arr = self.model.validate(arr)
         n = arr.shape[0]
         fut = ServingFuture(self.model.name)
+        if _trace.enabled():
+            # propagated context: the HTTP front end binds X-Request-Id
+            # on this thread; in-process callers get a fresh id
+            fut._trace = _trace.request_begin(self.model.name, rows=n)
         with self._cond:
             if self._draining or self._stopping:
                 self.metrics.record_reject()
@@ -243,6 +267,10 @@ class BucketBatcher:
                 rows += r.n
             self._rows -= rows
             self._inflight += 1
+            t_pop = time.monotonic()
+            for r in reqs:   # queue_wait ends here for the whole batch
+                if r.fut._trace is not None:
+                    r.fut._trace.mark("collected", t_pop)
             return reqs, rows
 
     def _pad(self, reqs, rows, bucket):
@@ -262,12 +290,18 @@ class BucketBatcher:
             reqs, rows = batch
             bucket = self.model.bucket_for(rows)
             x = self._pad(reqs, rows, bucket)
+            t_pad = time.monotonic()
             if self._stager is not None:
                 # h2d on this thread overlaps the runner's compiled call
                 try:
                     x = self._stager.put(x)
                 except Exception:
                     pass  # staging is an optimisation; jit transfers too
+            t_staged = time.monotonic()
+            for r in reqs:   # batch_collect = pad; h2d = the staged put
+                if r.fut._trace is not None:
+                    r.fut._trace.mark("assembled", t_pad)
+                    r.fut._trace.mark("staged", t_staged)
             while True:
                 try:
                     self._staged.put((reqs, x, rows, bucket), timeout=0.25)
@@ -282,6 +316,8 @@ class BucketBatcher:
     def _fail_batch(self, reqs, err):
         for r in reqs:
             r.fut._fail(err)
+            if r.fut._trace is not None:
+                r.fut._trace.finish(error=type(err).__name__)
         self.metrics.record_fail(len(reqs))
         with self._cond:
             self._inflight -= 1
@@ -309,6 +345,9 @@ class BucketBatcher:
                 return model.run(x, rows)
 
             t0 = time.monotonic()
+            for r in reqs:
+                if r.fut._trace is not None:
+                    r.fut._trace.mark("run_begin", t0)
             try:
                 outs = _watchdog.sync(
                     "serving.batch", run,
@@ -320,12 +359,17 @@ class BucketBatcher:
                     f"model {model.name!r}: batch of {rows} rows failed: "
                     f"{type(e).__name__}: {e}", cause=e))
                 continue
-            dur_ms = (time.monotonic() - t0) * 1e3
+            t_run_end = time.monotonic()
+            dur_ms = (t_run_end - t0) * 1e3
             off = 0
-            now = time.monotonic()
+            now = t_run_end
             for r in reqs:
                 sliced = [o[off:off + r.n] for o in outs]
+                if r.fut._trace is not None:
+                    r.fut._trace.mark("run_end", t_run_end)
                 r.fut._fulfill(sliced[0] if len(sliced) == 1 else sliced)
+                if r.fut._trace is not None:
+                    r.fut._trace.finish(bucket=bucket)
                 off += r.n
                 self.metrics.record_complete((now - r.fut.t_submit) * 1e3)
             self.metrics.record_batch(bucket, rows, dur_ms,

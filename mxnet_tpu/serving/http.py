@@ -3,7 +3,15 @@
 Endpoints (TF-Serving-flavoured paths, JSON bodies)::
 
     POST /v1/models/<name>:predict   {"data": [[...], ...]}
-                                     -> {"model":..., "outputs": [[...]]}
+                                     -> {"model":..., "outputs": [[...]],
+                                     "request_id":..., "phases": {...}}
+                                     (request id from the caller's
+                                     X-Request-Id header or minted here,
+                                     echoed back as a header; "phases"
+                                     is the traced queue_wait /
+                                     batch_collect / h2d / compute /
+                                     respond breakdown when tracing is
+                                     on — docs/OBSERVABILITY.md)
     GET  /v1/models                  -> {"models": [...]}
     GET  /v1/stats                   -> ModelServer.stats()
     GET  /healthz                    -> {"status": "ok"|"draining"}
@@ -33,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from ..telemetry import trace as _trace
 from .errors import (ModelNotFound, RequestError, RequestTimeout,
                      ServerBusyError, ServerDrainingError)
 
@@ -111,25 +120,47 @@ class HttpFrontEnd:
                 except (ValueError, KeyError, TypeError) as e:
                     self._json(400, {"error": f"bad request body: {e}"})
                     return
+                # propagated request id: honour the caller's
+                # X-Request-Id, else mint one; the batcher picks it up
+                # through the thread-bound trace context and the span
+                # pipeline keys the whole request timeline on it
+                rid = self.headers.get("X-Request-Id") \
+                    or _trace.new_request_id()
+                rid_hdr = [("X-Request-Id", rid)]
                 try:
-                    out = srv.predict(name, arr, timeout=front._timeout)
+                    with _trace.context(rid):
+                        fut = srv.submit(name, arr)
+                    out = fut.result(front._timeout)
                 except ModelNotFound as e:
-                    self._json(404, {"error": str(e)})
+                    self._json(404, {"error": str(e)},
+                               extra_headers=rid_hdr)
                 except ServerDrainingError as e:
                     self._json(503, {"error": str(e)},
-                               extra_headers=[("Retry-After", "1")])
+                               extra_headers=rid_hdr
+                               + [("Retry-After", "1")])
                 except ServerBusyError as e:
                     self._json(429, {"error": str(e)},
-                               extra_headers=[("Retry-After", "0.1")])
+                               extra_headers=rid_hdr
+                               + [("Retry-After", "0.1")])
                 except RequestTimeout as e:
-                    self._json(504, {"error": str(e)})
+                    self._json(504, {"error": str(e)},
+                               extra_headers=rid_hdr)
                 except (RequestError, ValueError) as e:
                     code = 400 if isinstance(e, ValueError) else 500
-                    self._json(code, {"error": str(e)})
+                    self._json(code, {"error": str(e)},
+                               extra_headers=rid_hdr)
                 else:
                     outs = out if isinstance(out, list) else [out]
-                    self._json(200, {"model": name,
-                                     "outputs": [o.tolist() for o in outs]})
+                    body = {"model": name,
+                            "outputs": [o.tolist() for o in outs],
+                            "request_id": fut.request_id or rid}
+                    bd = fut.breakdown()
+                    if bd is not None:
+                        body["phases"] = {
+                            k: bd.get(f"{k}_ms")
+                            for k in _trace.REQUEST_PHASES}
+                        body["phases"]["total_ms"] = bd["total_ms"]
+                    self._json(200, body, extra_headers=rid_hdr)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

@@ -22,7 +22,15 @@ ties them together for a fleet operator:
   ``cost_analysis()`` records, the per-device-kind peak-TFLOPS table,
   and the measured ``mfu_xla`` arithmetic;
 * :mod:`~mxnet_tpu.telemetry.steps` — the per-step phase timeline
-  (data-wait / h2d / compute / optimizer / sync).
+  (data-wait / h2d / compute / optimizer / sync);
+* :mod:`~mxnet_tpu.telemetry.trace` — the span tracer: propagated
+  request ids through the serving pipeline (five-phase per-request
+  breakdowns), trainer-step spans keyed (generation, rank, step), and
+  the merged multi-rank Perfetto ``trace.json`` exporter;
+* :mod:`~mxnet_tpu.telemetry.fleet` — per-rank telemetry shards next to
+  the gang heartbeat files, fleet-level ``mxtpu_fleet_*`` aggregation on
+  one scrape endpoint, and the ``mxtpu_gang_straggler_*`` skew/straggler
+  verdict.
 
 Knobs: ``MXNET_TPU_TELEMETRY=0`` disables push instrumentation
 (:func:`set_enabled` at runtime); ``MXNET_TPU_FLIGHT`` sizes the flight
@@ -36,14 +44,16 @@ module-global check; enabled, nothing runs on the per-op dispatch path
 """
 from __future__ import annotations
 
-from . import _state, costs, export, flight, memory, registry, steps
+from . import (_state, costs, export, fleet, flight, memory, registry,
+               steps, trace)
 from ._state import set_enabled
 from .export import (MetricsServer, metrics_snapshot, register_collector,
                      render_prometheus)
 
 __all__ = ["enabled", "set_enabled", "describe", "registry", "flight",
-           "costs", "memory", "steps", "export", "MetricsServer",
-           "metrics_snapshot", "render_prometheus", "register_collector"]
+           "costs", "memory", "steps", "export", "trace", "fleet",
+           "MetricsServer", "metrics_snapshot", "render_prometheus",
+           "register_collector"]
 
 
 def enabled() -> bool:
@@ -65,4 +75,5 @@ def describe():
         "executables_tracked": {s: a["executables"]
                                 for s, a in costs.aggregate().items()},
         "last_step": steps.last(),
+        "trace": trace.describe(),
     }

@@ -29,10 +29,11 @@ import threading
 
 from . import costs as _costs, flight as _flight, memory as _memory
 from . import registry as _registry
+from . import trace as _trace
 
-__all__ = ["register_collector", "collect", "metrics_snapshot",
-           "render_prometheus", "render_json", "MetricsServer",
-           "PROMETHEUS_CONTENT_TYPE"]
+__all__ = ["register_collector", "unregister_collector", "collect",
+           "metrics_snapshot", "render_prometheus", "render_json",
+           "MetricsServer", "PROMETHEUS_CONTENT_TYPE"]
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -50,6 +51,17 @@ def register_collector(name, fn):
                 _COLLECTORS[i] = (name, fn)
                 return
         _COLLECTORS.append((name, fn))
+
+
+def unregister_collector(name):
+    """Remove the collector registered as `name` (tests / fleet
+    teardown). Returns True when one was removed."""
+    with _lock:
+        for i, (n, _) in enumerate(_COLLECTORS):
+            if n == name:
+                del _COLLECTORS[i]
+                return True
+    return False
 
 
 def collect():
@@ -207,6 +219,16 @@ def _collect_flight():
                         _flight.size())
 
 
+def _collect_trace():
+    spans = _registry.counter("mxtpu_trace_spans_total",
+                              "Committed trace spans", labels=("kind",))
+    for kind, n in _trace.counts().items():
+        spans.set_total(n, kind)
+    _registry.gauge("mxtpu_trace_ring_size",
+                    "Span-ring capacity (0 = tracing disabled)").set(
+                        _trace.size())
+
+
 def _collect_preempt():
     mod = sys.modules.get("mxnet_tpu.preempt")
     if mod is None:
@@ -258,6 +280,7 @@ def _ensure_defaults():
     register_collector("kvstore", _collect_kvstore)
     register_collector("memory", _collect_memory)
     register_collector("flight", _collect_flight)
+    register_collector("trace", _collect_trace)
     register_collector("preempt", _collect_preempt)
     register_collector("gang", _collect_gang)
 

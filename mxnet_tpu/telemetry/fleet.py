@@ -1,0 +1,389 @@
+"""Fleet metric aggregation + straggler detection over rank shards.
+
+PR 10's gang gave every worker a heartbeat file; this module gives it a
+**telemetry shard** next to it: every heartbeat, each rank atomically
+rewrites ``telemetry-rank-<r>.json`` in the shared run dir with its
+post-collection metrics snapshot (the SAME values its own ``/metrics``
+scrape would serve), its recent step records, its span-ring tail and its
+flight tail — plus the (t_wall, t_mono) clock pair the multi-rank trace
+merge aligns on.
+
+The supervisor side consumes them two ways:
+
+* **Fleet scrape** — :func:`install` registers a scrape-time collector
+  that folds every (non-torn) shard into ``mxtpu_fleet_*`` series on
+  ONE endpoint (``tools/launch.py --supervise --metrics-port``):
+  counters are summed across ranks (``mxtpu_fleet_<name>`` — the sums
+  agree with the per-rank scrapes, test-asserted), a curated set of
+  gauges is re-exported per rank (``rank`` label), and
+  ``mxtpu_fleet_ranks`` / ``mxtpu_fleet_shard_age_seconds{rank}``
+  report shard liveness.
+
+* **Straggler verdict** — :class:`StragglerDetector` compares the ranks'
+  recent *common* steps: per-step skew (max−min duration), per-rank
+  sync-wait share, and a slowest-rank score (mean step time ÷ the other
+  ranks' median). A rank scoring ≥ ``MXNET_TPU_STRAGGLER_FACTOR``
+  (default 1.5) across ``MXNET_TPU_STRAGGLER_PERSIST`` (default 3)
+  consecutive *new* common steps is flagged **persistent**: the
+  ``mxtpu_gang_straggler_*`` gauges name it and a ``gang.straggler``
+  flight event is recorded once per episode. The GangSupervisor runs
+  the same detector from its monitor loop, so the verdict exists even
+  when nobody scrapes.
+
+Torn or partial shards (a rank mid-replace, a truncated file) are
+skipped at read time — merging must never trust a half-written rank.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import _state, flight as _flight, registry as _registry
+from . import steps as _steps, trace as _trace
+
+__all__ = ["SHARD_PREFIX", "shard_path", "set_shard_info", "write_shard",
+           "read_shards", "StragglerDetector", "detector", "install",
+           "uninstall", "installed_dir", "verdict", "shard_ages",
+           "describe"]
+
+SHARD_PREFIX = "telemetry-rank-"
+
+#: gauges re-exported per rank on the fleet endpoint (full generality
+#: would explode label cardinality; counters are summed generically)
+PER_RANK_GAUGES = ("mxtpu_step_time_ms", "mxtpu_step_mfu_xla",
+                   "mxtpu_serving_queue_depth", "mxtpu_serving_rps")
+
+_lock = threading.Lock()
+_INFO: dict = {}         # extra shard fields (metrics_port, ...)
+_seq = 0
+_detector = None
+_installed_dir = None
+
+
+def shard_path(run_dir, rank):
+    return os.path.join(os.fspath(run_dir), f"{SHARD_PREFIX}{rank}.json")
+
+
+def set_shard_info(**fields):
+    """Merge extra fields into every future shard this process writes
+    (e.g. ``metrics_port`` so the fleet side can find the rank's own
+    scrape endpoint)."""
+    with _lock:
+        _INFO.update(fields)
+
+
+def _atomic_json(path, obj):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, default=repr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def write_shard(run_dir, rank=None, generation=None):
+    """Atomically (re)write this rank's telemetry shard. Runs the scrape
+    collectors first so the snapshot equals what the rank's own
+    ``/metrics`` endpoint would serve. No-op (returns None) when
+    telemetry is disabled."""
+    if not _state.enabled:
+        return None
+    if rank is None or generation is None:
+        r, g = _trace.coords()
+        rank = r if rank is None else rank
+        generation = g if generation is None else generation
+    from . import export as _export
+
+    _export.collect()
+    global _seq
+    with _lock:
+        _seq += 1
+        seq = _seq
+        info = dict(_INFO)
+    shard = {"version": 1, "rank": int(rank),
+             "generation": int(generation), "pid": os.getpid(),
+             "seq": seq, "t_wall": time.time(),
+             "t_mono": time.monotonic(),
+             "metrics": _registry.snapshot(),
+             "steps": _steps.history(32),
+             "spans": _trace.tail(512),
+             "flight": _flight.tail(64)}
+    shard.update(info)
+    os.makedirs(os.fspath(run_dir), exist_ok=True)
+    return _atomic_json(shard_path(run_dir, int(rank)), shard)
+
+
+def read_shards(run_dir, generation=None):
+    """Parse every ``telemetry-rank-<r>.json`` under `run_dir` into
+    ``{rank: shard}``. Torn, truncated or malformed shards are SKIPPED
+    (the writer is mid-replace, or a rank died mid-write) — a merge must
+    only ever see complete shards. ``generation`` filters to one gang
+    incarnation."""
+    out = {}
+    try:
+        names = os.listdir(os.fspath(run_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(SHARD_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(run_dir, name)) as f:
+                shard = json.load(f)
+            rank = int(shard["rank"])
+            float(shard["t_wall"]), float(shard["t_mono"])
+        except (OSError, ValueError, TypeError, KeyError):
+            continue
+        if not isinstance(shard.get("metrics", {}), dict):
+            continue
+        if generation is not None \
+                and shard.get("generation") != generation:
+            continue
+        out[rank] = shard
+    return out
+
+
+def shard_ages(run_dir):
+    """{rank: seconds since the shard was written} (diagnose)."""
+    now = time.time()
+    return {rank: round(now - float(sh["t_wall"]), 3)
+            for rank, sh in read_shards(run_dir).items()}
+
+
+# ------------------------------------------------------------- straggler ---
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+class StragglerDetector:
+    """Cross-rank per-step skew analysis over the shards' step records.
+
+    A verdict only uses steps COMMON to every reporting rank (the gang
+    trains one global step sequence, so common steps are the comparable
+    unit); the persistence streak advances only when a NEW common step
+    appears, so re-reading unchanged shards can never inflate it."""
+
+    def __init__(self, factor=None, persist=None, window=8):
+        self.factor = _env_float("MXNET_TPU_STRAGGLER_FACTOR", 1.5) \
+            if factor is None else float(factor)
+        self.persist = int(_env_float("MXNET_TPU_STRAGGLER_PERSIST", 3)) \
+            if persist is None else int(persist)
+        self.window = int(window)
+        self.last = None
+        self.events = 0
+        self._streak_rank = None
+        self._streak = 0
+        self._last_step = -1
+        self._episode_recorded = False
+
+    def update(self, shards):
+        """Recompute the verdict from ``{rank: shard}``; returns it."""
+        hist = {}
+        for rank, sh in shards.items():
+            recs = {}
+            for r in sh.get("steps") or []:
+                if isinstance(r, dict) and "step" in r \
+                        and "duration_ms" in r:
+                    recs[int(r["step"])] = r
+            if recs:
+                hist[int(rank)] = recs
+        if len(hist) < 2:
+            self.last = {"status": "insufficient-ranks",
+                         "ranks": sorted(hist)}
+            return self.last
+        common = set.intersection(*(set(h) for h in hist.values()))
+        if not common:
+            self.last = {"status": "no-common-steps",
+                         "ranks": sorted(hist)}
+            return self.last
+        steps_common = sorted(common)[-self.window:]
+        last_step = steps_common[-1]
+        per_rank = {}
+        for rank, recs in hist.items():
+            durs = [float(recs[s]["duration_ms"]) for s in steps_common]
+            syncs = [float((recs[s].get("phases") or {}).get("sync", 0.0))
+                     for s in steps_common]
+            per_rank[rank] = {
+                "mean_step_ms": round(sum(durs) / len(durs), 3),
+                "last_step_ms": round(float(
+                    recs[last_step]["duration_ms"]), 3),
+                "sync_share": round(sum(syncs) / max(1e-9, sum(durs)), 4)}
+        means = {r: v["mean_step_ms"] for r, v in per_rank.items()}
+        slowest = max(means, key=lambda r: means[r])
+        others = sorted(v for r, v in means.items() if r != slowest)
+        median_others = others[len(others) // 2]
+        for r, v in per_rank.items():
+            v["score"] = round(means[r] / max(1e-9, median_others), 3)
+        score = per_rank[slowest]["score"]
+        lasts = [v["last_step_ms"] for v in per_rank.values()]
+        skew = max(lasts) - min(lasts)
+        flagged = score >= self.factor
+        if last_step > self._last_step:
+            self._last_step = last_step
+            if flagged and slowest == self._streak_rank:
+                self._streak += 1
+            elif flagged:
+                self._streak_rank, self._streak = slowest, 1
+            else:
+                self._streak_rank, self._streak = None, 0
+                self._episode_recorded = False
+        persistent = (self._streak_rank is not None
+                      and self._streak >= self.persist)
+        if persistent and not self._episode_recorded:
+            self._episode_recorded = True
+            self.events += 1
+            _flight.rec("gang.straggler", f"rank{self._streak_rank}",
+                        f"score {score:.2f} skew {skew:.1f}ms at step "
+                        f"{last_step}")
+        self.last = {"status": "ok", "ranks": sorted(hist),
+                     "last_common_step": last_step,
+                     "steps_compared": len(steps_common),
+                     "skew_ms": round(skew, 3),
+                     "slowest_rank": slowest if flagged else None,
+                     "score": score, "factor": self.factor,
+                     "persistent": persistent, "streak": self._streak,
+                     "per_rank": per_rank}
+        return self.last
+
+
+def detector():
+    """The process-shared detector (created on first use) — the
+    supervisor monitor loop and the fleet collector must feed the SAME
+    streak, or persistence would double-count."""
+    global _detector
+    with _lock:
+        if _detector is None:
+            _detector = StragglerDetector()
+        return _detector
+
+
+def verdict():
+    """The latest straggler verdict in this process, or None."""
+    det = _detector
+    return det.last if det is not None else None
+
+
+# ------------------------------------------------------- fleet collector ---
+
+def _fleet_name(name):
+    return "mxtpu_fleet_" + (name[len("mxtpu_"):]
+                             if name.startswith("mxtpu_") else name)
+
+
+def _collect_fleet():
+    run_dir = _installed_dir
+    if run_dir is None:
+        return
+    shards = read_shards(run_dir)
+    _registry.gauge("mxtpu_fleet_ranks",
+                    "Rank telemetry shards readable at the last "
+                    "scrape").set(len(shards))
+    age = _registry.gauge("mxtpu_fleet_shard_age_seconds",
+                          "Seconds since each rank's shard was written",
+                          labels=("rank",))
+    now = time.time()
+    sums: dict = {}   # (name, labels tuple, label values) -> total
+    for rank, sh in shards.items():
+        age.set(max(0.0, now - float(sh["t_wall"])), rank)
+        for name, metric in (sh.get("metrics") or {}).items():
+            if not isinstance(metric, dict):
+                continue
+            kind = metric.get("kind")
+            labels = tuple(metric.get("labels") or ())
+            for series in metric.get("series") or ():
+                try:
+                    values = tuple(series["labels"].get(l, "")
+                                   for l in labels)
+                except (AttributeError, TypeError):
+                    continue
+                v = series.get("value")
+                if kind == "counter" and isinstance(v, (int, float)):
+                    key = (name, labels, values)
+                    sums[key] = sums.get(key, 0.0) + float(v)
+                elif kind == "gauge" and name in PER_RANK_GAUGES \
+                        and isinstance(v, (int, float)):
+                    _registry.gauge(
+                        _fleet_name(name),
+                        f"Per-rank re-export of {name}",
+                        labels=labels + ("rank",)).set(v, *values, rank)
+    for (name, labels, values), total in sums.items():
+        _registry.counter(
+            _fleet_name(name),
+            f"Sum of {name} across rank shards",
+            labels=labels).set_total(total, *values)
+    # straggler verdict gauges ride on the same scrape
+    det = detector()
+    v = det.update(shards)
+    _registry.gauge(
+        "mxtpu_gang_straggler_rank",
+        "Rank flagged slowest (score >= factor); -1 when none").set(
+            v.get("slowest_rank") if v.get("slowest_rank") is not None
+            else -1)
+    _registry.gauge("mxtpu_gang_straggler_skew_ms",
+                    "max-min duration of the last common step").set(
+                        v.get("skew_ms", 0.0) or 0.0)
+    _registry.gauge("mxtpu_gang_straggler_persistent",
+                    "1 when the same rank stayed flagged across the "
+                    "persistence window").set(
+                        1.0 if v.get("persistent") else 0.0)
+    _registry.counter("mxtpu_gang_straggler_events_total",
+                      "Persistent-straggler flight events recorded"
+                      ).set_total(det.events)
+    per_rank = v.get("per_rank") or {}
+    if per_rank:
+        score = _registry.gauge("mxtpu_gang_straggler_score",
+                                "Mean step time / other ranks' median",
+                                labels=("rank",))
+        share = _registry.gauge("mxtpu_gang_straggler_sync_share",
+                                "Sync-wait share of recent step time",
+                                labels=("rank",))
+        stepms = _registry.gauge("mxtpu_gang_straggler_step_ms",
+                                 "Mean step duration over the compared "
+                                 "window", labels=("rank",))
+        for rank, rec in per_rank.items():
+            score.set(rec["score"], rank)
+            share.set(rec["sync_share"], rank)
+            stepms.set(rec["mean_step_ms"], rank)
+
+
+def install(run_dir):
+    """Point the fleet collector at `run_dir` and register it — every
+    subsequent scrape in this process (supervisor MetricsServer, serving
+    front end) folds the rank shards in. Returns the run dir."""
+    global _installed_dir
+    from . import export as _export
+
+    _installed_dir = os.fspath(run_dir)
+    _export.register_collector("fleet", _collect_fleet)
+    return _installed_dir
+
+
+def uninstall():
+    """Deregister the fleet collector (tests)."""
+    global _installed_dir
+    from . import export as _export
+
+    _installed_dir = None
+    _export.unregister_collector("fleet")
+
+
+def installed_dir():
+    return _installed_dir
+
+
+def describe():
+    """Knobs + state (tools/diagnose.py "Tracing")."""
+    det = _detector
+    return {"installed_dir": _installed_dir,
+            "shard_info": dict(_INFO),
+            "factor": _env_float("MXNET_TPU_STRAGGLER_FACTOR", 1.5),
+            "persist": int(_env_float("MXNET_TPU_STRAGGLER_PERSIST", 3)),
+            "verdict": det.last if det is not None else None,
+            "events": det.events if det is not None else 0}

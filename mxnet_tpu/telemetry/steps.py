@@ -125,6 +125,11 @@ def end_step(flops=None, devices=1, device_kind=None):
             "per-device-kind peak)").set(rec["mfu_xla"])
         _registry.gauge("mxtpu_step_flops",
                         "XLA-analyzed flops per step").set(flops)
+    # the step's span twin, keyed (generation, rank, step) — the raw
+    # material of the fleet straggler verdict and the merged gang trace
+    from . import trace as _trace
+
+    _trace.step_span(rec, cur["t0"])
     _flight.rec("step.end", "trainer.step",
                 f"step {rec['step']} {rec['duration_ms']}ms")
     from . import memory as _memory

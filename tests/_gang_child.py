@@ -32,6 +32,20 @@ Env knobs (GC_* are this child's; MXTPU_* come from the supervisor):
                       (e.g. "trainer.step:peerloss@6:1" — kill rank 1 at
                       step 6); later generations run clean, so the drill
                       converges instead of re-killing every incarnation
+    GC_STRAGGLE_RANK  this rank arms a per-step delay fault
+                      (trainer.step:delay@*) — the deterministic
+                      straggler for the PR 12 skew-detection drills
+    GC_STRAGGLE_MS    the straggler's per-step delay (default 200)
+    GC_METRICS        "1": start a per-rank telemetry MetricsServer,
+                      advertise its port in the rank's telemetry shard,
+                      and before exiting (a) scrape the OWN endpoint
+                      into <gang dir>/rank-scrape-<r>.txt — the
+                      fleet-sum acceptance compares the fleet scrape
+                      against these — and (b) write one final shard
+    GC_SERVE          "1": rank 0 serves a tiny model for a few traced
+                      requests after training (request spans with all
+                      five phases land in its shard for the merged
+                      gang trace)
 """
 import os
 import sys
@@ -89,6 +103,17 @@ def main():
     spec = os.environ.get("GC_FAULTS_GEN1")
     if spec and rank == 0 and generation == 1:
         faults.configure(spec)
+    straggle = os.environ.get("GC_STRAGGLE_RANK")
+    if straggle is not None and rank == int(straggle):
+        delay_s = float(os.environ.get("GC_STRAGGLE_MS", "200")) / 1e3
+        faults.configure(f"trainer.step:delay@*:{delay_s}")
+    metrics_server = None
+    if os.environ.get("GC_METRICS"):
+        from mxnet_tpu.telemetry import fleet
+        from mxnet_tpu.telemetry.export import MetricsServer
+
+        metrics_server = MetricsServer(port=0).start()
+        fleet.set_shard_info(metrics_port=metrics_server.port)
 
     mx.random.seed(7)
     net = gluon.nn.HybridSequential()
@@ -122,6 +147,40 @@ def main():
             # others must not race it in the shared manager
             preempt.drain(save=None if rank == 0 else False,
                           directory=ckpt_dir)  # SystemExit(75)
+
+    if os.environ.get("GC_SERVE") and rank == 0:
+        # a few traced requests so the gang trace carries serving
+        # request spans (five phases) alongside the step spans
+        from mxnet_tpu import serving
+
+        snet = gluon.nn.Dense(4, in_units=6)
+        snet.initialize(mx.init.Xavier())
+        snet(mx.nd.zeros((2, 6)))
+        cont = serving.ModelContainer()
+        cont.add_block("gangserve", snet, example_shape=(6,),
+                       buckets=(2,))
+        srv = serving.ModelServer(cont, max_wait_ms=1.0).start()
+        srv.warmup()
+        for i in range(4):
+            srv.predict("gangserve",
+                        np.zeros((1, 6), np.float32), timeout=10.0)
+        srv.drain(timeout=10.0)
+        srv.stop()
+
+    if metrics_server is not None and gang_dir:
+        # freeze this rank's story: scrape the own endpoint (the
+        # per-rank truth the fleet sums are checked against), then
+        # write a final telemetry shard carrying the same counters
+        import urllib.request
+
+        from mxnet_tpu.telemetry import fleet
+
+        text = urllib.request.urlopen(
+            metrics_server.url + "/metrics", timeout=10).read().decode()
+        with open(os.path.join(gang_dir, f"rank-scrape-{rank}.txt"),
+                  "w") as f:
+            f.write(text)
+        fleet.write_shard(gang_dir, rank, generation)
 
     if out:
         np.savez(out, __losses__=np.asarray(losses, np.float64),

@@ -47,6 +47,12 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     from the CheckpointManager-persisted iterator state with the
     identical remaining batch stream (``--skip-dataplane-drill`` skips
     the subprocess half),
+  * the STRAGGLER drill (phase 10): a supervised 2-worker gang with a
+    seeded ``delay`` fault on rank 1's ``trainer.step`` — the
+    supervisor's single fleet ``/metrics`` scrape must flag rank 1 as a
+    persistent straggler (``mxtpu_gang_straggler_*``) and record the
+    ``gang.straggler`` flight event, while the gang still completes
+    (``--skip-straggler-drill`` for spawn-constrained harnesses),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -259,6 +265,122 @@ def gang_drill(root=None):
     return 0
 
 
+def straggler_drill(root=None):
+    """Phase 10: gang-wide straggler detection, live.
+
+    A supervised 2-worker gang (``launch.py --supervise --metrics-port
+    0``) trains with a seeded ``delay`` fault on rank 1's
+    ``trainer.step``. The drill scrapes the supervisor's ONE fleet
+    endpoint while the gang runs and asserts that within the run the
+    ``mxtpu_gang_straggler_*`` gauges name rank 1 (persistent), and
+    that the ``gang.straggler`` flight event was recorded
+    (``mxtpu_flight_events_total{kind="gang.straggler"}``)."""
+    import re as _re
+    import subprocess
+    import threading
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = os.path.join(repo, "tests", "_gang_child.py")
+    launch = os.path.join(repo, "tools", "launch.py")
+    root = root or tempfile.mkdtemp(prefix="chaos_straggle_")
+    run_dir = os.path.join(root, "run")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "GC_BASE_DEVICES": "1", "GC_TOTAL": "16", "GC_EPOCH": "16",
+           "GC_STEP_SLEEP": "0.05", "GC_STRAGGLE_RANK": "1",
+           "GC_STRAGGLE_MS": "300", "GC_METRICS": "1",
+           "GC_CKPT_DIR": os.path.join(root, "ckpt"),
+           "MXNET_TPU_GANG_BEAT": "0.2"}
+    for k in ("MXNET_TPU_FAULTS", "XLA_FLAGS", "MXTPU_GANG_DIR",
+              "MXTPU_COORDINATOR", "MXTPU_NUM_WORKERS",
+              "MXTPU_WORKER_ID", "MXTPU_GANG_GENERATION"):
+        env.pop(k, None)
+    proc = subprocess.Popen(
+        [sys.executable, launch, "--supervise", "-n", "2",
+         "--run-dir", run_dir, "--max-restarts", "0", "--poll", "0.05",
+         "--metrics-port", "0", sys.executable, child],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    lines = []
+
+    def _pump(stream):
+        for line in stream:
+            lines.append(line)
+
+    threading.Thread(target=_pump, args=(proc.stdout,),
+                     daemon=True).start()
+    stderr_tail = []
+    threading.Thread(target=_pump, args=(proc.stderr,),
+                     daemon=True).start()
+    url = None
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and url is None:
+        for line in list(lines):
+            m = _re.search(r"gang metrics: (http://\S+)/metrics", line)
+            if m:
+                url = m.group(1)
+                break
+        time.sleep(0.1)
+    if url is None:
+        proc.kill()
+        print("FAIL: supervisor never announced its metrics endpoint")
+        return 1
+
+    def metric(text, name, **labels):
+        pat = name + (r"\{" if labels else r"[ {]")
+        for ln in text.splitlines():
+            if not _re.match(pat, ln):
+                continue
+            if all(f'{k}="{v}"' in ln for k, v in labels.items()):
+                return float(ln.rsplit(" ", 1)[1])
+        return None
+
+    seen = None
+    deadline = time.monotonic() + 180.0
+    while time.monotonic() < deadline and proc.poll() is None:
+        try:
+            text = urllib.request.urlopen(url + "/metrics",
+                                          timeout=5).read().decode()
+        except OSError:
+            time.sleep(0.25)
+            continue
+        who = metric(text, "mxtpu_gang_straggler_rank")
+        persistent = metric(text, "mxtpu_gang_straggler_persistent")
+        if who == 1 and persistent == 1:
+            seen = {
+                "rank": 1,
+                "skew_ms": metric(text, "mxtpu_gang_straggler_skew_ms"),
+                "score": metric(text, "mxtpu_gang_straggler_score",
+                                rank="1"),
+                "flight": metric(text, "mxtpu_flight_events_total",
+                                 kind="gang.straggler")}
+            break
+        time.sleep(0.25)
+    try:
+        proc.wait(timeout=120.0)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=10.0)
+    if seen is None:
+        print("FAIL: the supervisor scrape never flagged rank 1 as a "
+              "persistent straggler\nsupervisor stdout:\n"
+              + "".join(lines[-30:]))
+        return 1
+    if not seen["flight"]:
+        print(f"FAIL: straggler flagged but no gang.straggler flight "
+              f"event on the scrape: {seen}")
+        return 1
+    if proc.returncode != 0:
+        print(f"FAIL: straggler gang exited {proc.returncode}")
+        return 1
+    print(f"  straggler drill: fleet scrape named rank 1 "
+          f"(score {seen['score']}, skew {seen['skew_ms']}ms) with a "
+          f"gang.straggler flight event; gang still completed clean")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--epochs", type=int, default=2)
@@ -278,6 +400,10 @@ def main(argv=None):
     parser.add_argument("--skip-dataplane-drill", action="store_true",
                         help="skip the phase-9 SIGKILL-resume subprocess "
                              "half (in-process checks still run)")
+    parser.add_argument("--skip-straggler-drill", action="store_true",
+                        help="skip the phase-10 supervised straggler-"
+                             "detection drill (subprocess gang; same "
+                             "spawn caveat)")
     args = parser.parse_args(argv)
 
     if args.serve_drill:
@@ -757,6 +883,16 @@ def main(argv=None):
         print(f"  SIGKILL at batch {start9} -> resume replayed batches "
               f"{start9 + 1}..{len(ref_np['crcs'])} bit-exact "
               "(augmentation stream included)")
+
+    # phase 10: gang-wide straggler detection — a supervised 2-worker
+    # run with a seeded delay fault on rank 1's trainer.step must show
+    # mxtpu_gang_straggler_* naming rank 1 on the supervisor's ONE
+    # fleet scrape endpoint, with the gang.straggler flight event
+    # recorded (the PR 12 tracing-plane acceptance)
+    if not args.skip_straggler_drill:
+        rc = straggler_drill(root=os.path.join(ckpt_dir, "straggle"))
+        if rc:
+            return rc
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

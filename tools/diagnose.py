@@ -575,6 +575,74 @@ def check_telemetry():
     return out
 
 
+def check_tracing():
+    """Span tracing + fleet aggregation (docs/OBSERVABILITY.md
+    "Tracing"): ring knob, committed-span census, the last merged-trace
+    dump, per-rank telemetry shard ages in the gang run dir, and the
+    current straggler verdict."""
+    _p("---------Tracing---------------")
+    out = {"MXNET_TPU_TRACE": os.environ.get("MXNET_TPU_TRACE"),
+           "MXNET_TPU_STRAGGLER_FACTOR":
+               os.environ.get("MXNET_TPU_STRAGGLER_FACTOR"),
+           "MXNET_TPU_STRAGGLER_PERSIST":
+               os.environ.get("MXNET_TPU_STRAGGLER_PERSIST")}
+    _p(f"MXNET_TPU_TRACE={out['MXNET_TPU_TRACE'] or '<unset>'}  "
+       "(span-ring size; default 2048, 0 disables tracing)")
+    _p(f"MXNET_TPU_STRAGGLER_FACTOR="
+       f"{out['MXNET_TPU_STRAGGLER_FACTOR'] or '<unset>'}  "
+       "(slowest-rank score threshold; default 1.5)")
+    _p(f"MXNET_TPU_STRAGGLER_PERSIST="
+       f"{out['MXNET_TPU_STRAGGLER_PERSIST'] or '<unset>'}  "
+       "(consecutive flagged steps before 'persistent'; default 3)")
+    try:
+        from mxnet_tpu.telemetry import fleet, trace
+
+        desc = trace.describe()
+        out["effective"] = desc
+        _p(f"span ring     : {desc['ring']} "
+           f"({'on' if desc['enabled'] else 'OFF'}), "
+           f"{desc['retained']} retained")
+        _p(f"span counts   : {desc['spans'] or '(none committed)'}")
+        out["last_merged_trace"] = desc["last_dump"]
+        _p("last trace    :",
+           desc["last_dump"]
+           or "(none dumped — run tools/traceview.py)")
+        fdesc = fleet.describe()
+        out["fleet"] = fdesc
+        run_dir = fdesc["installed_dir"] \
+            or os.environ.get("MXTPU_GANG_DIR") \
+            or os.environ.get("MXNET_TPU_GANG_DIR")
+        out["run_dir"] = run_dir
+        if run_dir:
+            ages = fleet.shard_ages(run_dir)
+            out["shard_ages"] = ages
+            if ages:
+                for rank in sorted(ages):
+                    _p(f"rank {rank} shard  : {ages[rank]}s old")
+            else:
+                _p(f"rank shards   : none readable in {run_dir}")
+        else:
+            _p("rank shards   : <no gang run dir>")
+        v = fdesc["verdict"]
+        out["straggler"] = v
+        if v is None:
+            _p("straggler     : no verdict computed in this process")
+        elif v.get("status") != "ok":
+            _p(f"straggler     : {v.get('status')} "
+               f"(ranks {v.get('ranks')})")
+        else:
+            who = v["slowest_rank"]
+            _p(f"straggler     : "
+               f"{'rank %s' % who if who is not None else 'none'} "
+               f"(score {v['score']}, skew {v['skew_ms']}ms, "
+               f"{'PERSISTENT' if v['persistent'] else 'streak %d' % v['streak']}"
+               f" @ step {v['last_common_step']})")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("telemetry import failed:", e)
+    return out
+
+
 SECTIONS = (
     ("python", check_python),
     ("pip", check_pip),
@@ -590,6 +658,7 @@ SECTIONS = (
     ("gang", check_gang),
     ("dataplane", check_dataplane),
     ("telemetry", check_telemetry),
+    ("tracing", check_tracing),
 )
 
 

@@ -213,7 +213,11 @@ def main(argv=None):
                         "onto the smaller mesh (MXNET_TPU_GANG_SHRINK)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="[supervise] expose the supervisor's /metrics "
-                        "(mxtpu_gang_*) on this port (0 = pick free)")
+                        "on this port (0 = pick free): mxtpu_gang_* "
+                        "supervision series plus the FLEET aggregation "
+                        "(mxtpu_fleet_* rank-shard sums, "
+                        "mxtpu_gang_straggler_* skew verdict) — one "
+                        "scrape for the whole gang")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command to launch")
     args = p.parse_args(argv)
@@ -240,7 +244,11 @@ def main(argv=None):
             from mxnet_tpu.telemetry.export import MetricsServer
 
             server = MetricsServer(port=args.metrics_port).start()
-            print(f"gang metrics: {server.url}/metrics", flush=True)
+            # the supervisor installed the fleet collector at
+            # construction: this one endpoint serves mxtpu_gang_* AND
+            # the merged per-rank mxtpu_fleet_* / straggler series
+            print(f"gang metrics: {server.url}/metrics "
+                  f"(fleet aggregation over {sup.run_dir})", flush=True)
         try:
             return sup.run()
         finally:

@@ -8,7 +8,11 @@ running HTTP front end), drives it for a fixed duration, and reports
 sustained requests/s, client-side p50/p95/p99 latency, admission
 rejects, the server's batch fill ratio — and whether ANY recompile
 happened during the run (after warmup the compile service must show
-only cache hits).
+only cache hits). When span tracing is on (the default), the report
+also carries ``phase_breakdown``: p50/p99/mean per request phase
+(queue_wait / batch_collect / h2d / compute / respond) from the serving
+span tracer — cross-checked against ``serving.stats()`` percentiles in
+the test suite.
 
 Modes
 -----
@@ -77,6 +81,50 @@ def _percentiles(lats):
             for q, k in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms"))}
 
 
+_PHASES = ("queue_wait", "batch_collect", "h2d", "compute", "respond",
+           "total")
+_PHASE_CAP = 200000  # bound the per-phase sample memory on long runs
+
+
+class _PhaseAgg:
+    """Collects per-request phase breakdowns (from the serving span
+    tracer) and reduces them to p50/p99/mean per phase. Accepts both
+    the in-process ``ServingFuture.breakdown()`` shape (``<phase>_ms``
+    keys) and the HTTP response ``phases`` object (bare phase keys)."""
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.samples = {k: [] for k in _PHASES}
+        self.traced = 0
+
+    def record(self, bd):
+        if not bd:
+            return
+        with self._lock:
+            self.traced += 1
+            for k in _PHASES:
+                v = bd.get("total_ms") if k == "total" \
+                    else bd.get(f"{k}_ms", bd.get(k))
+                if isinstance(v, (int, float)) \
+                        and len(self.samples[k]) < _PHASE_CAP:
+                    self.samples[k].append(float(v))
+
+    def report(self):
+        from mxnet_tpu.serving.metrics import percentile
+
+        out = {}
+        with self._lock:
+            for k, vals in self.samples.items():
+                if not vals:
+                    continue
+                vals = sorted(vals)
+                out[k] = {"p50_ms": round(percentile(vals, 50), 3),
+                          "p99_ms": round(percentile(vals, 99), 3),
+                          "mean_ms": round(sum(vals) / len(vals), 3),
+                          "n": len(vals)}
+        return out or None
+
+
 # -------------------------------------------------------------- in-process --
 
 def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
@@ -112,15 +160,18 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                 f"{front.url}/v1/models/{name}:predict", data=body,
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=10.0) as resp:
-                json.loads(resp.read())
+                return json.loads(resp.read()).get("phases")
     else:
         def do_request(name, x):
-            server.predict(name, x, timeout=10.0)
+            fut = server.submit(name, x)
+            fut.result(10.0)
+            return fut.breakdown()
 
     pool = [np.random.RandomState(i).randn(1, dim).astype(np.float32)
             for i in range(64)]
     lock = threading.Lock()
     lats, completed, rejected, errors = [], [0], [0], []
+    phases = _PhaseAgg(lock)
     stop_at = time.perf_counter() + duration
 
     def record(ms):
@@ -135,8 +186,9 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
             x = pool[(tid * 7 + i) % len(pool)]
             t0 = time.perf_counter()
             try:
-                do_request(name, x)
+                bd = do_request(name, x)
                 record((time.perf_counter() - t0) * 1e3)
+                phases.record(bd)
             except serving.ServerBusyError:
                 with lock:
                     rejected[0] += 1
@@ -167,6 +219,7 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                 try:
                     fut.result(10.0)
                     record((time.perf_counter() - t0) * 1e3)
+                    phases.record(fut.breakdown())
                 except serving.ServerBusyError:
                     with lock:
                         rejected[0] += 1
@@ -235,6 +288,11 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
         if fills else None,
         "recompiles_during_run": post.get("misses", 0) - pre_misses,
         "server_stats": stats["models"],
+        # per-phase latency split from the serving span tracer
+        # (queue_wait/batch_collect/h2d/compute/respond; None when
+        # tracing is off) — the "where did my p99 go" answer
+        "phase_breakdown": phases.report(),
+        "traced_requests": phases.traced,
     }
     report.update(_percentiles(sorted(lats)))
     if front is not None:
@@ -260,6 +318,7 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
             for i in range(64)]
     lock = threading.Lock()
     lats, completed, rejected, errors = [], [0], [0], []
+    phases = _PhaseAgg(lock)
     stop_at = time.perf_counter() + duration
 
     def worker(tid):
@@ -274,10 +333,11 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
             t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(req, timeout=10.0) as resp:
-                    json.loads(resp.read())
+                    payload = json.loads(resp.read())
                 with lock:
                     lats.append((time.perf_counter() - t0) * 1e3)
                     completed[0] += 1
+                phases.record(payload.get("phases"))
             except urllib.error.HTTPError as e:
                 with lock:
                     if e.code in (429, 503):
@@ -303,6 +363,8 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
         "concurrency": concurrency, "completed": completed[0],
         "rejected": rejected[0], "errors": len(errors),
         "rps": round(completed[0] / elapsed, 1) if elapsed else 0.0,
+        "phase_breakdown": phases.report(),
+        "traced_requests": phases.traced,
     }
     report.update(_percentiles(sorted(lats)))
     return report

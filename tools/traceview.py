@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""traceview — merge a gang run's telemetry into one Perfetto trace.
+
+Folds every rank's telemetry shard (span tails, flight-recorder tails —
+written next to the PR 10 heartbeat files by each worker) into a single
+Chrome-trace/Perfetto ``trace.json`` with one lane (pid) per rank,
+clocks aligned via the shards' heartbeat (t_wall, t_mono) pairs. Open
+the result at https://ui.perfetto.dev or ``chrome://tracing``.
+
+    python tools/traceview.py --run-dir /path/to/gang/run
+    python tools/traceview.py --run-dir RUN -o merged.json --summary
+    python tools/traceview.py -o local.json          # this process only
+
+With no ``--run-dir`` the dump covers the calling process (spans +
+flight tail + any recorded profiler events) — the single-process
+equivalent of the old ``mx.profiler.dump()`` chrome trace, on the span
+timeline. ``--summary`` prints a per-rank census (span/flight counts,
+serving requests, trainer steps, shard age) so you can sanity-check a
+run dir before shipping the trace anywhere. Torn or partial rank shards
+are skipped, never merged half-written.
+
+See docs/OBSERVABILITY.md "Tracing" for the span model and the shard
+file format.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def summarize(run_dir):
+    """Per-rank shard census as a list of dicts (also printed by
+    --summary)."""
+    from mxnet_tpu.telemetry import fleet
+
+    rows = []
+    now = time.time()
+    for rank, sh in sorted(fleet.read_shards(run_dir).items()):
+        spans = sh.get("spans") or []
+        kinds = {}
+        for s in spans:
+            kinds[s.get("kind")] = kinds.get(s.get("kind"), 0) + 1
+        rows.append({
+            "rank": rank,
+            "generation": sh.get("generation"),
+            "pid": sh.get("pid"),
+            "age_s": round(now - float(sh["t_wall"]), 1),
+            "spans": len(spans),
+            "span_kinds": kinds,
+            "steps": len(sh.get("steps") or []),
+            "flight_events": len(sh.get("flight") or []),
+            "metrics_port": sh.get("metrics_port"),
+        })
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="traceview",
+        description="merge gang telemetry shards into one Perfetto "
+                    "trace.json")
+    ap.add_argument("--run-dir", default=None,
+                    help="gang run dir holding telemetry-rank-<r>.json "
+                         "shards (default: MXTPU_GANG_DIR / "
+                         "MXNET_TPU_GANG_DIR; omit both for a "
+                         "this-process-only dump)")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="output path (default trace.json)")
+    ap.add_argument("--summary", action="store_true",
+                    help="also print a per-rank shard census")
+    args = ap.parse_args(argv)
+
+    run_dir = args.run_dir or os.environ.get("MXTPU_GANG_DIR") \
+        or os.environ.get("MXNET_TPU_GANG_DIR")
+    from mxnet_tpu.telemetry import trace
+
+    if run_dir and not os.path.isdir(run_dir):
+        print(f"traceview: run dir {run_dir!r} does not exist",
+              file=sys.stderr)
+        return 1
+    path = trace.dump(args.out, run_dir=run_dir)
+    with open(path) as f:
+        n = len(json.load(f)["traceEvents"])
+    if run_dir and args.summary:
+        for row in summarize(run_dir):
+            print(f"rank {row['rank']}: gen {row['generation']} "
+                  f"pid {row['pid']} shard {row['age_s']}s old — "
+                  f"{row['spans']} spans {row['span_kinds']}, "
+                  f"{row['steps']} step records, "
+                  f"{row['flight_events']} flight events")
+    src = f"{len(summarize(run_dir))} rank shard(s) in {run_dir}" \
+        if run_dir else "this process"
+    print(f"traceview: wrote {n} events from {src} -> {path}")
+    print("open it at https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
