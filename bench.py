@@ -87,6 +87,23 @@ def _mfu_xla_fields(line, site, calls_per_sec, devices=1):
     return line
 
 
+def _gradcomms_fields(line, steps=None):
+    """Fold the gradient-comms trajectory into a train line:
+    ``sync_ms_mean`` (the step timeline's sync phase over the timed
+    steps — the serialized collective tail) and ``overlap_ratio`` (the
+    bucket pipeline's 1 - blocked/in-flight; null single-host, where no
+    cross-host reduction runs)."""
+    from mxnet_tpu.kvstore import buckets as _kvbuckets
+    from mxnet_tpu.telemetry import steps as _tsteps
+
+    hist = _tsteps.history(steps)
+    syncs = [r["phases"].get("sync", 0.0) for r in hist]
+    line["sync_ms_mean"] = round(sum(syncs) / len(syncs), 3) \
+        if syncs else None
+    line["overlap_ratio"] = _kvbuckets.comm_stats()["overlap_ratio"]
+    return line
+
+
 def main(argv=None):
     import argparse
 
@@ -265,6 +282,7 @@ def bench_train(ctx, batch, dtype, iters, model):
             line["mfu_vs_measured"] = round(achieved / measured, 3)
     _mfu_xla_fields(line, "trainer", iters * 1.0 / elapsed,
                     devices=trainer.mesh.num_devices)
+    _gradcomms_fields(line, steps=iters)
     print(json.dumps(_compile_fields(line)), flush=True)
 
 
@@ -315,6 +333,7 @@ def bench_train_cpu():
         "platform": "cpu",
     }
     _mfu_xla_fields(line, "trainer", iters / elapsed)
+    _gradcomms_fields(line, steps=iters)
     print(json.dumps(_compile_fields(line)), flush=True)
 
 

@@ -120,6 +120,42 @@ def apply_platform_env():
         pass  # backend already initialised — keep its platform
 
 
+def maybe_enable_latency_hiding():
+    """Arm XLA's latency-hiding scheduler on non-CPU backends — it
+    reorders compiled programs so collectives (the reduce-scatter /
+    all-gather pairs the grad-overlap path emits) run concurrently with
+    compute instead of serializing after backward.
+
+    ``XLA_FLAGS`` is read once at backend spin-up, so this must run
+    before any backend touch (``mxnet_tpu/__init__`` calls it next to
+    the platform pin). Applied only when the target platform is
+    *known* to be tpu/gpu from the env (an ``--xla_tpu_*`` flag is an
+    unknown-flag error on other backends); a user-provided
+    latency-hiding setting in ``XLA_FLAGS`` always wins.
+    ``MXNET_TPU_LHS=0`` opts out. Returns True when a flag was (or
+    already is) in effect."""
+    import os
+
+    if os.environ.get("MXNET_TPU_LHS", "1") == "0":
+        return False
+    plat = (os.environ.get("MXTPU_PLATFORM")
+            or os.environ.get("JAX_PLATFORMS", ""))
+    plat = plat.split(",")[0].strip().lower()
+    flag = {
+        "tpu": "--xla_tpu_enable_latency_hiding_scheduler=true",
+        "gpu": "--xla_gpu_enable_latency_hiding_scheduler=true",
+        "cuda": "--xla_gpu_enable_latency_hiding_scheduler=true",
+        "rocm": "--xla_gpu_enable_latency_hiding_scheduler=true",
+    }.get(plat)
+    if flag is None:
+        return False
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "latency_hiding_scheduler" in flags:
+        return True  # the user already decided
+    os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    return True
+
+
 def ensure_live_backend(timeout_s=90, retries=1, reprobe=False):
     """Probe the default JAX backend in a subprocess under a deadline,
     pinning the CPU platform if (and only if) the probe HANGS.

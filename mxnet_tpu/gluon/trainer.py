@@ -121,10 +121,17 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.grad())
-                self._kvstore.pull(i, param.grad())
+        # all pushes FIRST, in backward order with the reference's
+        # priority=-index contract (trainer.py:349) — the dist kvstore's
+        # bucket pipeline then has every fused reduction in flight while
+        # later pushes still stage — and only then the pulls, which
+        # resolve the futures (one blocking allreduce per key otherwise)
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        for i, param in reversed(live):
+            self._kvstore.push(i, param.grad(), priority=-i)
+        for i, param in live:
+            self._kvstore.pull(i, param.grad(), priority=-i)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -1,5 +1,7 @@
 """KVStore (parity: python/mxnet/kvstore/ + src/kvstore/)."""
+from . import buckets
 from .base import KVStoreBase
 from .kvstore import KVStore, PeerLostError, create
 
-__all__ = ["KVStore", "KVStoreBase", "PeerLostError", "create"]
+__all__ = ["KVStore", "KVStoreBase", "PeerLostError", "buckets",
+           "create"]

@@ -41,7 +41,7 @@ __all__ = ["KVStore", "PeerLostError", "create", "OP_COUNTS"]
 # bumps so the per-push cost is nil; collectives additionally land in
 # the flight recorder via their watchdog 'kvstore.sync' spans
 OP_COUNTS = {"init": 0, "push": 0, "pull": 0, "barrier": 0,
-             "allreduce": 0}
+             "allreduce": 0, "fused": 0}
 
 
 class PeerLostError(StallError):
@@ -64,19 +64,24 @@ class PeerLostError(StallError):
 
     exit_code = PEERLOST_EXIT_CODE
 
-    def __init__(self, op, rank, num_workers, stall):
+    def __init__(self, op, rank, num_workers, stall, census=None):
         super().__init__(stall.point, stall.label, stall.elapsed,
                          stall.deadline, stall.bundle)
         self.op = op
         self.rank = rank
         self.num_workers = num_workers
+        #: bucket-pipeline census at the moment of loss (op
+        #: 'bucket_reduce' — which fused collectives were in flight);
+        #: the same census rides in the crash bundle's report.json
+        self.census = census
         _flight.rec("gang.peer_lost", stall.point,
                     f"{op} rank {rank}/{num_workers}")
         self.args = (
             f"kvstore {op!r}: peer lost — rank {rank}/{num_workers} "
             f"waited {stall.elapsed:.1f}s (deadline {stall.deadline:g}s) "
             "for the group; a peer process is presumed dead or wedged"
-            + (f"; crash bundle: {stall.bundle}" if stall.bundle else ""),)
+            + (f"; crash bundle: {stall.bundle}" if stall.bundle else "")
+            + (f"; bucket census: {census}" if census else ""),)
 
 
 def _to_list(x):
@@ -326,6 +331,15 @@ class _DistKVStore(KVStore):
 
         self._sched = _distcheck.ScheduleRecorder() \
             if _distcheck.enabled() else None
+        # bucketed async gradient reduction (docs/PERFORMANCE.md):
+        # pushes stage into size-capped buckets, each reduced as ONE
+        # fused async collective resolved at pull/barrier; bucket cap 0
+        # restores the legacy per-key path exactly
+        from . import buckets as _buckets
+
+        cap = _buckets.bucket_bytes()
+        self._pipeline = _buckets.BucketPipeline(self, cap) if cap > 0 \
+            else None
 
     @property
     def rank(self):
@@ -335,7 +349,44 @@ class _DistKVStore(KVStore):
     def num_workers(self):
         return self._procs
 
+    def init(self, key, value):
+        super().init(key, value)
+        if self._pipeline is None:
+            return
+        from ..ndarray.sparse import RowSparseNDArray
+
+        keys, _ = self._canonical(key, value)
+        for k in keys:
+            stored = self._store[k]
+            if isinstance(stored, RowSparseNDArray):
+                continue  # sparse traffic keeps the row-union path
+            self._pipeline.register(k, tuple(stored.shape),
+                                    str(stored._data.dtype))
+
+    def _bucketed(self, agg):
+        """True when this push rides the bucket pipeline (dense,
+        registered, and there is actually a group to reduce over — or
+        the force knob engages the full path single-process)."""
+        if self._pipeline is None:
+            return False
+        from . import buckets as _buckets
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(agg, RowSparseNDArray):
+            return False
+        return self._procs > 1 or _buckets.bucket_force()
+
     def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store across all workers.
+
+        ``priority`` keeps the MXNet contract (higher reduces earlier):
+        the bucket pipeline realizes it structurally — assembly is keyed
+        on registration order and a bucket dispatches the moment its
+        last member is pushed, so pushing in backward order (what gluon
+        ``Trainer`` does, mirroring the reference's ``priority=-index``)
+        reduces last-layer buckets first while earlier layers are still
+        computing. The argument itself is accepted for API parity.
+        """
         from .. import faults as _faults
         from .. import watchdog as _watchdog
 
@@ -346,6 +397,20 @@ class _DistKVStore(KVStore):
             agg = vals[0]
             for v in vals[1:]:
                 agg = self._merge(agg, v)
+            if self._bucketed(agg) and self._pipeline.wants(k):
+                gather = self._type == "dist_async" \
+                    and self._updater is not None
+                # compression applies to CROSS-HOST traffic only (the
+                # 1-proc force seam stages raw, like the legacy path)
+                if self._compression and not gather and self._procs > 1:
+                    codes, meta = self._quantize(k, agg)
+                    self._pipeline.enqueue(k, codes.reshape(-1), meta)
+                else:
+                    self._pipeline.enqueue(
+                        k, agg._data.reshape(-1),
+                        {"shape": tuple(agg.shape),
+                         "dtype": str(agg._data.dtype)})
+                continue
             if self._sched is not None:
                 # the static collective schedule this rank is committing
                 # to: op kind + key + payload signature (divergent key
@@ -383,14 +448,157 @@ class _DistKVStore(KVStore):
         mean N updates, not one update on the summed gradient. The updates
         are applied in rank order on every worker, which keeps replicas
         bit-identical while preserving the async statistical semantics
-        (the reference's server applies them in arrival order instead)."""
+        (the reference's server applies them in arrival order instead).
+
+        This is the legacy (unbucketed) path — one blocking
+        ``process_allgather`` per key, O(N·size) on the wire. With
+        bucketing enabled the same gather rides ONE fused bucket
+        collective instead (``_dispatch_bucket`` mode ``gather``)."""
+        import time as _time
+
         from ..ndarray import NDArray
         from jax.experimental.multihost_utils import process_allgather
+        from ..telemetry import steps as _tsteps
 
+        t0 = _time.monotonic()
         gathered = process_allgather(agg._data)  # (procs, ...) per-worker
+        _tsteps.phase("sync", (_time.monotonic() - t0) * 1e3)
         idx = self._key_index(k)
         for r in range(self._procs):
             self._updater(idx, NDArray(gathered[r]), self._store[k])
+
+    # ------------------------------------------------- bucket pipeline ----
+    def _bucket_mode(self):
+        """The fused-collective flavour for a dispatching bucket:
+        ``gather`` for dist_async optimizer-on-store (every worker's
+        payload applied separately), ``sum`` otherwise (2-bit codes sum
+        exactly like raw grads — they concatenate trivially and rescale
+        per key at resolve)."""
+        if self._type == "dist_async" and self._updater is not None:
+            return "gather"
+        return "sum"
+
+    def _note_bucket(self, mode, sig):
+        """Collective-order fingerprint entry for one fused dispatch —
+        rank-identical because bucket assembly is keyed on registration
+        order (distcheck pass 2 cross-checks at the next barrier)."""
+        if self._sched is not None:
+            self._sched.note("allgather" if mode == "gather"
+                             else "allreduce", sig)
+
+    def _dispatch_bucket(self, raw, mode):
+        """Asynchronously dispatch ONE fused cross-host collective over
+        a flattened bucket payload and return the (unresolved) future
+        array — the caller resolves it later under the ``kvstore.sync``
+        watchdog point. Nothing here blocks the host; that is the whole
+        point."""
+        OP_COUNTS["fused"] += 1
+        if mode == "gather":
+            return self._dispatch_gather(raw)
+        OP_COUNTS["allreduce"] += 1
+        return self._dispatch_sum(raw)
+
+    def _dispatch_sum(self, raw):
+        """Async fused cross-host sum (the bucketed twin of
+        ``_cross_host_sum`` — same mesh, same reduction, no host
+        block)."""
+        import jax.numpy as jnp
+
+        try:
+            from jax.experimental import multihost_utils
+            from jax.sharding import PartitionSpec
+
+            mesh = self._proc_mesh()
+            stacked = multihost_utils.host_local_array_to_global_array(
+                raw[None], mesh, PartitionSpec("proc"))  # noqa: partition-spec-literal — the deliberate per-PROCESS reduction axis (baselined for the legacy path)
+            summed = self._sum_exe(mesh)(stacked)
+            return multihost_utils.global_array_to_host_local_array(
+                summed, mesh, PartitionSpec())
+        except (ValueError, RuntimeError, TypeError):
+            # fallback: allgather + local sum (blocking, still correct)
+            from jax.experimental.multihost_utils import process_allgather
+
+            return jnp.sum(jnp.asarray(process_allgather(raw)), axis=0)
+
+    def _gather_exe(self, mesh):
+        """Cached compiled cross-process allgather (identity with a
+        replicated output layout), through the unified compile service."""
+        exe = getattr(self, "_gather_exe_cache", None)
+        if exe is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from .. import compile as _compile
+
+            exe = _compile.jit(
+                lambda a: a, site="kvstore",
+                token=("kvstore", "bucket_gather", f"p{self._procs}"),
+                out_shardings=NamedSharding(mesh, PartitionSpec()))
+            self._gather_exe_cache = exe
+        return exe
+
+    def _dispatch_gather(self, raw):
+        """Async fused allgather: returns a ``(procs, total)`` future so
+        N workers' dist_async updates ride ONE gathered bucket instead
+        of one blocking ``process_allgather`` per key."""
+        import jax.numpy as jnp
+
+        try:
+            from jax.experimental import multihost_utils
+            from jax.sharding import PartitionSpec
+
+            mesh = self._proc_mesh()
+            stacked = multihost_utils.host_local_array_to_global_array(
+                raw[None], mesh, PartitionSpec("proc"))  # noqa: partition-spec-literal — the deliberate per-PROCESS reduction axis (baselined for the legacy path)
+            gathered = self._gather_exe(mesh)(stacked)
+            return multihost_utils.global_array_to_host_local_array(
+                gathered, mesh, PartitionSpec())
+        except (ValueError, RuntimeError, TypeError):
+            from jax.experimental.multihost_utils import process_allgather
+
+            return jnp.asarray(process_allgather(raw))
+
+    def _apply_reduced(self, k, piece, mode, meta):
+        """Scatter one key's slice of a resolved bucket back into the
+        store — the same per-key apply the legacy path runs, so the
+        bucketed pipeline is numerically bit-identical to it."""
+        from ..ndarray import NDArray
+
+        shape = meta["shape"]
+        if mode == "gather":
+            idx = self._key_index(k)
+            for r in range(self._procs):
+                self._updater(idx, NDArray(piece[r].reshape(shape)),
+                              self._store[k])
+            return
+        if meta.get("thr") is not None:
+            # summed 2-bit codes rescale to the original dtype
+            agg = NDArray(piece.reshape(shape).astype(meta["dtype"])
+                          * meta["thr"])
+        else:
+            agg = NDArray(piece.reshape(shape))
+        if self._updater is not None:
+            self._updater(self._key_index(k), agg, self._store[k])
+        else:
+            self._pending_setdefault(k)
+            self._pending[k] = agg if self._pending[k] is None \
+                else self._merge(self._pending[k], agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Resolve any in-flight bucket reductions covering `key`
+        first (futures resolve here, at barrier, or at optimizer
+        apply), then the normal pull."""
+        if self._pipeline is not None:
+            for k in _to_list(key):
+                self._pipeline.resolve(k)
+        super().pull(key, out=out, priority=priority,
+                     ignore_sparse=ignore_sparse)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if self._pipeline is not None:
+            for k in _to_list(key):
+                self._pipeline.resolve(k)
+        super().row_sparse_pull(key, out=out, priority=priority,
+                                row_ids=row_ids)
 
     def _proc_mesh(self):
         """One-device-per-process mesh (cached): the reduction axis spans
@@ -433,8 +641,11 @@ class _DistKVStore(KVStore):
         ``kvstore.sync`` watchdog point, so a dead peer surfaces as a
         structured :class:`PeerLostError` (crash bundle attached) instead
         of wedging this worker forever."""
+        import time as _time
+
         from .. import faults as _faults
         from .. import watchdog as _watchdog
+        from ..telemetry import steps as _tsteps
 
         OP_COUNTS["allreduce"] += 1
 
@@ -462,6 +673,7 @@ class _DistKVStore(KVStore):
                 gathered = process_allgather(raw)
                 return NDArray(jnp.sum(gathered, axis=0))
 
+        t0 = _time.monotonic()
         try:
             return _watchdog.sync(
                 "kvstore.sync", _reduce,
@@ -469,14 +681,21 @@ class _DistKVStore(KVStore):
         except StallError as e:
             raise PeerLostError("cross_host_sum", self._rank, self._procs,
                                 e) from e
+        finally:
+            # the per-key host cost of the serialized legacy path lands
+            # in the step timeline's 'sync' phase (the bucketed pipeline
+            # records only its blocked resolve tail there instead)
+            _tsteps.phase("sync", (_time.monotonic() - t0) * 1e3)
 
-    def _compressed_cross_host_sum(self, key, value):
-        """2-bit gradient compression with error feedback (parity:
+    def _quantize(self, key, value):
+        """2-bit quantization with error feedback (parity:
         `src/kvstore/gradient_compression.h:38-134` / .cc Quantize2Bit):
-        each worker quantizes grad+residual to {-1, 0, +1} (int8 on the
-        wire — 4x fewer bytes than f32), keeps the quantization error as
-        the next step's residual, and the summed codes are rescaled by
-        the threshold after the all-reduce."""
+        grad+residual quantizes to {-1, 0, +1} (int8 on the wire — 4x
+        fewer bytes than f32) and the quantization error carries into
+        the next step's residual. Returns ``(codes, meta)`` — the
+        resolve-side rescale needs the threshold and original dtype.
+        Shared by the legacy per-key path and bucket fusion (codes
+        concatenate trivially and sum exactly like raw grads)."""
         import jax.numpy as jnp
 
         thr = float(self._compression.get("threshold", 0.5))
@@ -486,8 +705,16 @@ class _DistKVStore(KVStore):
         codes = jnp.where(g >= thr, jnp.int8(1),
                           jnp.where(g <= -thr, jnp.int8(-1), jnp.int8(0)))
         self._residuals[key] = g - codes.astype(g.dtype) * thr
+        return codes, {"shape": tuple(raw.shape),
+                       "dtype": str(raw.dtype), "thr": thr}
+
+    def _compressed_cross_host_sum(self, key, value):
+        """Legacy per-key compressed reduction: quantize, ONE all-reduce
+        of the codes, rescale by the threshold (bucketing fuses the same
+        codes across keys instead)."""
+        codes, meta = self._quantize(key, value)
         summed = self._cross_host_sum(NDArray(codes))._data
-        return NDArray(summed.astype(raw.dtype) * thr)
+        return NDArray(summed.astype(meta["dtype"]) * meta["thr"])
 
     def barrier(self):
         """Cross-host rendezvous, deadline-bounded via the
@@ -507,6 +734,12 @@ class _DistKVStore(KVStore):
         from .. import watchdog as _watchdog
 
         OP_COUNTS["barrier"] += 1
+        if self._pipeline is not None:
+            # flush: dispatch every still-staged bucket (descending
+            # registration order) and resolve all in-flight futures —
+            # the barrier is a resolution point, and the fingerprints
+            # compared below must include every issued collective
+            self._pipeline.resolve(None)
         if self._sched is not None:
             if self._procs > 1:
                 from ..analysis import distcheck as _distcheck

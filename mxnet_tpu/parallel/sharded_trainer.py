@@ -111,6 +111,18 @@ class ShardedTrainer:
             raise ValueError("accum_steps must be >= 1")
         self._nan_guard = bool(nan_guard)
         self._max_consecutive_skips = int(max_consecutive_skips)
+        # multi-host dp gradient overlap: pin each gradient to a
+        # dp-sharded layout (the ZeRO state layout) so XLA materializes
+        # the cross-host grad sum as reduce-scatter + all-gather — which
+        # the latency-hiding scheduler can overlap with backward — not
+        # one monolithic all-reduce at the end of backward. Numerics
+        # match up to XLA reduction order. MXNET_TPU_GRAD_SCATTER=0
+        # opts out; ZeRO already implies the same layout.
+        import os as _os
+
+        self._grad_scatter = (
+            self._multiprocess and self._mesh.size("dp") > 1
+            and _os.environ.get("MXNET_TPU_GRAD_SCATTER", "1") != "0")
         self.skipped_steps = 0       # total updates skipped by the guard
         self.consecutive_skips = 0   # current skip streak
         opt_params = dict(optimizer_params or {})
@@ -275,6 +287,20 @@ class ShardedTrainer:
     def _spec_for(self, name):
         return self._mesh.sharding(*self._rules.get(name, ()))
 
+    def _dp_sharded_full(self, spec, shape):
+        """`spec` additionally dp-sharded on the first divisible
+        unsharded dim (no divisible dim: unchanged, the constraint is a
+        no-op) — the ZeRO-1 state layout AND the grad reduce-scatter
+        layout."""
+        dp = self._mesh.size("dp")
+        full = spec + (None,) * (len(shape) - len(spec))
+        if dp > 1 and "dp" not in full:
+            for i, (s, d) in enumerate(zip(full, shape)):
+                if s is None and d % dp == 0:
+                    full = full[:i] + ("dp",) + full[i + 1:]
+                    break
+        return full
+
     def _state_spec_for(self, name, shape):
         """Optimizer-state layout: the parameter's own spec, or — under
         ZeRO — additionally dp-sharded on the first divisible unsharded
@@ -284,14 +310,15 @@ class ShardedTrainer:
         spec = tuple(self._rules.get(name, ()))[:len(shape)]
         if not self._zero:
             return self._mesh.sharding(*spec)
-        dp = self._mesh.size("dp")
-        full = spec + (None,) * (len(shape) - len(spec))
-        if dp > 1 and "dp" not in full:
-            for i, (s, d) in enumerate(zip(full, shape)):
-                if s is None and d % dp == 0:
-                    full = full[:i] + ("dp",) + full[i + 1:]
-                    break
-        return self._mesh.sharding(*full)
+        return self._mesh.sharding(*self._dp_sharded_full(spec, shape))
+
+    def _grad_spec_for(self, name, shape):
+        """Gradient reduce-scatter layout (``_grad_scatter``): dp-shard
+        the gradient like ZeRO shards state, so the cross-host grad sum
+        lowers to reduce-scatter + all-gather instead of one blocking
+        all-reduce."""
+        spec = tuple(self._rules.get(name, ()))[:len(shape)]
+        return self._mesh.sharding(*self._dp_sharded_full(spec, shape))
 
     def _place_params(self):
         """Lay parameters out on the mesh per the rules (replicate or
@@ -347,7 +374,7 @@ class ShardedTrainer:
             repr(sorted(self._rules.items())),
             repr(self._mesh.describe()),
             repr((self._donate, self._zero, self._remat, self._accum,
-                  self._nan_guard))])
+                  self._nan_guard, self._grad_scatter))])
         return ("trainer", kind,
                 hashlib.sha1(blob.encode()).hexdigest()[:16])
 
@@ -371,6 +398,47 @@ class ShardedTrainer:
         from .. import compile as _compile
 
         return _compile.warmup()
+
+    def aot_lower(self, x, y):
+        """AOT-lower the full train step under GSPMD for batches shaped
+        like ``x``/``y`` WITHOUT executing it (and without consuming the
+        RNG stream) — the compile-cleanliness proof for a training
+        config before hardware is available (``__graft_entry__``'s
+        multichip dryrun lowers the flagship dp×tp+ZeRO+remat config
+        through this). Returns the jax ``Lowered``; ``.compile()``
+        finishes the XLA pipeline and its HLO text feeds
+        ``analysis.distcheck.schedule_from_hlo`` for the collective
+        census."""
+        import jax
+
+        from .. import random as _rand
+
+        x_raw = x._data if isinstance(x, NDArray) else x
+        y_raw = y._data if isinstance(y, NDArray) else y
+        if self._step_fn is None:
+            if self._distcheck:
+                from ..analysis import distcheck as _dc
+
+                _dc.check_trainer(self, x_raw, y_raw)
+            self._step_fn = self._build(x_raw, y_raw)
+        _rand._ensure()
+        key = _rand._state.key  # aval only; the stream does not advance
+
+        def aval(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        import jax.numpy as jnp
+
+        return self._step_fn.lower(
+            tuple(aval(h._data) for h in self._train_handles),
+            tuple(tuple(aval(s) for s in per) for per in self._opt_raws),
+            tuple(aval(h._data) for h in self._aux_handles),
+            jax.ShapeDtypeStruct(tuple(x_raw.shape),
+                                 _np.dtype(x_raw.dtype)),
+            jax.ShapeDtypeStruct(tuple(y_raw.shape),
+                                 _np.dtype(y_raw.dtype)),
+            aval(key), jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32))
 
     def _build(self, x_raw, y_raw):
         import jax
@@ -460,6 +528,10 @@ class ShardedTrainer:
             return (loss_sum / accum, new_aux), grads
 
         nan_guard = self._nan_guard
+        grad_scatter = self._grad_scatter
+        grad_sh = [self._grad_spec_for(n, h._data.shape)
+                   for n, h in zip(self._param_names, train_handles)] \
+            if grad_scatter else None
 
         def step_fn(praws, opt_raws, araws, x, y, rng, t, lr):
             (loss, new_aux), grads = grads_of(praws, araws, x, y, rng)
@@ -481,6 +553,12 @@ class ShardedTrainer:
                     # the dp-sharded state layout; XLA all-gathers only
                     # the final parameter delta (ZeRO-1)
                     g = jax.lax.with_sharding_constraint(g, state_sh[i])
+                elif grad_scatter:
+                    # multi-host dp: the same dp-sharded pin on the grad
+                    # alone — the cross-host sum becomes reduce-scatter
+                    # (+ all-gather of the delta), overlappable with
+                    # backward by the latency-hiding scheduler
+                    g = jax.lax.with_sharding_constraint(g, grad_sh[i])
                 rng_i = jax.random.fold_in(rng, i + 1)  # stochastic rules
                 if multi_precision and is_lowp(w):
                     # fp32 master copy leads the state tuple; the rule

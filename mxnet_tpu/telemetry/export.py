@@ -194,6 +194,30 @@ def _collect_kvstore():
                             "KVStore operations", labels=("op",))
     for op, n in mod.OP_COUNTS.items():
         ops.set_total(n, op)
+    bmod = sys.modules.get("mxnet_tpu.kvstore.buckets")
+    if bmod is None:
+        return
+    cs = bmod.comm_stats()
+    if not cs["pipelines"]:
+        return
+    _registry.counter("mxtpu_kvstore_fused_collectives_total",
+                      "Fused bucket collectives dispatched").set_total(
+                          cs["fused"])
+    _registry.counter("mxtpu_kvstore_bucketed_keys_total",
+                      "Key payloads that rode a fused bucket").set_total(
+                          cs["keys"])
+    _registry.counter("mxtpu_kvstore_bucket_bytes_total",
+                      "Bytes moved through fused bucket collectives"
+                      ).set_total(cs["bytes"])
+    _registry.gauge("mxtpu_kvstore_pending_buckets",
+                    "Bucket reductions currently in flight "
+                    "(dispatched, unresolved)").set(cs["pending"])
+    if cs["overlap_ratio"] is not None:
+        _registry.gauge(
+            "mxtpu_kvstore_overlap_ratio",
+            "1 - blocked/in-flight over fused reductions (1.0 = "
+            "cross-host gradient sync fully hidden behind compute)"
+        ).set(cs["overlap_ratio"])
 
 
 def _collect_memory():

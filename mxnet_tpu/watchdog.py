@@ -468,6 +468,17 @@ def _report(span):
         report["memory"] = _tele_memory.oom_report()
     except Exception as e:
         report["memory"] = f"<unavailable: {e}>"
+    try:
+        # gradient-comms forensics: which fused bucket reductions were
+        # staged/in flight when the sync wedged (sys.modules-gated — a
+        # process that never ran a dist kvstore reports nothing)
+        import sys as _sys
+
+        bmod = _sys.modules.get("mxnet_tpu.kvstore.buckets")
+        if bmod is not None:
+            report["kvstore_buckets"] = bmod.census()
+    except Exception as e:
+        report["kvstore_buckets"] = f"<unavailable: {e}>"
     return report
 
 

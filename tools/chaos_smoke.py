@@ -53,6 +53,13 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     persistent straggler (``mxtpu_gang_straggler_*``) and record the
     ``gang.straggler`` flight event, while the gang still completes
     (``--skip-straggler-drill`` for spawn-constrained harnesses),
+  * the GRADIENT-COMMS drill (phase 11): with the bucketed async
+    reduction pipeline engaged (``MXNET_TPU_BUCKET_FORCE``), an
+    injected ``kvstore.sync`` hang lands MID-BUCKET — while a fused
+    reduction future resolves — and must surface a structured
+    ``PeerLostError`` carrying the bucket census, with the same census
+    embedded in the crash bundle's ``report.json`` (no silent wedge of
+    the async path),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -893,6 +900,61 @@ def main(argv=None):
         rc = straggler_drill(root=os.path.join(ckpt_dir, "straggle"))
         if rc:
             return rc
+
+    # phase 11: bucketed gradient collectives — an injected kvstore.sync
+    # hang MID-BUCKET (while a fused reduction future resolves) must
+    # surface a structured PeerLostError carrying the bucket census,
+    # with the same census embedded in the crash bundle's report.json —
+    # never a silent wedge of the async path
+    import json as _json
+
+    from mxnet_tpu import kvstore as kv_mod
+    from mxnet_tpu.kvstore import PeerLostError
+
+    os.environ["MXNET_TPU_BUCKET_FORCE"] = "1"  # full pipeline, 1 proc
+    try:
+        import mxnet_tpu as mx_
+
+        kv = kv_mod.create("dist_sync")
+        if kv._pipeline is None:
+            print("FAIL: bucket pipeline not constructed")
+            return 1
+        for i in range(4):
+            kv.init(i, mx_.nd.zeros((8, 8)))
+        watchdog.configure({"kvstore.sync": 0.8},
+                           crash_dir=os.path.join(ckpt_dir, "crash"),
+                           interval=0.1)
+        faults.configure("kvstore.sync:hang@1:2.0", seed=args.seed)
+        for i in reversed(range(4)):  # backward order, one fused bucket
+            kv.push(i, mx_.nd.ones((8, 8)))
+        try:
+            kv.pull(0, mx_.nd.zeros((8, 8)))
+            print("FAIL: the mid-bucket hang was not detected")
+            return 1
+        except PeerLostError as e:
+            if not e.census or not e.census["plan"]["buckets"]:
+                print(f"FAIL: PeerLostError carries no bucket census: "
+                      f"{e.census}")
+                return 1
+            if not (e.bundle and os.path.isdir(e.bundle)):
+                print("FAIL: no crash bundle for the bucket stall")
+                return 1
+            with open(os.path.join(e.bundle, "report.json")) as f:
+                rep = _json.load(f)
+            if not rep.get("kvstore_buckets"):
+                print("FAIL: bucket census missing from the crash "
+                      "bundle report")
+                return 1
+            print(f"  mid-bucket hang -> PeerLostError rank "
+                  f"{e.rank}/{e.num_workers} with census "
+                  f"({len(e.census['plan']['buckets'])} buckets, "
+                  f"{e.census['pending']['inflight']} in flight); "
+                  f"bundle {e.bundle}")
+        faults.reset()
+        watchdog.configure(None)
+        time.sleep(2.5)  # drain the abandoned waiter before moving on
+    finally:
+        os.environ.pop("MXNET_TPU_BUCKET_FORCE", None)
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

@@ -643,6 +643,58 @@ def check_tracing():
     return out
 
 
+def check_gradcomms():
+    """Gradient comms (docs/PERFORMANCE.md): the bucketed async
+    cross-host reduction pipeline — knobs, bucket plan sizes, fusion
+    counts, overlap ratio, pending-future depth."""
+    _p("-------Gradient Comms----------")
+    out = {"MXNET_TPU_BUCKET_BYTES":
+           os.environ.get("MXNET_TPU_BUCKET_BYTES"),
+           "MXNET_TPU_BUCKET_FORCE":
+           os.environ.get("MXNET_TPU_BUCKET_FORCE"),
+           "MXNET_TPU_GRAD_SCATTER":
+           os.environ.get("MXNET_TPU_GRAD_SCATTER"),
+           "MXNET_TPU_LHS": os.environ.get("MXNET_TPU_LHS")}
+    try:
+        from mxnet_tpu.kvstore import buckets
+
+        out["cap_bytes"] = buckets.bucket_bytes()
+        _p(f"bucket cap    : {out['cap_bytes']} bytes "
+           f"(MXNET_TPU_BUCKET_BYTES="
+           f"{out['MXNET_TPU_BUCKET_BYTES'] or '<unset>'}; 0 = legacy "
+           "per-key collectives)")
+        _p(f"trainer knobs : MXNET_TPU_GRAD_SCATTER="
+           f"{out['MXNET_TPU_GRAD_SCATTER'] or '<unset>'} (dp grad "
+           "reduce-scatter pin), MXNET_TPU_LHS="
+           f"{out['MXNET_TPU_LHS'] or '<unset>'} (latency-hiding "
+           "scheduler on tpu/gpu)")
+        cs = buckets.comm_stats()
+        out["stats"] = cs
+        _p(f"fused         : {cs['fused']} collectives over "
+           f"{cs['keys']} key payloads, {cs['bytes']} bytes "
+           f"({cs['partial']} partial, {cs['drains']} forced drains)")
+        _p(f"overlap       : ratio {cs['overlap_ratio']} (blocked "
+           f"{cs['wait_ms']}ms of {cs['window_ms']}ms in flight); "
+           f"pending futures {cs['pending']} "
+           f"(max {cs['max_pending']})")
+        cen = buckets.census()
+        out["pipelines"] = cen
+        if not cen:
+            _p("pipelines     : none live (no dist kvstore constructed, "
+               "or bucketing disabled)")
+        for p in cen:
+            plan = p["plan"]
+            sizes = [b["bytes"] for b in plan["buckets"]]
+            _p(f"pipeline      : {plan['keys']} keys in "
+               f"{len(plan['buckets'])} buckets, bytes {sizes[:8]}"
+               f"{'...' if len(sizes) > 8 else ''}; "
+               f"pending {p['pending']['inflight']}")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("kvstore import failed:", e)
+    return out
+
+
 SECTIONS = (
     ("python", check_python),
     ("pip", check_pip),
@@ -657,6 +709,7 @@ SECTIONS = (
     ("preempt", check_preempt),
     ("gang", check_gang),
     ("dataplane", check_dataplane),
+    ("grad_comms", check_gradcomms),
     ("telemetry", check_telemetry),
     ("tracing", check_tracing),
 )
