@@ -32,7 +32,11 @@ or run just it with ``--serve-only``): sustained requests/s + p50/p99
 latency + batch fill ratio from a ``tools/loadgen.py`` closed loop
 against an in-process 2-model ``mxnet_tpu.serving`` container
 (BENCH_SERVE_SECONDS, default 30), so the serving trajectory is tracked
-in BENCH_r06+ alongside img/s.
+in BENCH_r06+ alongside img/s. A ``serving_rps_int8_*`` companion line
+follows it (same harness in ``--dtype both`` pair mode,
+BENCH_SERVE_INT8_SECONDS, default 16): the embedding-lookup fixture
+served fp32 AND entropy-calibrated int8 from one warm ladder, recording
+the matched-p99 int8-vs-float rps ratio every round (ROADMAP item 4).
 
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
 BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
@@ -127,6 +131,7 @@ def main(argv=None):
 
     if args.serve_only:
         bench_serve()
+        bench_serve_int8()
         return
     if args.dataplane_only:
         bench_dataplane()
@@ -218,9 +223,11 @@ def main(argv=None):
     if args.train:
         bench_train_cpu()
     # the serving line is part of the default metric series (the ROADMAP
-    # item-1 trajectory); BENCH_SKIP_SERVE=1 opts out
+    # item-1 trajectory); BENCH_SKIP_SERVE=1 opts out of both it and the
+    # int8-vs-float companion line (the ROADMAP item-4 ratio)
     if args.serve or not os.environ.get("BENCH_SKIP_SERVE"):
         bench_serve()
+        bench_serve_int8()
     # the host data-plane line tracks the streaming input pipeline
     # (native fused decode+augment img/s + trainer data_wait);
     # BENCH_SKIP_DATAPLANE=1 opts out
@@ -367,6 +374,48 @@ def bench_serve():
         "p99_ms": rep.get("p99_ms"),
         "batch_fill_ratio": rep.get("batch_fill_ratio"),
         "rejected": rep.get("rejected"),
+        "recompiles_during_run": rep.get("recompiles_during_run"),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_serve_int8():
+    """Int8 serving throughput vs float, same loadgen harness: the
+    embedding-lookup fixture pair (``tools/loadgen.py --dtype both``)
+    driven closed-loop per variant from ONE warm server — the ROADMAP
+    item-4 acceptance number. Emits the int8 rps as the metric value
+    with the matched-p99 int8-vs-float ratio alongside, so BENCH_r06+
+    records the ratio every round. ``recompiles_during_run`` must be 0
+    (both ladders compiled/disk-loaded at warmup). Env knobs:
+    BENCH_SERVE_INT8_SECONDS (default 16), BENCH_SERVE_CONCURRENCY
+    (16), BENCH_PAIR_VOCAB/_EMBED_DIM/_SEQ_LEN size the fixture."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import loadgen
+
+    import jax
+
+    duration = float(os.environ.get("BENCH_SERVE_INT8_SECONDS", 16))
+    concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY", 16))
+    rep = loadgen.run_pair(
+        duration=duration, concurrency=concurrency,
+        vocab=int(os.environ.get("BENCH_PAIR_VOCAB", 50_000)),
+        embed_dim=int(os.environ.get("BENCH_PAIR_EMBED_DIM", 512)),
+        seq_len=int(os.environ.get("BENCH_PAIR_SEQ_LEN", 1024)))
+    line = {
+        "metric": f"serving_rps_int8_emblookup_closed{concurrency}",
+        "value": rep.get("rps_int8"),
+        "unit": "req/s",
+        "rps_float32": rep.get("rps_float32"),
+        "ratio_int8_vs_float": rep.get("rps_ratio_int8_vs_float"),
+        "p99_int8_ms": rep.get("p99_int8_ms"),
+        "p99_float32_ms": rep.get("p99_float32_ms"),
+        "matched_p99": rep.get("matched_p99"),
+        "calib_mode": rep.get("calib_mode"),
+        "bucket_census_int8": rep.get("bucket_census_int8"),
         "recompiles_during_run": rep.get("recompiles_during_run"),
         "platform": jax.devices()[0].platform,
     }

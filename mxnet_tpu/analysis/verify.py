@@ -340,11 +340,13 @@ def _check_shapes(order, entries, shape_hints, dtype_hints, issues):
         for child, oi, st in in_structs:
             if st is None and child.is_var and child.name in rules:
                 try:
+                    rshape, rdtype = rules[child.name]
                     st = jax.ShapeDtypeStruct(
-                        rules[child.name],
+                        rshape,
                         canonical_dtype(dtype_hints.get(
                             child.name,
-                            child.attrs.get("__dtype__", "float32"))))
+                            child.attrs.get("__dtype__",
+                                            rdtype or "float32"))))
                     vals[id(child), 0] = st
                 except Exception:
                     st = None

@@ -37,6 +37,9 @@ class _Ctx:
         self.initializers = []
         self.counter = 0
         self.op_types = set()  # emitted ONNX op types (opset selection)
+        self.params = {}       # param name -> numpy value (quantized ops
+        #                        fold scale/zero-point constants from it)
+        self.alias = {}        # Identity-passthrough tensor -> source param
 
     def emit(self, op_type, inputs, outputs, **attrs):
         self.op_types.add(op_type)
@@ -45,7 +48,9 @@ class _Ctx:
     def const(self, base, arr):
         name = f"{base}_const{self.counter}"
         self.counter += 1
-        self.initializers.append(proto.tensor(name, _np.asarray(arr)))
+        arr = _np.asarray(arr)
+        self.initializers.append(proto.tensor(name, arr))
+        self.params[name] = arr  # resolvable like any param constant
         return name
 
 
@@ -613,6 +618,177 @@ def _layer_norm(ctx, name, ins, out, attrs):
              epsilon=float(attrs.get("eps", 1e-5)))
 
 
+# ---------------------------------------------------------------------------
+# quantized graphs (contrib.quantization output): exported in the ONNX
+# QLinear representation — QuantizeLinear on the calibrated activation,
+# QLinearConv / QLinearMatMul over the int8 weights (per-channel w_scale),
+# DequantizeLinear back to fp32, bias added in fp32 exactly like the
+# in-framework ops. All emitted ops exist in the default domain at
+# opset 13 (per-axis QuantizeLinear/DequantizeLinear need >= 13).
+
+def _act_scale(attrs):
+    """The calibrated activation scale baked into a quantized node."""
+    lo = float(attrs.get("min_calib_range", 0.0))
+    hi = float(attrs.get("max_calib_range", 0.0))
+    s = max(abs(lo), abs(hi)) / 127.0
+    return s if s > 0 else 1.0
+
+
+def _out_scale(attrs, x_scale, w_scale, fan_in):
+    """y_scale for the QLinear output: the observed output range when
+    the graph pass stamped one, else a conservative accumulation
+    estimate (x_scale * max w_scale * sqrt(fan_in))."""
+    lo = attrs.get("min_out_calib_range")
+    hi = attrs.get("max_out_calib_range")
+    if lo is not None and hi is not None:
+        s = max(abs(float(lo)), abs(float(hi))) / 127.0
+        if s > 0:
+            return s
+    return x_scale * float(_np.max(w_scale)) * max(1.0, fan_in) ** 0.5
+
+
+def _quantize_linear(ctx, name, data, scale):
+    """Emit QuantizeLinear(data) at `scale`; returns (qname, s_const,
+    zp_const) for reuse by the consuming QLinear node."""
+    sc = ctx.const(name, _np.float32(scale))
+    zp = ctx.const(name, _np.int8(0))
+    q = f"{name}_qx"
+    ctx.emit("QuantizeLinear", [data, sc, zp], [q])
+    return q, sc, zp
+
+
+def _w_scale_inputs(ctx, name, ins, wval):
+    """(w_scale input, w_zero_point input): per-channel when the scale
+    param is a vector, scalar otherwise (the tensor-wise A/B path)."""
+    sval = _np.asarray(ctx.params[ins[2]], _np.float32).reshape(-1)
+    if sval.size > 1:
+        return ins[2], ctx.const(name, _np.zeros(sval.size, _np.int8)), sval
+    return (ctx.const(name, _np.float32(sval[0])),
+            ctx.const(name, _np.int8(0)), sval)
+
+
+@register_translation("_contrib_quantized_fully_connected")
+def _qfc(ctx, name, ins, out, attrs):
+    wval = ctx.params.get(ins[1])
+    if wval is None:
+        raise NotImplementedError(
+            f"quantized FC {name!r}: int8 weight {ins[1]!r} must be a "
+            "param to export (QLinearMatMul needs the transposed table)")
+    xs = _act_scale(attrs)
+    flat = f"{name}_flat"
+    ctx.emit("Flatten", [ins[0]], [flat], axis=1)
+    qx, xs_c, xzp = _quantize_linear(ctx, name, flat, xs)
+    # QLinearMatMul computes a @ b: our weight is (N, K) — export its
+    # transpose as an int8 initializer (per-column b_scale = the
+    # per-output-channel scale vector)
+    wT = ctx.const(name, _np.ascontiguousarray(
+        _np.asarray(wval, _np.int8).T))
+    ws, wzp, sval = _w_scale_inputs(ctx, name, ins, wval)
+    ys = _out_scale(attrs, xs, sval, wval.shape[-1])
+    ys_c = ctx.const(name, _np.float32(ys))
+    yzp = ctx.const(name, _np.int8(0))
+    qy = f"{name}_qy"
+    ctx.emit("QLinearMatMul", [qx, xs_c, xzp, wT, ws, wzp, ys_c, yzp],
+             [qy])
+    bias = ins[3] if len(ins) > 3 and not attrs.get("no_bias", False) \
+        else None
+    dq = f"{name}_dq" if bias else out
+    ctx.emit("DequantizeLinear", [qy, ys_c, yzp], [dq])
+    if bias:
+        ctx.emit("Add", [dq, bias], [out])
+
+
+@register_translation("_contrib_quantized_conv")
+def _qconv(ctx, name, ins, out, attrs):
+    wval = ctx.params.get(ins[1])
+    if wval is None:
+        raise NotImplementedError(
+            f"quantized conv {name!r}: int8 weight {ins[1]!r} must be a "
+            "param to export")
+    xs = _act_scale(attrs)
+    qx, xs_c, xzp = _quantize_linear(ctx, name, ins[0], xs)
+    ws, wzp, sval = _w_scale_inputs(ctx, name, ins, wval)
+    fan_in = int(_np.prod(wval.shape[1:]))
+    ys = _out_scale(attrs, xs, sval, fan_in)
+    ys_c = ctx.const(name, _np.float32(ys))
+    yzp = ctx.const(name, _np.int8(0))
+    kernel = list(attrs.get("kernel", ()))
+    n = len(kernel)
+    qy = f"{name}_qy"
+    ctx.emit("QLinearConv",
+             [qx, xs_c, xzp, ins[1], ws, wzp, ys_c, yzp], [qy],
+             kernel_shape=kernel,
+             strides=_pair(attrs.get("stride"), n, 1),
+             dilations=_pair(attrs.get("dilate"), n, 1),
+             group=int(attrs.get("num_group", 1)),
+             pads=_pair(attrs.get("pad"), n, 0) * 2)
+    bias = ins[3] if len(ins) > 3 and not attrs.get("no_bias", False) \
+        else None
+    dq = f"{name}_dq" if bias else out
+    ctx.emit("DequantizeLinear", [qy, ys_c, yzp], [dq])
+    if bias:
+        # fp32 bias broadcast over (N, C, *spatial), like the op itself
+        shape = ctx.const(name, _np.asarray(
+            [int(wval.shape[0])] + [1] * n, _np.int64))
+        br = f"{name}_bias_r"
+        ctx.emit("Reshape", [bias, shape], [br])
+        ctx.emit("Add", [dq, br], [out])
+
+
+@register_translation("_contrib_quantized_embedding")
+def _qembed(ctx, name, ins, out, attrs):
+    # int8 table gather; range metadata (outputs 1/2) passes through as
+    # Identity over the range params so the downstream dequantize can
+    # resolve the constant scale
+    idx = f"{name}_idx"
+    ctx.emit("Cast", [ins[0]], [idx], to=proto.INT64)
+    ctx.emit("Gather", [ins[1], idx], [out], axis=0)
+    for i, src in ((1, ins[2]), (2, ins[3])):
+        ctx.alias[f"{name}_{i}"] = src
+        ctx.emit("Identity", [src], [f"{name}_{i}"])
+
+
+def _range_value(ctx, tname, node):
+    src = ctx.alias.get(tname, tname)
+    val = ctx.params.get(src)
+    if val is None:
+        raise NotImplementedError(
+            f"{node!r}: quantization range {tname!r} is not a constant "
+            "param; dynamic-range graphs do not export to ONNX")
+    return float(_np.asarray(val).reshape(-1)[0])
+
+
+@register_translation("_contrib_dequantize")
+def _dequantize_tr(ctx, name, ins, out, attrs):
+    lo = _range_value(ctx, ins[1], name)
+    hi = _range_value(ctx, ins[2], name)
+    s = max(abs(lo), abs(hi)) / 127.0 or 1.0
+    sc = ctx.const(name, _np.float32(s))
+    zp = ctx.const(name, _np.int8(0))
+    ctx.emit("DequantizeLinear", [ins[0], sc, zp], [out])
+
+
+@register_translation("_contrib_quantize_v2")
+def _quantize_v2_tr(ctx, name, ins, out, attrs):
+    lo = attrs.get("min_calib_range")
+    hi = attrs.get("max_calib_range")
+    if lo is None or hi is None:
+        raise NotImplementedError(
+            f"{name!r}: _contrib_quantize_v2 without calibrated ranges "
+            "(dynamic quantization) does not export to ONNX")
+    s = max(abs(float(lo)), abs(float(hi))) / 127.0 or 1.0
+    sc = ctx.const(name, _np.float32(s))
+    zp = ctx.const(name, _np.int8(0))
+    ctx.emit("QuantizeLinear", [ins[0], sc, zp], [out])
+    # outputs 1/2 are the (min, max) range passthroughs
+    mn = ctx.const(name, _np.float32(float(lo)))
+    mx = ctx.const(name, _np.float32(float(hi)))
+    ctx.alias[f"{name}_1"] = mn
+    ctx.alias[f"{name}_2"] = mx
+    ctx.emit("Identity", [mn], [f"{name}_1"])
+    ctx.emit("Identity", [mx], [f"{name}_2"])
+
+
 def export_model(sym, params, in_shapes=None, in_types=_np.float32,
                  onnx_file_path="model.onnx", verbose=False,
                  dynamic=False, input_type=None, input_shape=None,
@@ -637,6 +813,7 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
     param_names = set(flat_params)
 
     ctx = _Ctx()
+    ctx.params.update(flat_params)
     data_inputs = []
     out_name = {}  # (id(node), idx) -> onnx tensor name
     for node in order:

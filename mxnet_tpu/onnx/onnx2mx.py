@@ -391,6 +391,27 @@ def import_model(model_file):
     def _init_ints(tname):
         return [int(x) for x in _np.asarray(inits[tname]).reshape(-1)]
 
+    def _init_scalar(tname, node_name):
+        if tname not in inits:
+            raise NotImplementedError(
+                f"node {node_name!r}: quantization scale {tname!r} must "
+                "be an initializer (dynamic scales are not importable)")
+        return float(_np.asarray(inits[tname]).reshape(-1)[0])
+
+    def _range_vars(base, lo, hi):
+        mn = sym_mod.var(base + "_min")
+        mx_ = sym_mod.var(base + "_max")
+        arg_params[base + "_min"] = array(_np.asarray([lo], _np.float32))
+        arg_params[base + "_max"] = array(_np.asarray([hi], _np.float32))
+        return mn, mx_
+
+    # QuantizeLinear outputs remember their fp32 source + calibrated
+    # range so a following QLinearConv/QLinearMatMul folds back into the
+    # framework's fused float-in/float-out quantized op; that op already
+    # dequantizes, so the chain's DequantizeLinear becomes a passthrough
+    qsources = {}     # onnx tensor -> (float Symbol, min, max)
+    dequant_skip = {}  # QLinear output tensor -> fused float Symbol
+
     for n in g["nodes"]:
         op = n["op_type"]
         name = n["name"] or n["output"][0]
@@ -475,6 +496,60 @@ def import_model(model_file):
             hi = float(inits[n["input"][2]])
             out = sym_mod.clip(as_sym(n["input"][0], name), a_min=lo,
                                a_max=hi, name=name)
+        elif op == "QuantizeLinear":
+            s = _init_scalar(n["input"][1], name)
+            x = as_sym(n["input"][0], name)
+            lo, hi = -s * 127.0, s * 127.0
+            qsources[n["output"][0]] = (x, lo, hi)
+            out = sym_mod._contrib_quantize_v2(
+                x, min_calib_range=lo, max_calib_range=hi, name=name)[0]
+        elif op == "QLinearMatMul":
+            src = qsources.get(n["input"][0])
+            if src is None:
+                raise NotImplementedError(
+                    f"QLinearMatMul {name!r}: input a must come from an "
+                    "imported QuantizeLinear")
+            x, lo, hi = src
+            w = _np.asarray(inits[n["input"][3]])  # (K, N) int8
+            wname = f"{name}_weight_quantize"
+            wvar = sym_mod.var(wname)
+            arg_params[wname] = array(
+                _np.ascontiguousarray(w.T), dtype="int8")
+            svar = as_sym(n["input"][4], name)
+            out = sym_mod._contrib_quantized_fully_connected(
+                x, wvar, svar, num_hidden=int(w.shape[1]), no_bias=True,
+                min_calib_range=lo, max_calib_range=hi, name=name)
+            dequant_skip[n["output"][0]] = out
+        elif op == "QLinearConv":
+            src = qsources.get(n["input"][0])
+            if src is None:
+                raise NotImplementedError(
+                    f"QLinearConv {name!r}: input x must come from an "
+                    "imported QuantizeLinear")
+            x, lo, hi = src
+            wvar = as_sym(n["input"][3], name)  # int8 (O, I/g, *k) param
+            svar = as_sym(n["input"][4], name)
+            attrs = n["attrs"]
+            w_shape = _np.asarray(inits[n["input"][3]]).shape
+            out = sym_mod._contrib_quantized_conv(
+                x, wvar, svar,
+                kernel=tuple(attrs.get("kernel_shape", ())),
+                stride=tuple(attrs.get("strides", ())),
+                dilate=tuple(attrs.get("dilations", ())),
+                pad=_halve_pads(attrs.get("pads", ())),
+                num_group=int(attrs.get("group", 1)),
+                num_filter=int(w_shape[0]), no_bias=True,
+                min_calib_range=lo, max_calib_range=hi, name=name)
+            dequant_skip[n["output"][0]] = out
+        elif op == "DequantizeLinear":
+            if n["input"][0] in dequant_skip:
+                # the fused quantized op above already emitted fp32
+                out = dequant_skip[n["input"][0]]
+            else:
+                s = _init_scalar(n["input"][1], name)
+                x = as_sym(n["input"][0], name)
+                mn, mx_ = _range_vars(name, -s * 127.0, s * 127.0)
+                out = sym_mod._contrib_dequantize(x, mn, mx_, name=name)
         elif op == "BatchNormalization":
             ins = [as_sym(i, name) for i in n["input"]]
             # moving stats are aux params

@@ -81,12 +81,19 @@ def _contrib_requantize(data, min_range, max_range, min_calib_range=None,
 @register("_contrib_quantized_fully_connected")
 def _quantized_fully_connected(data, weight, scale, bias=None, num_hidden=1,
                                no_bias=False, flatten=True,
-                               min_calib_range=0.0, max_calib_range=0.0):
+                               min_calib_range=0.0, max_calib_range=0.0,
+                               min_out_calib_range=None,
+                               max_out_calib_range=None):
     """int8 FullyConnected: activation quantized with the calibrated range,
     int8 x int8 -> int32 on the MXU, per-output-channel dequantize.
 
-    weight: int8 (num_hidden, K); scale: float32 (num_hidden,) per-channel
-    weight scales. parity: quantized_fully_connected.cc.
+    weight: int8 (num_hidden, K); scale: float32 per-channel weight
+    scales (num_hidden,), or a single-element/scalar tensor for
+    tensor-wise granularity. ``min_out_calib_range``/
+    ``max_out_calib_range`` carry the observed OUTPUT range (stamped by
+    the graph pass) for the ONNX exporter's y_scale and requantize
+    fusion — they do not change the computation here.
+    parity: quantized_fully_connected.cc.
     """
     if flatten and data.ndim > 2:
         data = data.reshape((data.shape[0], -1))
@@ -105,10 +112,15 @@ def _quantized_fully_connected(data, weight, scale, bias=None, num_hidden=1,
 def _quantized_conv(data, weight, scale, bias=None, kernel=(), stride=(),
                     dilate=(), pad=(), num_filter=1, num_group=1,
                     no_bias=False, layout=None, min_calib_range=0.0,
-                    max_calib_range=0.0):
+                    max_calib_range=0.0, min_out_calib_range=None,
+                    max_out_calib_range=None):
     """int8 Convolution (NCHW): parity: quantized_conv.cc.
 
-    weight: int8 (num_filter, C/g, *kernel); scale: float32 (num_filter,)."""
+    weight: int8 (num_filter, C/g, *kernel); scale: float32
+    (num_filter,) per-channel, or single-element for tensor-wise
+    granularity. ``min_out_calib_range``/``max_out_calib_range`` carry
+    the observed output range for the ONNX exporter (no effect on the
+    computation)."""
     n = len(kernel)
     stride = tuple(stride) if stride else (1,) * n
     dilate = tuple(dilate) if dilate else (1,) * n
@@ -139,6 +151,9 @@ def _quantized_conv(data, weight, scale, bias=None, kernel=(), stride=(),
 
 @register("_contrib_quantized_act", num_outputs=3)
 def _quantized_act(data, min_data, max_data, act_type="relu"):
+    """int8 Activation (parity: quantized_activation.cc): relu clips the
+    range to (0, max) and requantizes the payload onto the new scale;
+    other act types pass through unchanged."""
     if act_type != "relu":
         return data, min_data, max_data
     # the clipped range (0, max) has a new scale — requantize the payload,
@@ -153,6 +168,8 @@ def _quantized_act(data, min_data, max_data, act_type="relu"):
 
 @register("_contrib_quantized_flatten", num_outputs=3)
 def _quantized_flatten(data, min_data, max_data):
+    """int8 Flatten (parity: quantized_flatten.cc): pure reshape — the
+    payload and its range metadata pass through untouched."""
     return data.reshape(data.shape[0], -1), min_data, max_data
 
 
@@ -179,6 +196,9 @@ def _quantized_concat(*args, dim=1, num_args=None):
 
 @register("_contrib_quantized_elemwise_add", num_outputs=3)
 def _quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 elementwise add (parity: quantized_elemwise_add.cc): both
+    sides dequantize onto fp32, the sum requantizes onto its own
+    dynamic range; returns (int8 out, min, max)."""
     sl = _scale(lhs_min, lhs_max)
     sr = _scale(rhs_min, rhs_max)
     out = lhs.astype(jnp.float32) * sl + rhs.astype(jnp.float32) * sr
@@ -190,6 +210,9 @@ def _quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
 
 @register("_contrib_quantized_elemwise_mul", num_outputs=3)
 def _quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 elementwise multiply (parity: quantized_elemwise_mul.cc):
+    dequantize both sides, multiply in fp32, requantize onto the
+    product's dynamic range; returns (int8 out, min, max)."""
     sl = _scale(lhs_min, lhs_max)
     sr = _scale(rhs_min, rhs_max)
     out = (lhs.astype(jnp.float32) * sl) * (rhs.astype(jnp.float32) * sr)
@@ -225,6 +248,10 @@ def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
 def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
                           min_data, max_data, eps=1e-3, min_calib_range=None,
                           max_calib_range=None, **kw):
+    """int8 inference BatchNorm (parity: quantized_batch_norm.cc):
+    dequantize, normalize with the moving statistics in fp32, requantize
+    onto the calibrated range (or the batch's own range when
+    uncalibrated)."""
     s_in = _scale(min_data, max_data)
     x = data.astype(jnp.float32) * s_in
     shape = [1, -1] + [1] * (data.ndim - 2)
@@ -243,7 +270,17 @@ def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
 @register("_contrib_quantized_embedding", num_outputs=3)
 def _quantized_embedding(data, weight, min_weight, max_weight,
                          input_dim=None, output_dim=None):
+    """int8 Embedding lookup (parity: quantized_indexing_op.cc): the
+    gather stays in int8 — 4x less table traffic than fp32, the actual
+    speed win for bandwidth-bound embedding models — and the range
+    metadata passes through so a downstream dequantize (cast * scale,
+    fused into the gather's consumer by XLA) restores fp32."""
     out = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    # XLA CPU otherwise fuses this gather into a consuming reduction and
+    # re-materializes it element-by-element, losing the vectorized int8
+    # row copy (the entire point of the op); the barrier pins the gather
+    # as one materialized memcpy-shaped kernel. Semantically identity.
+    out = jax.lax.optimization_barrier(out)
     return out, min_weight, max_weight
 
 

@@ -178,6 +178,18 @@ class BucketBatcher:
         """Rows waiting for a batch (the bound admission checks)."""
         return self._rows
 
+    def ladder_census(self):
+        """The bucket ladder with its observed batch counts and the
+        model's dtypes — the int8-serving proof surface (diagnose's
+        Quantization report, chaos phase 12): every ladder bucket that
+        warmed must still be servable after a fault."""
+        with self.metrics._lock:
+            census = dict(sorted(self.metrics.bucket_census.items()))
+        return {"buckets": list(self.model.buckets),
+                "bucket_census": census,
+                "dtype": self.model.dtype,
+                "weight_dtype": self.model.weight_dtype}
+
     @property
     def draining(self):
         return self._draining

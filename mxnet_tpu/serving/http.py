@@ -90,7 +90,10 @@ class HttpFrontEnd:
                     self._json(200, {"status": "draining" if srv.draining
                                      else "ok"})
                 elif self.path in ("/v1/models", "/models"):
-                    self._json(200, {"models": srv.models()})
+                    # "detail" carries per-model dtype/weight_dtype (int8
+                    # for quantized models) + the bucket ladder
+                    self._json(200, {"models": srv.models(),
+                                     "detail": srv.model_info()})
                 elif self.path in ("/v1/stats", "/stats"):
                     self._json(200, srv.stats())
                 elif self.path == "/metrics":

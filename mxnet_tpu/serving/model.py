@@ -20,6 +20,15 @@ Loaders (the same model zoo the C predict ABI speaks):
 * :meth:`ServedModel.from_onnx` — a ``.onnx`` file through the existing
   ONNX importer.
 
+Quantized (int8) models load through the SAME loaders: a
+``contrib.quantization.quantize_model`` symbol/params pair (or its
+``save_checkpoint`` round trip) is detected by its int8 weight params,
+reported as ``weight_dtype: "int8"`` in ``stats()``/``/v1/models``, and
+compiled under a token salted with the weight dtype — the int8 bucket
+ladder gets its own executables in the persistent disk cache, warming
+exactly like the float ladder (zero recompiles under traffic after
+``warmup()``; docs/PERFORMANCE.md "Int8 inference").
+
 Bucket ladder note: the default smallest bucket is **2**, not 1 — XLA's
 CPU matmul takes a GEMV kernel path at batch 1 whose last-bit rounding
 differs from the GEMM path every other bucket takes. With buckets >= 2 a
@@ -59,12 +68,23 @@ class ServedModel:
     """
 
     def __init__(self, name, forward, param_raws, aux_raws, example_shape,
-                 dtype="float32", buckets=None):
+                 dtype="float32", buckets=None, weight_dtype=None):
         from .. import compile as _compile
 
         self.name = str(name)
         self.example_shape = tuple(int(s) for s in example_shape)
         self.dtype = str(dtype)
+        # int8-quantized models keep a float INPUT dtype (activations
+        # quantize inside the compiled graph) but carry int8 weights;
+        # the distinction rides into stats()//models and the compile
+        # token so an int8 ladder never collides with its float twin
+        if weight_dtype is None:
+            weight_dtype = self.dtype
+            for r in param_raws:
+                if str(getattr(r, "dtype", "")) == "int8":
+                    weight_dtype = "int8"
+                    break
+        self.weight_dtype = str(weight_dtype)
         if buckets is None:
             buckets = _config.effective()["buckets"]
         self.buckets = _config._coerce("buckets", buckets)
@@ -88,8 +108,14 @@ class ServedModel:
 
     def _token(self, forward):
         base = getattr(forward, "_serving_token", None) or repr(forward)
-        blob = "\n".join([str(base), repr(self.example_shape), self.dtype])
+        blob = "\n".join([str(base), repr(self.example_shape), self.dtype,
+                          self.weight_dtype])
         return ("serving", hashlib.sha1(blob.encode()).hexdigest()[:16])
+
+    @property
+    def quantized(self):
+        """True for an int8-weight (quantized) model."""
+        return self.weight_dtype == "int8"
 
     # ------------------------------------------------------------ shape ---
     @property
@@ -155,7 +181,8 @@ class ServedModel:
 
     def __repr__(self):
         return (f"ServedModel({self.name!r}, example={self.example_shape}, "
-                f"dtype={self.dtype}, buckets={self.buckets})")
+                f"dtype={self.dtype}, weight_dtype={self.weight_dtype}, "
+                f"buckets={self.buckets})")
 
     # ---------------------------------------------------------- loaders ---
     @classmethod

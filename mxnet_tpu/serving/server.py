@@ -92,6 +92,17 @@ class ModelServer:
         return list(self._batchers) if self._batchers \
             else self._container.names()
 
+    def model_info(self):
+        """Per-model serving metadata: input dtype, weight dtype (int8
+        for quantized models), bucket ladder, example shape — the
+        ``/v1/models`` detail payload."""
+        return {m.name: {"dtype": m.dtype,
+                         "weight_dtype": m.weight_dtype,
+                         "quantized": m.quantized,
+                         "buckets": list(m.buckets),
+                         "example_shape": list(m.example_shape)}
+                for m in self._container}
+
     # ------------------------------------------------------------ serving --
     def _batcher(self, model):
         b = self._batchers.get(model)
@@ -175,6 +186,8 @@ class ModelServer:
             models[name] = b.metrics.snapshot(
                 queue_depth=b.queue_depth(),
                 buckets=list(b.model.buckets),
+                dtype=b.model.dtype,
+                weight_dtype=b.model.weight_dtype,
                 draining=b.draining)
         return {
             "name": self.name,

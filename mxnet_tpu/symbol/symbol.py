@@ -372,13 +372,15 @@ class Symbol:
             for child, oi, st in in_structs:
                 if st is None:
                     if child.is_var and child.name in rules:
+                        rshape, rdtype = rules[child.name]
                         st = jax.ShapeDtypeStruct(
-                            rules[child.name],
+                            rshape,
                             canonical_dtype(
                                 dtype_hints.get(
                                     child.name,
-                                    child.attrs.get("__dtype__",
-                                                    "float32"))))
+                                    child.attrs.get(
+                                        "__dtype__",
+                                        rdtype or "float32"))))
                         vals[id(child), 0] = st
                         shapes["var", child.name] = tuple(st.shape)
                         shapes[id(child), 0] = tuple(st.shape)
@@ -725,18 +727,19 @@ def _eval_shape_node(node, in_structs):
 
 
 def _param_shape_rules(node, data_struct):
-    """Parameter shapes derivable from the (first) data input — the
-    shape-inference rules of the reference's layer ops."""
+    """Parameter shapes (and, for quantized ops, dtypes) derivable from
+    the (first) data input — the shape-inference rules of the
+    reference's layer ops. Values are ``(shape, dtype_or_None)``."""
     if data_struct is None:
         return {}
     dshape = tuple(data_struct.shape)
     attrs = node.attrs
     rules = {}
 
-    def put(idx, shape):
+    def put(idx, shape, dtype=None):
         child, _ = node.inputs[idx]
         if child.is_var:
-            rules[child.name] = tuple(int(s) for s in shape)
+            rules[child.name] = (tuple(int(s) for s in shape), dtype)
 
     op = node.op
     if op == "FullyConnected":
@@ -774,6 +777,29 @@ def _param_shape_rules(node, data_struct):
             put(i, (dshape[1],))
     elif op == "Embedding":
         put(1, (attrs["input_dim"], attrs["output_dim"]))
+    elif op == "_contrib_quantized_fully_connected":
+        num_hidden = attrs["num_hidden"]
+        flatten = attrs.get("flatten", True)
+        in_units = (int(_np.prod(dshape[1:])) if flatten else dshape[-1])
+        put(1, (num_hidden, in_units), "int8")
+        # channel-wise scale; a tensor-wise graph carries (1,) params,
+        # which bind paths must pass explicitly (eval_with always works)
+        put(2, (num_hidden,))
+        if len(node.inputs) > 3:
+            put(3, (num_hidden,))
+    elif op == "_contrib_quantized_conv":
+        kernel = attrs.get("kernel", ())
+        num_filter = attrs["num_filter"]
+        num_group = attrs.get("num_group", 1)
+        put(1, (num_filter, dshape[1] // num_group) + tuple(kernel),
+            "int8")
+        put(2, (num_filter,))
+        if len(node.inputs) > 3:
+            put(3, (num_filter,))
+    elif op == "_contrib_quantized_embedding":
+        put(1, (attrs["input_dim"], attrs["output_dim"]), "int8")
+        put(2, (1,))
+        put(3, (1,))
     elif op == "RNN":
         put(1, (_rnn_param_size(dshape, attrs),))
     elif op in ("SoftmaxOutput", "SVMOutput"):

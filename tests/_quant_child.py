@@ -1,0 +1,73 @@
+"""Int8 serving ladder warm-start drill (tests/test_quantization.py).
+
+Builds a DETERMINISTIC entropy-calibrated int8 model (explicit node
+names + seeded params -> a process-stable serving compile token),
+serves it through a 3-bucket ladder with MXNET_TPU_CACHE_DIR set, and
+prints one ``QCHILD <json>`` line with the serving compile-site stats
+(misses / disk hits / compile ms), the traffic-window recompile count
+and the bucket census. Run twice against the same cache dir by the
+parent test: the SECOND (warm) run must show zero compiles — the whole
+int8 ladder loads from the persistent disk cache.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUCKETS = (2, 4, 8)
+
+
+def main():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile as _compile
+    from mxnet_tpu import serving
+    from mxnet_tpu.contrib import quantization as quant
+
+    rng = np.random.RandomState(0)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="qc_fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="qc_fc2")
+    args = {"qc_fc1_weight": mx.nd.array(
+                (rng.randn(16, 8) * 0.2).astype(np.float32)),
+            "qc_fc1_bias": mx.nd.array(np.zeros(16, np.float32)),
+            "qc_fc2_weight": mx.nd.array(
+                (rng.randn(4, 16) * 0.2).astype(np.float32)),
+            "qc_fc2_bias": mx.nd.array(np.zeros(4, np.float32))}
+    calib = mx.io.NDArrayIter(rng.randn(64, 8).astype(np.float32),
+                              batch_size=16, label_name=None)
+    qsym, qargs, _ = quant.quantize_model(
+        net, args, {}, data_names=("data",), calib_data=calib,
+        calib_mode="entropy")
+
+    container = serving.ModelContainer()
+    container.add_symbol("qchild", qsym, qargs, example_shape=(8,),
+                         buckets=BUCKETS)
+    server = serving.ModelServer(container, max_wait_ms=1.0).start()
+    server.warmup()
+    pre = _compile.stats().get("serving", {})
+    for rows in (1, 2, 3, 4, 5, 8, 7, 6):
+        y = server.predict(
+            "qchild", rng.randn(rows, 8).astype(np.float32), timeout=30.0)
+        assert y.shape == (rows, 4), y.shape
+    post = _compile.stats().get("serving", {})
+    stats = server.stats()["models"]["qchild"]
+    server.drain(timeout=10.0)
+    print("QCHILD " + json.dumps({
+        "misses": post.get("misses", 0),
+        "hits": post.get("hits", 0),
+        "disk_hits": post.get("disk_hits", 0),
+        "compile_ms": post.get("compile_ms", 0.0),
+        "recompiles_during_traffic":
+            post.get("misses", 0) - pre.get("misses", 0),
+        "weight_dtype": stats.get("weight_dtype"),
+        "buckets": stats.get("buckets"),
+        "bucket_census": stats.get("bucket_census"),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,11 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     ``PeerLostError`` carrying the bucket census, with the same census
     embedded in the crash bundle's ``report.json`` (no silent wedge of
     the async path),
+  * the INT8-SERVING drill (phase 12): an entropy-calibrated quantized
+    model (``contrib.quantization``) served through its own bucket
+    ladder takes an injected ``serving.batch`` fault — the request
+    fails typed, the server keeps serving int8, and the ladder census
+    stays intact with ``weight_dtype: int8`` still reported,
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -955,6 +960,70 @@ def main(argv=None):
         time.sleep(2.5)  # drain the abandoned waiter before moving on
     finally:
         os.environ.pop("MXNET_TPU_BUCKET_FORCE", None)
+
+    # phase 12: int8 serving — an entropy-calibrated quantized model
+    # served through its own bucket ladder takes an injected
+    # serving.batch fault: the request fails TYPED (RequestError), the
+    # server keeps serving int8, and the ladder census stays intact
+    # (every warmed bucket still servable — the quantized executables
+    # survived the fault)
+    from mxnet_tpu.contrib import quantization as _quant
+
+    mx.random.seed(args.seed + 12)
+    qdata = mx.sym.var("data")
+    qnet = mx.sym.FullyConnected(qdata, num_hidden=16, name="chaosq_fc1")
+    qnet = mx.sym.Activation(qnet, act_type="relu")
+    qnet = mx.sym.FullyConnected(qnet, num_hidden=4, name="chaosq_fc2")
+    qrng = np.random.RandomState(args.seed + 12)
+    qfargs = {"chaosq_fc1_weight": mx.nd.array(
+                  (qrng.randn(16, 8) * 0.2).astype(np.float32)),
+              "chaosq_fc1_bias": mx.nd.array(np.zeros(16, np.float32)),
+              "chaosq_fc2_weight": mx.nd.array(
+                  (qrng.randn(4, 16) * 0.2).astype(np.float32)),
+              "chaosq_fc2_bias": mx.nd.array(np.zeros(4, np.float32))}
+    qcalib = mx.io.NDArrayIter(
+        qrng.randn(64, 8).astype(np.float32), batch_size=16,
+        label_name=None)
+    qsym12, qargs12, _ = _quant.quantize_model(
+        qnet, qfargs, {}, data_names=("data",), calib_data=qcalib,
+        calib_mode="entropy")
+    qcont = serving.ModelContainer()
+    qcont.add_symbol("chaos_int8", qsym12, qargs12, example_shape=(8,),
+                     buckets=(2, 4))
+    qserver = serving.ModelServer(qcont, max_wait_ms=1.0).start()
+    qserver.warmup()
+    qstats0 = qserver.stats()["models"]["chaos_int8"]
+    if qstats0.get("weight_dtype") != "int8":
+        print(f"FAIL: served quantized model not reported int8: {qstats0}")
+        return 1
+    faults.configure("serving.batch:raise@1", seed=args.seed)
+    qx = np.random.RandomState(args.seed).randn(1, 8).astype(np.float32)
+    try:
+        qserver.predict("chaos_int8", qx, timeout=10.0)
+        print("FAIL: the injected int8 serving fault was not raised")
+        return 1
+    except serving.RequestError as e:
+        print(f"  int8 serving fault surfaced typed: {type(e).__name__}")
+    faults.reset()
+    # the whole ladder must still be servable: drive one batch into
+    # every bucket and require each to land in the census
+    y12 = qserver.predict("chaos_int8", qx, timeout=10.0)
+    if y12.shape != (1, 4):
+        print(f"FAIL: post-fault int8 predict shape {y12.shape}")
+        return 1
+    qserver.predict("chaos_int8",
+                    np.repeat(qx, 3, axis=0), timeout=10.0)
+    qstats1 = qserver.stats()["models"]["chaos_int8"]
+    census12 = qstats1["bucket_census"]
+    if not {2, 4} <= {int(b) for b in census12} \
+            or qstats1.get("weight_dtype") != "int8":
+        print(f"FAIL: int8 ladder census damaged after the fault: "
+              f"{qstats1}")
+        return 1
+    print(f"  int8 server kept serving after the fault "
+          f"(ladder census {census12}, calib mode "
+          f"{_quant.last_calibration()['mode']})")
+    qserver.drain(timeout=10.0)
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

@@ -695,6 +695,76 @@ def check_gradcomms():
     return out
 
 
+def check_quantization():
+    """Int8 quantization state (docs/PERFORMANCE.md "Int8 inference"):
+    the last calibration run in this process (mode / histogram bins /
+    per-tensor thresholds), the last graph-pass census (per-channel vs
+    per-tensor vs embedding weights), the live int8 serving ladders
+    (weight_dtype + bucket census) and the serving compile site's
+    disk-cache warmth — everything needed to answer "is this process
+    actually serving the calibrated int8 model, warm?"."""
+    _p("---------Quantization----------")
+    out = {}
+    try:
+        from mxnet_tpu.contrib import quantization as quant
+
+        calib = quant.last_calibration()
+        out["last_calibration"] = calib
+        if calib is None:
+            _p("calibration   : none run in this process")
+        else:
+            _p(f"calibration   : mode={calib['mode']} "
+               f"bins={calib['num_bins']} examples={calib['examples']} "
+               f"({calib['batches']} batches)")
+            for tname, rec in sorted(calib["tensors"].items()):
+                if "threshold" in rec:
+                    _p(f"  {tname:<28s} th={rec['threshold']:g} "
+                       f"kl={rec['kl_divergence']:g} seen="
+                       f"[{rec['min_seen']:g}, {rec['max_seen']:g}] "
+                       f"bins={rec['bins']}")
+                else:
+                    _p(f"  {tname:<28s} range=[{rec.get('min')}, "
+                       f"{rec.get('max')}]")
+        census = quant.last_quantization()
+        out["last_pass"] = census
+        if census is None:
+            _p("graph pass    : none run in this process")
+        else:
+            _p(f"graph pass    : {census['granularity']} — "
+               f"{census['per_channel']} per-channel + "
+               f"{census['per_tensor']} per-tensor weights; ops "
+               f"{census['ops']}")
+        from mxnet_tpu import serving
+
+        int8_models = {}
+        for srv in serving.live_stats():
+            for name, m in srv.get("models", {}).items():
+                if m.get("weight_dtype") == "int8":
+                    int8_models[name] = {
+                        "buckets": m.get("buckets"),
+                        "bucket_census": m.get("bucket_census"),
+                        "completed": m.get("completed")}
+        out["live_int8_models"] = int8_models
+        if not int8_models:
+            _p("int8 serving  : no live int8 models in this process")
+        for name, m in int8_models.items():
+            _p(f"int8 model    : {name} ladder={m['buckets']} "
+               f"census={m['bucket_census']} completed={m['completed']}")
+        from mxnet_tpu import compile as _compile
+
+        sstats = _compile.stats().get("serving")
+        out["serving_compile"] = sstats
+        if sstats:
+            _p(f"serving site  : hits={sstats.get('hits')} "
+               f"misses={sstats.get('misses')} "
+               f"disk_hits={sstats.get('disk_hits')} (disk hits = the "
+               "ladder warmed from the persistent cache)")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("quantization import failed:", e)
+    return out
+
+
 SECTIONS = (
     ("python", check_python),
     ("pip", check_pip),
@@ -705,6 +775,7 @@ SECTIONS = (
     ("analysis", check_analysis),
     ("compile_cache", check_compile_cache),
     ("serving", check_serving),
+    ("quantization", check_quantization),
     ("watchdog", check_watchdog),
     ("preempt", check_preempt),
     ("gang", check_gang),

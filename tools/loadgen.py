@@ -28,6 +28,12 @@ default      in-process ModelServer over --models small MLPs
 --via-http   same server, but driven through the JSON/HTTP front end
              (socket path exercised end to end)
 --url URL    an already-running external front end
+--dtype D    model-pair mode: ONE embedding-lookup fixture served as
+             fp32 and as its entropy-calibrated int8 twin from the same
+             warm ladder; ``--dtype both`` drives each variant with the
+             identical closed loop and prints the matched-p99
+             int8-vs-float rps ratio as one JSON line (the ROADMAP
+             item-4 acceptance measurement)
 
 Examples::
 
@@ -79,6 +85,184 @@ def _percentiles(lats):
     return {k: (round(percentile(lats, q), 3)
                 if percentile(lats, q) is not None else None)
             for q, k in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms"))}
+
+
+# ------------------------------------------------- int8-vs-float pair mode --
+
+def build_pair_container(vocab=50_000, embed_dim=512, seq_len=1024,
+                         seed=0, calib_mode="entropy",
+                         calib_examples=64, buckets=None,
+                         granularity="channel-wise"):
+    """The int8-vs-float fixture: ONE embedding-lookup model served
+    twice — as fp32 and as its ``contrib.quantization`` int8 twin — in a
+    single container/ladder.
+
+    The model is an embedding-lookup service (request: a bag of ids;
+    response: the table rows) — the feature-store / two-tower-retrieval
+    serving pattern, and the workload where int8 pays on EVERY backend:
+    the table gather is memory-bandwidth-bound and int8 storage moves
+    and ships 4x fewer bytes (the int8 variant responds with the int8
+    rows; the per-tensor dequantize scale is a static model constant,
+    reported in the pair meta, that clients apply lazily — the
+    weights-only serving contract). On the MXU quantized conv/dense
+    additionally run at 2x the bf16 rate; this CPU jaxlib scalarizes
+    every int8 elementwise/GEMM kernel, so compute-bound fixtures
+    cannot show the serving win there (docs/PERFORMANCE.md "Int8
+    inference" walks the whole story).
+
+    The int8 twin comes out of the full quantize_model pipeline
+    (entropy calibration included); its serving graph is the quantized
+    graph's int8 gather output — ``internals["<name>_output0"]`` —
+    i.e. the rows BEFORE the dequantize that a pooled classifier would
+    fuse downstream.
+    """
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.contrib import quantization as quant
+
+    rng = np.random.RandomState(seed)
+    data = mx.sym.var("data")
+    sym = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed_dim,
+                           name="pair_embed")
+    args = {
+        "pair_embed_weight": mx.nd.array(
+            (rng.randn(vocab, embed_dim) * 0.05).astype(np.float32)),
+    }
+    calib = rng.randint(0, vocab, (calib_examples, seq_len)) \
+        .astype(np.float32)
+    it = mx.io.NDArrayIter(calib, batch_size=32, label_name=None)
+    qfull, qargs, _ = quant.quantize_model(
+        sym, args, {}, data_names=("data",), calib_data=it,
+        calib_mode=calib_mode, quantize_granularity=granularity)
+    # serve the int8 rows themselves (output 0 of the quantized gather)
+    qsym = qfull.get_internals()["pair_embed_output0"]
+    scale = float(qargs["pair_embed_weight_max"].asnumpy()[0]) / 127.0
+    container = serving.ModelContainer()
+    container.add_symbol("emblookup_float32", sym, args,
+                         example_shape=(seq_len,), buckets=buckets)
+    container.add_symbol("emblookup_int8", qsym, qargs,
+                         example_shape=(seq_len,), buckets=buckets)
+    meta = {"vocab": vocab, "embed_dim": embed_dim, "seq_len": seq_len,
+            "calib_mode": calib_mode, "granularity": granularity,
+            "seed": seed, "int8_dequantize_scale": round(scale, 9)}
+    return container, meta
+
+
+def _drive_closed(server, names, pool, duration, concurrency):
+    """One closed-loop drive (the run_inproc worker loop, reusable per
+    variant): returns (sorted latencies ms, completed, rejected, errors,
+    elapsed seconds)."""
+    from mxnet_tpu import serving
+
+    lock = threading.Lock()
+    lats, completed, rejected, errors = [], [0], [0], []
+    stop_at = time.perf_counter() + duration
+
+    def worker(tid):
+        i = 0
+        while time.perf_counter() < stop_at:
+            name = names[(tid + i) % len(names)]
+            x = pool[(tid * 7 + i) % len(pool)]
+            t0 = time.perf_counter()
+            try:
+                server.submit(name, x).result(10.0)
+                with lock:
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                    completed[0] += 1
+            except serving.ServerBusyError:
+                with lock:
+                    rejected[0] += 1
+                time.sleep(0.001)
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                if len(errors) > 100:
+                    return
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 30.0)
+    return sorted(lats), completed[0], rejected[0], errors, \
+        time.perf_counter() - t_start
+
+
+def run_pair(duration=20.0, concurrency=16, vocab=50_000, embed_dim=512,
+             seq_len=1024, seed=0, calib_mode="entropy", warmup=True,
+             variants=("float32", "int8"), buckets=None, max_wait_ms=0.5):
+    """Drive the float and int8 variants of the SAME model through one
+    warm server, sequentially, with the identical closed-loop harness —
+    the int8-vs-float acceptance measurement. Returns one JSON-able
+    report with per-variant rps/percentiles, the rps ratio, whether the
+    p99s matched (int8 must not buy throughput with a worse tail), the
+    int8 ladder's bucket census and ``recompiles_during_run`` (must be 0
+    on a warm server — the int8 ladder compiles/loads at warmup, never
+    under traffic)."""
+    import numpy as np
+
+    from mxnet_tpu import compile as _compile
+    from mxnet_tpu import serving
+
+    container, meta = build_pair_container(
+        vocab=vocab, embed_dim=embed_dim, seq_len=seq_len, seed=seed,
+        calib_mode=calib_mode, buckets=buckets)
+    # a tight admission window: the A/B measures the MODEL, not the
+    # collector's idle batching wait (under the saturating closed loop
+    # batches fill and launch immediately anyway)
+    server = serving.ModelServer(container, max_wait_ms=max_wait_ms).start()
+    if warmup:
+        server.warmup()
+    pre_misses = _compile.stats().get("serving", {}).get("misses", 0)
+    pool = [np.random.RandomState(seed + i)
+            .randint(0, vocab, (1, seq_len)).astype(np.float32)
+            for i in range(64)]
+    per_variant = duration / max(len(variants), 1)
+    sides = {}
+    for variant in variants:
+        name = f"emblookup_{variant}"
+        lats, completed, rejected, errors, elapsed = _drive_closed(
+            server, [name], pool, per_variant, concurrency)
+        side = {"completed": completed, "rejected": rejected,
+                "errors": len(errors), "first_errors": errors[:3],
+                "duration_s": round(elapsed, 2),
+                "rps": round(completed / elapsed, 1) if elapsed else 0.0}
+        side.update(_percentiles(lats))
+        sides[variant] = side
+    post_misses = _compile.stats().get("serving", {}).get("misses", 0)
+    stats = server.stats()["models"]
+    report = {
+        "harness": "loadgen-pair",
+        "model": "emblookup",
+        "mode": "closed",
+        "concurrency": concurrency,
+        "variants": sides,
+        "recompiles_during_run": post_misses - pre_misses,
+        "weight_dtype_int8": stats.get("emblookup_int8", {})
+        .get("weight_dtype"),
+        "bucket_census_int8": stats.get("emblookup_int8", {})
+        .get("bucket_census"),
+        **meta,
+    }
+    f32, i8 = sides.get("float32"), sides.get("int8")
+    if f32 and i8 and f32["rps"]:
+        report["rps_float32"] = f32["rps"]
+        report["rps_int8"] = i8["rps"]
+        report["rps_ratio_int8_vs_float"] = round(i8["rps"] / f32["rps"], 3)
+        report["p99_float32_ms"] = f32.get("p99_ms")
+        report["p99_int8_ms"] = i8.get("p99_ms")
+        # matched p99: the int8 rps win must come at an equal-or-better
+        # tail, not by trading latency for throughput
+        report["matched_p99"] = bool(
+            f32.get("p99_ms") and i8.get("p99_ms")
+            and i8["p99_ms"] <= f32["p99_ms"] * 1.05)
+    server.drain(timeout=10.0)
+    return report
 
 
 _PHASES = ("queue_wait", "batch_collect", "h2d", "compute", "respond",
@@ -395,7 +579,42 @@ def main(argv=None):
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the pre-traffic bucket warmup (recompiles "
                          "will then land inside the measured window)")
+    ap.add_argument("--dtype", choices=("float32", "int8", "both"),
+                    default=None,
+                    help="model-pair mode: serve the embedding-lookup "
+                         "fixture as fp32 AND its entropy-calibrated int8 "
+                         "twin; 'both' drives each for duration/2 with the "
+                         "same harness and prints the matched-p99 rps "
+                         "ratio as one JSON line")
+    ap.add_argument("--pair-vocab", type=int, default=50_000,
+                    help="pair-mode embedding vocab (table size drives "
+                         "the bandwidth win)")
+    ap.add_argument("--pair-embed-dim", type=int, default=512)
+    ap.add_argument("--pair-seq-len", type=int, default=1024)
+    ap.add_argument("--calib-mode", default="entropy",
+                    choices=("entropy", "naive", "percentile"),
+                    help="pair-mode calibration mode for the int8 twin")
     args = ap.parse_args(argv)
+
+    if args.dtype:
+        variants = ("float32", "int8") if args.dtype == "both" \
+            else (args.dtype,)
+        report = run_pair(
+            duration=args.duration, concurrency=args.concurrency,
+            vocab=args.pair_vocab, embed_dim=args.pair_embed_dim,
+            seq_len=args.pair_seq_len, calib_mode=args.calib_mode,
+            warmup=not args.no_warmup, variants=variants)
+        ratio = report.get("rps_ratio_int8_vs_float")
+        print("loadgen pair: " + ", ".join(
+            f"{v}: {s['rps']} req/s p99 {s.get('p99_ms')}ms"
+            for v, s in report["variants"].items()) +
+            (f" -> int8/float = {ratio}x "
+             f"(matched_p99={report.get('matched_p99')})"
+             if ratio is not None else ""),
+            file=sys.stderr, flush=True)
+        print(json.dumps(report), flush=True)
+        errs = sum(s["errors"] for s in report["variants"].values())
+        return 0 if errs == 0 else 1
 
     if args.url:
         report = run_http(args.url, duration=args.duration,
