@@ -37,6 +37,11 @@ follows it (same harness in ``--dtype both`` pair mode,
 BENCH_SERVE_INT8_SECONDS, default 16): the embedding-lookup fixture
 served fp32 AND entropy-calibrated int8 from one warm ladder, recording
 the matched-p99 int8-vs-float rps ratio every round (ROADMAP item 4).
+A ``serving_fleet_rps_*`` line follows (``loadgen --workers`` through
+the ServingFleet router at workers=1 and workers=4;
+BENCH_FLEET_WORKERS/_SECONDS): the N-worker rps with ``rps_1worker``
+and ``scaling_efficiency`` = rpsN/(N·rps1) — the multi-process scaling
+trajectory. BENCH_SKIP_SERVE=1 skips all three.
 
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
 BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
@@ -132,6 +137,7 @@ def main(argv=None):
     if args.serve_only:
         bench_serve()
         bench_serve_int8()
+        bench_serve_fleet()
         return
     if args.dataplane_only:
         bench_dataplane()
@@ -228,6 +234,10 @@ def main(argv=None):
     if args.serve or not os.environ.get("BENCH_SKIP_SERVE"):
         bench_serve()
         bench_serve_int8()
+        # the fleet line: 1-worker vs N-worker rps through the router
+        # (serving_fleet_rps_*, scaling_efficiency) — the PR 15
+        # near-linear-scaling trajectory
+        bench_serve_fleet()
     # the host data-plane line tracks the streaming input pipeline
     # (native fused decode+augment img/s + trainer data_wait);
     # BENCH_SKIP_DATAPLANE=1 opts out
@@ -375,6 +385,54 @@ def bench_serve():
         "batch_fill_ratio": rep.get("batch_fill_ratio"),
         "rejected": rep.get("rejected"),
         "recompiles_during_run": rep.get("recompiles_during_run"),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_serve_fleet():
+    """Serving-fleet throughput: ``tools/loadgen.py --workers N``
+    (closed loop through the router against N ModelServer worker
+    processes) at workers=1 and workers=N, emitting ONE line whose
+    value is the N-worker rps with ``rps_1worker`` and
+    ``scaling_efficiency`` = rpsN / (N * rps1) alongside — the
+    near-linear 1→N scaling trajectory BENCH_r06+ tracks. The measured
+    number is recorded either way; on a < N-core host the efficiency is
+    honest about the floor it ran on (``cores`` rides in the line).
+    Env knobs: BENCH_FLEET_WORKERS (default 4), BENCH_FLEET_SECONDS
+    (default 10 per census), BENCH_SERVE_CONCURRENCY (16)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import loadgen
+
+    import jax
+
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", 4))
+    duration = float(os.environ.get("BENCH_FLEET_SECONDS", 10))
+    concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY", 16))
+    rep1 = loadgen.run_fleet(workers=1, duration=duration,
+                             concurrency=concurrency)
+    repn = loadgen.run_fleet(workers=workers, duration=duration,
+                             concurrency=concurrency)
+    rps1, rpsn = rep1.get("rps") or 0.0, repn.get("rps") or 0.0
+    line = {
+        "metric": f"serving_fleet_rps_{workers}worker_closed{concurrency}",
+        "value": rpsn,
+        "unit": "req/s",
+        "workers": workers,
+        "rps_1worker": rps1,
+        "scaling_efficiency": round(rpsn / (workers * rps1), 3)
+        if rps1 else None,
+        "duration_s": repn.get("duration_s"),
+        "p50_ms": repn.get("p50_ms"),
+        "p99_ms": repn.get("p99_ms"),
+        "router_retries": repn.get("router", {}).get("retries"),
+        "rejected": repn.get("rejected"),
+        "reconnects": repn.get("reconnects"),
+        "connect_ms_mean": repn.get("connect_ms_mean"),
+        "cores": os.cpu_count(),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(_compile_fields(line)), flush=True)

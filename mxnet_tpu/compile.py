@@ -462,10 +462,42 @@ def _disk_key(canon):
     return hashlib.sha1(canon.encode()).hexdigest()
 
 
+#: framed .bin layout: magic + 4-byte meta length + meta json + payload.
+#: The CRC meta rides INSIDE the payload file so a load never depends on
+#: the .bin/.json pairing — two processes cold-compiling the same key
+#: concurrently serialize non-identical bytes, and interleaved renames
+#: of separate bin/json files could otherwise leave a permanently
+#: mismatched pair (payload from writer B, checksum from writer A).
+#: The .json sidecar remains for gc/diagnose introspection.
+_FRAME_MAGIC = b"MXTC1"
+
+
+def _frame(meta_bytes, payload):
+    return (_FRAME_MAGIC + len(meta_bytes).to_bytes(4, "big")
+            + meta_bytes + payload)
+
+
+def _unframe(blob):
+    """-> (embedded meta | None, payload | None). A legacy (unframed)
+    file returns ``(None, blob)``; a mangled frame returns
+    ``(None, None)``."""
+    if not blob.startswith(_FRAME_MAGIC):
+        return None, blob
+    try:
+        n = int.from_bytes(blob[5:9], "big")
+        meta = json.loads(blob[9:9 + n].decode())
+        if not isinstance(meta, dict):
+            return None, None
+        return meta, blob[9 + n:]
+    except (ValueError, UnicodeDecodeError):
+        return None, None
+
+
 def _disk_store(key, compiled, site, canon, spec_args):
-    """Serialize one compiled executable + CRC sidecar. Best effort: any
-    failure (unpicklable out-tree, full disk) leaves the in-memory entry
-    working and the site on the compile path."""
+    """Serialize one compiled executable: a self-verifying framed .bin
+    (embedded CRC meta) + a .json sidecar for gc/diagnose. Best effort:
+    any failure (unpicklable out-tree, full disk) leaves the in-memory
+    entry working and the site on the compile path."""
     try:
         from jax.experimental import serialize_executable as se
 
@@ -478,10 +510,11 @@ def _disk_store(key, compiled, site, canon, spec_args):
             "size": len(payload), "site": site, "canon": canon,
             "fingerprint": fingerprint(), "created": time.time(),
             "args": spec_args}
+    meta_bytes = json.dumps(meta, sort_keys=True).encode()
     try:
-        _atomic_write_bytes(os.path.join(d, key + ".bin"), payload)
-        _atomic_write_bytes(os.path.join(d, key + ".json"),
-                            json.dumps(meta, sort_keys=True).encode())
+        _atomic_write_bytes(os.path.join(d, key + ".bin"),
+                            _frame(meta_bytes, payload))
+        _atomic_write_bytes(os.path.join(d, key + ".json"), meta_bytes)
     except OSError:
         return False
     return True
@@ -490,20 +523,29 @@ def _disk_store(key, compiled, site, canon, spec_args):
 def _disk_load(key, st):
     """Load + CRC-verify + deserialize one entry; None on any mismatch or
     failure (the corrupt counter distinguishes checksum failures, which
-    the caller resolves by recompiling — and eventually GC'ing)."""
+    the caller resolves by recompiling — and eventually GC'ing). The CRC
+    comes from the meta embedded in the framed .bin; the .json sidecar
+    is only the fallback for legacy (unframed) entries."""
     d = _exec_dir()
-    jpath = os.path.join(d, key + ".json")
     bpath = os.path.join(d, key + ".bin")
     try:
-        with open(jpath, "rb") as f:
-            meta = json.loads(f.read().decode())
         with open(bpath, "rb") as f:
-            payload = f.read()
-    except (OSError, ValueError):
+            blob = f.read()
+    except OSError:
         return None
     # 'compile.load' injection point: corrupt mode flips entry bytes so
     # the CRC fallback is deterministically testable
-    payload = _faults.point("compile.load", payload)
+    blob = _faults.point("compile.load", blob)
+    meta, payload = _unframe(blob)
+    if payload is None:
+        st[6] += 1
+        return None
+    if meta is None:  # legacy unframed entry: the sidecar carries the CRC
+        try:
+            with open(os.path.join(d, key + ".json"), "rb") as f:
+                meta = json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None
     if len(payload) != meta.get("size") or \
             (zlib.crc32(payload) & 0xFFFFFFFF) != meta.get("crc32"):
         st[6] += 1
@@ -585,13 +627,21 @@ def gc_cache():
                 continue
             bpath = path[:-5] + ".bin"
             try:
-                with open(path, "rb") as f:
-                    meta = json.loads(f.read().decode())
                 with open(bpath, "rb") as f:
-                    payload = f.read()
-                ok = (len(payload) == meta.get("size") and
-                      (zlib.crc32(payload) & 0xFFFFFFFF)
-                      == meta.get("crc32"))
+                    blob = f.read()
+                emeta, payload = _unframe(blob)
+                if payload is None:
+                    ok = False
+                else:
+                    # framed entries self-verify; legacy ones fall back
+                    # to the sidecar CRC
+                    meta = emeta
+                    if meta is None:
+                        with open(path, "rb") as f:
+                            meta = json.loads(f.read().decode())
+                    ok = (len(payload) == meta.get("size") and
+                          (zlib.crc32(payload) & 0xFFFFFFFF)
+                          == meta.get("crc32"))
             except (OSError, ValueError):
                 ok = False
             if not ok:

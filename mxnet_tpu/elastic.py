@@ -46,6 +46,15 @@ Three cooperating pieces:
   that process exit code, so the supervisor sees a ladder code instead of
   the interpreter's generic 1.
 
+* :class:`ServingSupervisor` — the **serving mode** of the same
+  machinery (``mxnet_tpu.serving.fleet`` drives it): slots restart
+  *individually* instead of gang-wide, a deliberately drained worker
+  (exit 75 after :meth:`ServingSupervisor.drain_slot` — rollout /
+  scale-down) is retired rather than restarted, and slot ids are never
+  reused so two model generations can overlap during a zero-downtime
+  rollout. Heartbeat files, telemetry shards, the exit-code ladder and
+  the liveness kill are shared verbatim with the gang path.
+
 Environment knobs (supervisor side, CLI flags override)::
 
     MXNET_TPU_GANG_MAX_RESTARTS   restart budget across the run (default 5)
@@ -91,7 +100,8 @@ from . import watchdog as _watchdog
 from .telemetry import fleet as _fleet
 from .telemetry import flight as _flight
 
-__all__ = ["GangSupervisor", "RESTARTABLE_EXITS", "STATES", "STATE_CODES",
+__all__ = ["GangSupervisor", "ServingSupervisor", "RESTARTABLE_EXITS",
+           "STATES", "STATE_CODES",
            "GANG_STATS", "start_heartbeat", "stop_heartbeat",
            "read_heartbeats", "kill_peer", "install_excepthook",
            "uninstall_excepthook", "maybe_install_from_env", "describe"]
@@ -864,6 +874,312 @@ class GangSupervisor:
     def stop(self):
         """Request a graceful gang drain (same as SIGTERM)."""
         self._stop_signals += 1
+
+
+# ------------------------------------------------- serving supervision ----
+
+#: per-slot lifecycle states of a serving-mode supervisor
+SLOT_STARTING = "starting"
+SLOT_RUNNING = "running"
+SLOT_DRAINING = "draining"     # deliberate drain requested (SIGTERM sent)
+SLOT_BACKOFF = "backoff"       # crashed; restart scheduled
+SLOT_FAILED = "failed"         # restart budget exhausted
+
+
+class ServingSupervisor:
+    """Serving-mode supervision: the fleet's process plane.
+
+    The gang supervisor above restarts the WHOLE gang when one rank dies
+    (training is a lockstep collective — a lost rank invalidates every
+    survivor's step). Serving workers are independent replicas, so the
+    policy inverts: each **slot** restarts individually, the others keep
+    answering traffic, and a *deliberate* drain (rollout, scale-down)
+    removes the slot instead of restarting it.
+
+    Reuses the gang plumbing wholesale: workers get ``MXTPU_GANG_DIR`` /
+    ``MXTPU_WORKER_ID`` / ``MXTPU_GANG_GENERATION`` so the heartbeat
+    daemon + telemetry shard + exit-code excepthook arm themselves at
+    ``import mxnet_tpu``; exits are classified through the same ladder
+    (:func:`mxnet_tpu.preempt.canonical_exit`); heartbeat-silent live
+    processes are declared dead and SIGKILLed exactly like gang ranks.
+
+    Restart policy per serving semantics:
+
+    * exit 75 on a slot marked draining — the **expected** drained-worker
+      exit: the slot is retired (rollout/scale-down/stop), not restarted;
+    * any other exit (ladder or not: a serving replica crashing with a
+      real error should still come back — availability first) — restart
+      the slot in place with exponential backoff, budgeted per slot;
+      an exhausted budget parks the slot as ``failed`` with an event,
+      it never flaps forever.
+
+    Slot ids are **globally unique and never reused** (the fleet hands
+    out a fresh id per spawn), so two generations can run side by side
+    during a rollout without their ``rank-<r>.json`` heartbeat or
+    telemetry shard files colliding.
+
+    ``command_for(slot, generation)`` builds each worker's argv — the
+    seam the fleet uses to point generation N+1 at a new model dir.
+    Everything here is driven by :meth:`poll` from the owner's monitor
+    loop; nothing blocks.
+    """
+
+    def __init__(self, command_for, run_dir, *, grace=None, dead_after=None,
+                 backoff=None, backoff_cap=None, max_restarts=None,
+                 env=None, cwd=None, popen=None):
+        self.command_for = command_for
+        self.run_dir = os.fspath(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.crash_dir = os.path.join(self.run_dir, "crash")
+        self.grace = (_env_float("MXNET_TPU_GANG_GRACE", 10.0)
+                      if grace is None else float(grace))
+        self.dead_after = (_env_float("MXNET_TPU_GANG_DEAD_S", 60.0)
+                           if dead_after is None else float(dead_after))
+        self.backoff = (_env_float("MXNET_TPU_GANG_BACKOFF", 0.5)
+                        if backoff is None else float(backoff))
+        self.backoff_cap = (_env_float("MXNET_TPU_GANG_BACKOFF_CAP", 30.0)
+                            if backoff_cap is None else float(backoff_cap))
+        self.max_restarts = (_env_int("MXNET_TPU_GANG_MAX_RESTARTS", 5)
+                             if max_restarts is None else int(max_restarts))
+        self.extra_env = dict(env or {})
+        self.cwd = cwd
+        self._popen = popen or subprocess.Popen
+        self._lock = threading.Lock()
+        self.slots = {}            # slot -> record dict
+        self.events = []           # lifecycle history (bounded)
+        self.restarts_total = 0
+        self.drained_total = 0
+
+    # ------------------------------------------------------------- spawn --
+    def _worker_env(self, slot, generation):
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env["MXTPU_GANG_DIR"] = self.run_dir
+        env["MXTPU_WORKER_ID"] = str(slot)
+        env["MXTPU_GANG_GENERATION"] = str(generation)
+        # serving workers are independent replicas: no rendezvous
+        env.pop("MXTPU_COORDINATOR", None)
+        env.setdefault("MXNET_TPU_CRASH_DIR", self.crash_dir)
+        env.setdefault("MXNET_TPU_PREEMPT_DIR", self.run_dir)
+        # SIGTERM must DRAIN the worker (answer everything admitted,
+        # exit 75), never kill it mid-batch
+        env.setdefault("MXNET_TPU_PREEMPT", "1")
+        return env
+
+    def _event(self, kind, slot, detail="", **extra):
+        rec = {"t_wall": time.time(), "kind": kind, "slot": int(slot),
+               "detail": detail}
+        rec.update(extra)
+        with self._lock:
+            self.events.append(rec)
+            del self.events[:-512]
+        _flight.rec(f"fleet.{kind}", f"slot{slot}", detail)
+        return rec
+
+    def spawn(self, slot, generation):
+        """Start one worker in `slot` (a fresh, never-reused id)."""
+        slot = int(slot)
+        proc = self._popen(self.command_for(slot, generation),
+                           env=self._worker_env(slot, generation),
+                           cwd=self.cwd)
+        with self._lock:
+            self.slots[slot] = {
+                "slot": slot, "generation": int(generation), "proc": proc,
+                "pid": proc.pid, "state": SLOT_STARTING,
+                "spawned": time.time(), "restarts": 0, "exit_code": None,
+                "restart_at": None, "liveness_killed": False}
+        self._event("spawn", slot, f"gen{generation} pid {proc.pid}")
+        _logger.info("fleet: slot %d spawned (generation %d, pid %d)",
+                     slot, generation, proc.pid)
+        return self.slots[slot]
+
+    # ------------------------------------------------------------- drain --
+    def drain_slot(self, slot, reason="drain"):
+        """Deliberately retire `slot`: SIGTERM (its preempt handler
+        answers everything admitted and exits 75); the reap removes the
+        slot instead of restarting. Stragglers past the grace deadline
+        are SIGKILLed by :meth:`poll`. A slot with no live process
+        (backoff / failed) is retired on the spot."""
+        with self._lock:
+            rec = self.slots.get(int(slot))
+            if rec is None or rec["state"] == SLOT_DRAINING:
+                return rec
+            proc = rec.get("proc")
+            if proc is None:   # nothing running: retire immediately
+                self.slots.pop(int(slot), None)
+                self.drained_total += 1
+            else:
+                rec["state"] = SLOT_DRAINING
+                rec["drain_reason"] = reason
+                rec["drain_deadline"] = time.monotonic() + self.grace
+        if proc is None:
+            self._event("drained", slot,
+                        f"retired while not running ({reason})",
+                        exit_code=rec.get("exit_code"),
+                        generation=rec["generation"])
+            return rec
+        self._event("drain", slot, reason)
+        _kill_quietly(proc, _signal.SIGTERM)
+        return rec
+
+    def kill_slot(self, slot):
+        """SIGKILL a slot's process (tests / chaos); the ladder reap and
+        the per-slot restart policy take over."""
+        with self._lock:
+            rec = self.slots.get(int(slot))
+            proc = rec.get("proc") if rec else None
+        if proc is not None:
+            _kill_quietly(proc, _signal.SIGKILL)
+        return rec
+
+    # -------------------------------------------------------------- poll --
+    def _reap_one(self, slot, rec, code):
+        kind = _preempt.classify_exit(code)
+        rec["exit_code"] = code
+        deliberate = rec["state"] == SLOT_DRAINING
+        if deliberate and code in (0, _preempt.DRAIN_EXIT_CODE):
+            with self._lock:
+                self.slots.pop(slot, None)
+                self.drained_total += 1
+            self._event("drained", slot,
+                        f"exit {code} ({rec.get('drain_reason')})",
+                        exit_code=code, generation=rec["generation"])
+            _logger.info("fleet: slot %d drained (exit %d)", slot, code)
+            return
+        if deliberate:
+            # it ignored the drain and died some other way; still retired
+            with self._lock:
+                self.slots.pop(slot, None)
+                self.drained_total += 1
+            self._event("drain_killed", slot, f"exit {code} ({kind})",
+                        exit_code=code, generation=rec["generation"])
+            _logger.warning("fleet: draining slot %d exited %d (%s)",
+                            slot, code, kind)
+            return
+        # an unrequested death: restart in place, budgeted, backed off
+        if rec["restarts"] >= self.max_restarts:
+            rec["state"] = SLOT_FAILED
+            rec["proc"] = None
+            self._event("slot_failed", slot,
+                        f"exit {code} ({kind}); budget "
+                        f"{rec['restarts']}/{self.max_restarts} exhausted",
+                        exit_code=code)
+            _logger.error("fleet: slot %d FAILED — exit %d (%s), restart "
+                          "budget exhausted", slot, code, kind)
+            return
+        delay = min(self.backoff_cap,
+                    self.backoff * (2 ** rec["restarts"]))
+        rec["restarts"] += 1
+        rec["state"] = SLOT_BACKOFF
+        rec["proc"] = None
+        rec["restart_at"] = time.monotonic() + delay
+        with self._lock:
+            self.restarts_total += 1
+        why = "heartbeat-lost" if rec.pop("liveness_killed", False) \
+            else f"exit {code} ({kind})"
+        self._event("restart", slot,
+                    f"{why}; restart {rec['restarts']}/"
+                    f"{self.max_restarts} in {delay:.1f}s",
+                    exit_code=code)
+        _logger.warning("fleet: slot %d died (%s) — restart %d/%d in "
+                        "%.1fs", slot, why, rec["restarts"],
+                        self.max_restarts, delay)
+
+    def _check_heartbeats(self):
+        if not self.dead_after:
+            return
+        beats = read_heartbeats(self.run_dir)
+        for slot, rec in list(self.slots.items()):
+            proc = rec.get("proc")
+            if proc is None or rec["state"] == SLOT_DRAINING:
+                continue
+            hb = beats.get(slot)
+            if hb is None or hb.get("generation") != rec["generation"]:
+                continue  # never beat (or stale): the exit path owns it
+            if hb.get("age_s", 0.0) > self.dead_after:
+                rec["liveness_killed"] = True
+                self._event("heartbeat_lost", slot,
+                            f"{hb.get('age_s'):.1f}s silent")
+                _logger.error("fleet: slot %d heartbeat silent %.1fs — "
+                              "SIGKILL", slot, hb.get("age_s", 0.0))
+                _kill_quietly(proc, _signal.SIGKILL)
+
+    def poll(self):
+        """One supervision pass: reap exits (apply the per-slot restart
+        policy), escalate drain stragglers, kill heartbeat-dead workers,
+        respawn slots whose backoff expired. Returns the live census
+        ``{slot: record}`` (no Popen objects)."""
+        now = time.monotonic()
+        for slot, rec in list(self.slots.items()):
+            proc = rec.get("proc")
+            if proc is not None:
+                rc = proc.poll()
+                if rc is not None:
+                    self._reap_one(slot, rec, _preempt.canonical_exit(rc))
+                    continue
+                if rec["state"] == SLOT_STARTING:
+                    rec["state"] = SLOT_RUNNING
+                if rec["state"] == SLOT_DRAINING and \
+                        now >= rec.get("drain_deadline", now):
+                    _logger.error("fleet: draining slot %d ignored the "
+                                  "grace deadline — SIGKILL", slot)
+                    rec["drain_deadline"] = now + self.grace
+                    _kill_quietly(proc, _signal.SIGKILL)
+            elif rec["state"] == SLOT_BACKOFF and \
+                    now >= (rec.get("restart_at") or 0):
+                gen = rec["generation"]
+                restarts = rec["restarts"]
+                newrec = self.spawn(slot, gen)
+                newrec["restarts"] = restarts
+        self._check_heartbeats()
+        return self.census()
+
+    # ------------------------------------------------------------- state --
+    def census(self):
+        """{slot: record-without-Popen} of every tracked slot."""
+        out = {}
+        with self._lock:
+            for slot, rec in self.slots.items():
+                r = {k: v for k, v in rec.items() if k != "proc"}
+                r["alive"] = rec.get("proc") is not None \
+                    and rec["proc"].poll() is None
+                out[slot] = r
+        return out
+
+    def alive(self):
+        """Slots with a live process right now."""
+        return {s: r for s, r in self.census().items() if r["alive"]}
+
+    def stop_all(self, graceful=True, timeout=None):
+        """Retire every slot: drain (SIGTERM) then SIGKILL stragglers
+        after the grace deadline; returns when all are reaped or
+        `timeout` (default grace + 5s) expires. With ``graceful=False``
+        slots are still MARKED draining before the SIGKILL — a stop
+        must retire them, never trip the restart policy."""
+        for slot in list(self.slots):
+            self.drain_slot(slot, reason="stop")
+            if not graceful:
+                self.kill_slot(slot)
+        deadline = time.monotonic() + (self.grace + 5.0
+                                       if timeout is None else timeout)
+        while self.slots and time.monotonic() < deadline:
+            self.poll()
+            if self.slots:
+                time.sleep(0.05)
+        for slot in list(self.slots):  # drainless stragglers
+            self.kill_slot(slot)
+            self.poll()
+        return not self.slots
+
+    def describe(self):
+        """JSON-able supervisor state (fleet.json / diagnose)."""
+        return {"run_dir": self.run_dir, "grace": self.grace,
+                "dead_after": self.dead_after, "backoff": self.backoff,
+                "max_restarts": self.max_restarts,
+                "restarts_total": self.restarts_total,
+                "drained_total": self.drained_total,
+                "slots": self.census(),
+                "events": list(self.events[-64:])}
 
 
 def _kill_quietly(proc, sig):

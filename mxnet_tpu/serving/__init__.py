@@ -23,6 +23,12 @@ users") asks for, built from the pieces the stack already has:
   (answer everything admitted, exit 75 via ``preempt``).
 * **HttpFrontEnd** (``http.py``) — a small JSON-over-HTTP front so
   external clients / ``tools/loadgen.py``'s socket mode can drive it.
+* **ServingFleet** (``fleet.py`` + ``worker.py``) — N worker processes
+  behind one router front door: serving-mode supervision (per-slot
+  restart via the exit-code ladder), least-loaded / consistent-hash
+  routing with retry-on-connection-refused, telemetry-driven
+  autoscaling, and zero-downtime model rollout warmed from the
+  persistent compile cache (docs/SERVING.md "Fleet").
 
 Robust by construction: every in-flight batch runs under a
 ``watchdog.sync("serving.batch", ...)`` deadline (a hung batch produces
@@ -58,7 +64,7 @@ __all__ = [
     "ServerDrainingError", "RequestError", "RequestTimeout",
     "ModelMetrics", "ModelContainer", "ServedModel", "BucketBatcher",
     "ServingFuture", "ModelServer", "live_servers", "live_stats",
-    "HttpFrontEnd",
+    "HttpFrontEnd", "ServingFleet", "FleetError",
 ]
 
 
@@ -67,4 +73,8 @@ def __getattr__(name):
         from .http import HttpFrontEnd
 
         return HttpFrontEnd
+    if name in ("ServingFleet", "FleetError"):  # fleet: same laziness
+        from . import fleet as _fleet_mod
+
+        return getattr(_fleet_mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1289 @@
+"""ServingFleet: N ModelServer workers behind one router front door.
+
+The ps-lite scheduler/server split (SURVEY §L7) replayed for inference:
+one `ModelServer` process sustains thousands of req/s (PR 8), "millions
+of users" needs N of them behind one address. Everything here composes
+pieces the stack already has:
+
+* **process plane** — a serving-mode supervisor
+  (:class:`mxnet_tpu.elastic.ServingSupervisor`): per-slot restart with
+  backoff, heartbeat liveness kills, the exit-code ladder; exit 75 on a
+  deliberately drained slot retires it (rollout / scale-down) instead of
+  restarting;
+* **router** — an HTTP front end dispatching ``POST
+  /v1/models/<m>:predict`` to workers over persistent (keep-alive)
+  upstream connections. Placement: **least-loaded** (live queue depth
+  from each worker's telemetry shard, falling back to round-robin when
+  shards are missing/stale), **consistent-hash-by-model** (a vnode hash
+  ring — a worker-set change only remaps the keys the lost worker
+  owned), or plain round-robin. A connection-refused/reset upstream (a
+  dying worker) is retried on a different worker — a request is only
+  ever lost if NO worker can take it — and a worker's 503
+  (draining/not-admitted) fails over the same way. Upstream timeouts are
+  NOT retried: the batch may already be running;
+* **autoscaler** — a control loop over the gauges telemetry already
+  exports per worker (queue depth / p99 / batch fill / completion rate):
+  sustained pressure for K samples scales up, sustained idle scales
+  down, min/max bounds and a cooldown damp oscillation
+  (``MXNET_TPU_FLEET`` grammar below);
+* **zero-downtime rollout** — :meth:`ServingFleet.rollout` starts a
+  generation-N+1 worker set from ``new_model_dir`` (warming from the
+  persistent compile cache: a warm generation LOADS, never compiles),
+  health-gates every new worker (``/healthz`` + an announce census
+  showing ZERO pending compiles), shifts router traffic atomically,
+  then drains generation N through the exit-75 protocol — mid-load,
+  with zero dropped admitted requests.
+
+``MXNET_TPU_FLEET`` env grammar (mirrors FAULTS/WATCHDOG: one variable,
+``,``/``;``-separated ``option:value`` entries; constructor kwargs and
+``config=`` override)::
+
+    min:<N>            autoscaler lower bound (default 1)
+    max:<N>            autoscaler upper bound (default 4; min==max
+                       disables autoscaling)
+    up_queue:<N>       scale-up pressure: any worker's queue depth >= N
+                       (default 32)
+    up_p99_ms:<F>      scale-up pressure: any worker's p99 >= F (250)
+    up_fill:<F>        scale-up pressure: batch fill ratio >= F (0.98 —
+                       full buckets mean the batcher is saturated)
+    k:<N>              consecutive pressure samples before scaling up (3)
+    idle_rps:<F>       scale-down: fleet completion rate <= F req/s with
+                       empty queues (default 1.0)
+    idle_k:<N>         consecutive idle samples before scaling down (5)
+    cooldown:<F>       seconds after any scale action before the next (10)
+    interval:<F>       autoscaler sampling period, seconds (1.0)
+    policy:<P>         least_loaded | hash | round_robin (least_loaded)
+    beat:<F>           worker heartbeat/telemetry-shard cadence (0.5)
+    ready_timeout:<F>  worker-ready / rollout health-gate deadline (120)
+    drain_timeout:<F>  generation drain deadline during rollout (60)
+    grace:<F>          drain SIGTERM->SIGKILL escalation deadline (15)
+    dead_after:<F>     heartbeat-silence kill threshold (30; 0 off)
+    restarts:<N>       per-slot restart budget (5)
+    timeout_ms:<F>     router upstream request deadline (30000)
+
+Quick start::
+
+    from mxnet_tpu.serving import fleet, worker
+
+    worker.write_spec(model_dir, worker.demo_spec(models=2))
+    f = fleet.ServingFleet(model_dir, workers=2).start()
+    ...                           # drive f.url like any serving front end
+    f.rollout(new_model_dir)      # zero-downtime model swap
+    f.stop()
+
+Observability: ``fleet.json`` in the run dir (census, autoscaler state,
+rollout history, router counters — the diagnose "Serving Fleet" report),
+``mxtpu_fleet_*`` gauges on the router's ``/metrics`` (generation,
+ready/desired workers, fleet rps, router/autoscale counters, plus the
+per-rank re-exports from :mod:`mxnet_tpu.telemetry.fleet`), and
+``fleet.*`` flight events for every lifecycle transition.
+"""
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+import weakref
+
+from .. import log as _log
+from ..telemetry import flight as _flight
+from . import worker as _worker
+from .errors import ServingError
+
+__all__ = ["ServingFleet", "FleetError", "Autoscaler", "HashRing",
+           "order_candidates", "gate_ready", "worker_metrics",
+           "configure", "effective", "describe", "live_fleets",
+           "DEFAULTS", "ENV", "POLICIES"]
+
+_logger = _log.get_logger("mxnet_tpu.serving.fleet")
+
+ENV = "MXNET_TPU_FLEET"
+
+POLICIES = ("least_loaded", "hash", "round_robin")
+
+DEFAULTS = {
+    "min": 1,
+    "max": 4,
+    "up_queue": 32,
+    "up_p99_ms": 250.0,
+    "up_fill": 0.98,
+    "k": 3,
+    "idle_rps": 1.0,
+    "idle_k": 5,
+    "cooldown": 10.0,
+    "interval": 1.0,
+    "policy": "least_loaded",
+    "beat": 0.5,
+    "ready_timeout": 120.0,
+    "drain_timeout": 60.0,
+    "grace": 15.0,
+    "dead_after": 30.0,
+    "restarts": 5,
+    "timeout_ms": 30000.0,
+}
+
+_INT_KEYS = ("min", "max", "up_queue", "k", "idle_k", "restarts")
+_FLOAT_KEYS = ("up_p99_ms", "up_fill", "idle_rps", "cooldown", "interval",
+               "beat", "ready_timeout", "drain_timeout", "grace",
+               "dead_after", "timeout_ms")
+
+_cfg_lock = threading.Lock()
+_CFG: dict | None = None
+_loaded_env = False
+
+
+class FleetError(ServingError):
+    """Fleet-level failure: workers never became ready, a rollout's
+    health gate timed out, or the fleet was asked to serve with no
+    routable workers."""
+
+
+def _coerce(key, val):
+    if key == "policy":
+        v = str(val).strip().lower()
+        if v not in POLICIES:
+            raise ValueError(f"unknown fleet policy {val!r}; expected one "
+                             f"of {POLICIES}")
+        return v
+    if key in _INT_KEYS:
+        n = int(val)
+        if n < 0 or (n < 1 and key in ("min", "max")):
+            raise ValueError(f"fleet {key} must be >= 1, got {n}")
+        return n
+    if key in _FLOAT_KEYS:
+        f = float(val)
+        if f < 0:
+            raise ValueError(f"fleet {key} must be >= 0, got {f}")
+        return f
+    raise ValueError(f"unknown fleet option {key!r}; expected one of "
+                     f"{sorted(DEFAULTS)}")
+
+
+def _parse(spec):
+    cfg = dict(DEFAULTS)
+    for entry in re.split(r"[;,]", spec):
+        entry = entry.strip()
+        if not entry:
+            continue
+        key, sep, val = entry.partition(":")
+        key, val = key.strip(), val.strip()
+        if not sep or not val:
+            raise ValueError(
+                f"bad {ENV} entry {entry!r}: expected <option>:<value>")
+        cfg[key] = _coerce(key, val)
+    if cfg["max"] < cfg["min"]:
+        raise ValueError(f"fleet max ({cfg['max']}) < min ({cfg['min']})")
+    return cfg
+
+
+def configure(spec=None, **options):
+    """Install a fleet configuration (grammar string, dict, or kwargs on
+    top of the defaults); pass nothing to reset to env/defaults."""
+    global _CFG, _loaded_env
+    if isinstance(spec, dict):
+        cfg = dict(DEFAULTS)
+        for k, v in spec.items():
+            cfg[k] = _coerce(k, v)
+    elif spec:
+        cfg = _parse(spec)
+    else:
+        cfg = dict(DEFAULTS)
+    for k, v in options.items():
+        cfg[k] = _coerce(k, v)
+    if cfg["max"] < cfg["min"]:
+        raise ValueError(f"fleet max ({cfg['max']}) < min ({cfg['min']})")
+    with _cfg_lock:
+        _loaded_env = True
+        _CFG = cfg
+    return dict(cfg)
+
+
+def _ensure_env():
+    global _loaded_env, _CFG
+    if _loaded_env:
+        return
+    with _cfg_lock:
+        if _loaded_env:
+            return
+        _loaded_env = True
+        env = os.environ.get(ENV, "")
+        if env:
+            try:
+                _CFG = _parse(env)
+            except ValueError as e:
+                _logger.warning("ignoring invalid %s: %s", ENV, e)
+                _CFG = None
+
+
+def effective() -> dict:
+    """The effective fleet configuration (env-seeded, configure-wins)."""
+    _ensure_env()
+    cfg = _CFG
+    return dict(cfg) if cfg is not None else dict(DEFAULTS)
+
+
+def describe() -> dict:
+    """Knobs + provenance (tools/diagnose.py 'Serving Fleet')."""
+    out = effective()
+    out["env"] = os.environ.get(ENV, "<unset>")
+    return out
+
+
+# ------------------------------------------------------- routing policies --
+
+def _hash32(s):
+    return int(hashlib.md5(str(s).encode()).hexdigest()[:8], 16)
+
+
+class HashRing:
+    """Consistent hashing over worker slots (``vnodes`` points per slot):
+    removing a worker only remaps the keys that worker owned; the other
+    keys keep their placement — the property the fleet's
+    consistent-hash-by-model policy needs across worker churn."""
+
+    def __init__(self, slots=(), vnodes=64):
+        self.vnodes = int(vnodes)
+        self._ring = []            # sorted [(point, slot)]
+        self.rebuild(slots)
+
+    def rebuild(self, slots):
+        self._ring = sorted(
+            (_hash32(f"{slot}:{v}"), slot)
+            for slot in set(slots) for v in range(self.vnodes))
+        return self
+
+    def lookup(self, key, allowed=None):
+        """The slot owning `key` (restricted to `allowed` when given);
+        None on an empty ring."""
+        ring = self._ring
+        if not ring:
+            return None
+        h = _hash32(key)
+        lo, hi = 0, len(ring)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if ring[mid][0] < h:
+                lo = mid + 1
+            else:
+                hi = mid
+        for i in range(len(ring)):
+            slot = ring[(lo + i) % len(ring)][1]
+            if allowed is None or slot in allowed:
+                return slot
+        return None
+
+
+def order_candidates(policy, model, slots, depths=None, rr=0, ring=None):
+    """Order the routable `slots` for one request: the head is the
+    placement choice, the tail is the failover order.
+
+    * ``least_loaded`` — ascending live queue depth (unknown depth
+      counts as 0: a fresh worker has an empty queue), round-robin
+      rotation breaking ties; with NO depth known at all this degrades
+      to pure round-robin.
+    * ``hash`` — the consistent-hash owner of `model` first, the rest
+      rotated.
+    * ``round_robin`` — rotation by the request counter.
+    """
+    slots = list(slots)
+    if not slots:
+        return []
+    k = rr % len(slots)
+    rotated = slots[k:] + slots[:k]
+    if policy == "hash" and ring is not None:
+        primary = ring.lookup(model, allowed=set(slots))
+        if primary is None:
+            return rotated
+        return [primary] + [s for s in rotated if s != primary]
+    if policy == "least_loaded" and depths \
+            and any(depths.get(s) is not None for s in slots):
+        return sorted(rotated, key=lambda s: depths.get(s) or 0)
+    return rotated
+
+
+def gate_ready(announce):
+    """The rollout health gate's announce half: a worker may take
+    traffic only when it announced ``serving`` + ``ready`` with ZERO
+    pending compiles (an unwarmed ladder would recompile under traffic —
+    exactly what a rollout must never do)."""
+    return (bool(announce)
+            and announce.get("state") == "serving"
+            and bool(announce.get("ready"))
+            and int(announce.get("pending_compiles") or 0) == 0)
+
+
+# ---------------------------------------------------------- shard reading --
+
+def _series_values(shard, name, **match):
+    out = []
+    metric = (shard.get("metrics") or {}).get(name)
+    if not isinstance(metric, dict):
+        return out
+    for series in metric.get("series") or ():
+        labels = series.get("labels") or {}
+        if all(labels.get(k) == v for k, v in match.items()):
+            v = series.get("value")
+            if isinstance(v, (int, float)):
+                out.append(float(v))
+    return out
+
+
+def worker_metrics(run_dir, slots=None):
+    """Per-worker serving gauges from the telemetry shards each worker
+    co-writes with its heartbeat: ``{slot: {queue_depth, p99_ms, fill,
+    completed, rps, age_s, generation}}``. Missing/torn shards are
+    simply absent — callers fall back (router: round-robin; autoscaler:
+    no pressure signal from that worker)."""
+    from ..telemetry import fleet as _tfleet
+
+    out = {}
+    now = time.time()
+    for rank, shard in _tfleet.read_shards(run_dir).items():
+        if slots is not None and rank not in slots:
+            continue
+        depth = _series_values(shard, "mxtpu_serving_queue_depth")
+        p99 = _series_values(shard, "mxtpu_serving_latency_ms",
+                             quantile="p99")
+        fill = _series_values(shard, "mxtpu_serving_batch_fill_ratio")
+        done = _series_values(shard, "mxtpu_serving_requests_total",
+                              outcome="completed")
+        rps = _series_values(shard, "mxtpu_serving_rps")
+        out[rank] = {
+            "queue_depth": sum(depth) if depth else None,
+            "p99_ms": max(p99) if p99 else None,
+            "fill": max(fill) if fill else None,
+            "completed": sum(done) if done else 0.0,
+            "rps": sum(rps) if rps else None,
+            "age_s": round(now - float(shard.get("t_wall", now)), 3),
+            "generation": shard.get("generation"),
+        }
+    return out
+
+
+# -------------------------------------------------------------- autoscaler --
+
+class Autoscaler:
+    """The scaling decision core, pure enough to table-test: feed it one
+    aggregate sample per interval and it answers up/down/None.
+
+    Pressure (any of: max queue depth >= ``up_queue``, max p99 >=
+    ``up_p99_ms``, max batch fill >= ``up_fill``) sustained for ``k``
+    consecutive samples scales up; idleness (completion rate <=
+    ``idle_rps`` AND empty queues) sustained for ``idle_k`` samples
+    scales down; every action starts a ``cooldown`` window during which
+    streaks keep accumulating but nothing fires; ``min``/``max`` bound
+    the census."""
+
+    def __init__(self, cfg=None):
+        self.cfg = dict(effective() if cfg is None else cfg)
+        self.up_streak = 0
+        self.idle_streak = 0
+        self.cooldown_until = 0.0
+        self.last = None           # last decision record (incl. holds)
+        self.last_action = None    # last actual up/down
+        self.decisions = {"up": 0, "down": 0}
+
+    def decide(self, sample, workers, now=None):
+        """One sample -> ("up"|"down"|None, record). `sample` carries
+        ``queue_depth``/``p99_ms``/``fill`` (fleet-max) + ``rps``
+        (fleet completion rate); `workers` is the current census."""
+        cfg = self.cfg
+        now = time.monotonic() if now is None else now
+        pressure = []
+        q = sample.get("queue_depth")
+        if q is not None and q >= cfg["up_queue"]:
+            pressure.append(f"queue {q:g} >= {cfg['up_queue']}")
+        p99 = sample.get("p99_ms")
+        if p99 is not None and p99 >= cfg["up_p99_ms"]:
+            pressure.append(f"p99 {p99:g}ms >= {cfg['up_p99_ms']:g}")
+        fill = sample.get("fill")
+        if fill is not None and fill >= cfg["up_fill"]:
+            pressure.append(f"fill {fill:g} >= {cfg['up_fill']:g}")
+        rps = sample.get("rps")
+        # idleness takes PRECEDENCE over pressure: p99/fill are
+        # recent-window gauges that stay high after traffic stops — an
+        # empty-queue fleet completing nothing is idle no matter what
+        # its stale latency gauges say
+        idle = (rps is not None and rps <= cfg["idle_rps"] and not q)
+        if idle:
+            self.idle_streak += 1
+            self.up_streak = 0
+        elif pressure:
+            self.up_streak += 1
+            self.idle_streak = 0
+        else:
+            self.up_streak = 0
+            self.idle_streak = 0
+        direction, why = None, None
+        if self.up_streak >= cfg["k"]:
+            if workers >= cfg["max"]:
+                why = f"at max ({cfg['max']})"
+            elif now < self.cooldown_until:
+                why = "cooling down"
+            else:
+                direction = "up"
+                why = "; ".join(pressure)
+        elif self.idle_streak >= cfg["idle_k"]:
+            if workers <= cfg["min"]:
+                why = f"at min ({cfg['min']})"
+            elif now < self.cooldown_until:
+                why = "cooling down"
+            else:
+                direction = "down"
+                why = (f"idle: rps {rps:g} <= {cfg['idle_rps']:g} for "
+                       f"{self.idle_streak} samples")
+        rec = {"t_wall": time.time(), "direction": direction,
+               "reason": why, "workers": workers,
+               "up_streak": self.up_streak,
+               "idle_streak": self.idle_streak,
+               "sample": {k: sample.get(k) for k in
+                          ("queue_depth", "p99_ms", "fill", "rps")}}
+        self.last = rec
+        if direction is not None:
+            self.cooldown_until = now + cfg["cooldown"]
+            self.up_streak = 0
+            self.idle_streak = 0
+            self.decisions[direction] += 1
+            self.last_action = rec
+        return direction, rec
+
+    def describe(self):
+        return {"last": self.last, "last_action": self.last_action,
+                "decisions": dict(self.decisions),
+                "up_streak": self.up_streak,
+                "idle_streak": self.idle_streak,
+                "enabled": self.cfg["max"] > self.cfg["min"]}
+
+
+# ------------------------------------------------------------- the router --
+
+_PREDICT_RE = re.compile(r"^/(?:v1/models|models|predict)/([^/:]+)"
+                         r"(?::predict)?$")
+
+#: upstream failures safe to retry on ANOTHER worker: the connection
+#: died before (or instead of) a response — the request was never
+#: admitted there. A timeout is NOT in this set: the batch may already
+#: be running, and "zero dropped admitted requests" forbids guessing.
+_RETRYABLE = (ConnectionError, http.client.HTTPException,
+              socket.gaierror)
+
+
+class _RouterFront:
+    """The fleet's HTTP front door: proxies predict traffic to workers
+    over persistent per-thread upstream connections, retrying
+    connection-level failures (and worker 503s — not-admitted by
+    construction) on the next candidate."""
+
+    def __init__(self, fleet, host="127.0.0.1", port=0):
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        self._fleet = fleet
+        self._local = threading.local()
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "mxtpu-fleet/0.1"
+            # keep-alive + separate header/body sends otherwise hit the
+            # Nagle x delayed-ACK 40ms stall — even on loopback
+            disable_nagle_algorithm = True
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload, extra_headers=()):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                fl = front._fleet
+                if self.path == "/healthz":
+                    st = fl.stats(light=True)
+                    ok = st["ready"] >= 1
+                    self._json(200 if ok else 503,
+                               {"status": "ok" if ok else "degraded",
+                                "generation": st["generation"],
+                                "workers_ready": st["ready"],
+                                "workers_desired": st["desired"]})
+                elif self.path in ("/v1/models", "/models"):
+                    self._json(200, fl.models())
+                elif self.path in ("/v1/stats", "/stats"):
+                    self._json(200, fl.stats())
+                elif self.path == "/metrics":
+                    from ..telemetry import export as _export
+
+                    body = _export.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     _export.PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/metrics.json":
+                    from ..telemetry import export as _export
+
+                    body = _export.render_json().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": f"no route {self.path!r}"})
+
+            def do_POST(self):
+                m = _PREDICT_RE.match(self.path)
+                if not m:
+                    self._json(404, {"error": f"no route {self.path!r}"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                rid = self.headers.get("X-Request-Id")
+                if not rid:
+                    from ..telemetry import trace as _trace
+
+                    rid = _trace.new_request_id()
+                status, payload, hdrs = front._dispatch(
+                    m.group(1), self.path, body,
+                    self.headers.get("Content-Type", "application/json"),
+                    rid)
+                self.send_response(status)
+                for k, v in hdrs:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+
+    # ------------------------------------------------------- dispatching --
+    def _conn_to(self, slot, endpoint):
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        conn, ep = conns.get(slot, (None, None))
+        if conn is None or ep != endpoint:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            conn = http.client.HTTPConnection(
+                endpoint[0], endpoint[1],
+                timeout=self._fleet.cfg["timeout_ms"] / 1e3)
+            conn.connect()
+            # persistent upstream: TCP_NODELAY or every request eats the
+            # Nagle x delayed-ACK stall
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                 1)
+            conns[slot] = (conn, endpoint)
+        return conn
+
+    def _drop_conn(self, slot):
+        conns = getattr(self._local, "conns", None)
+        if conns:
+            conn, _ = conns.pop(slot, (None, None))
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _dispatch(self, model, path, body, ctype, rid):
+        """Route one admitted-at-the-front-door request: walk the
+        policy-ordered candidates; connection-level failures and 503s
+        fail over to the next worker; the LAST candidate's verdict (or a
+        fleet 503) goes back to the client."""
+        fleet = self._fleet
+        fleet._count("requests")
+        candidates = fleet.pick(model)
+        rid_hdr = [("X-Request-Id", rid)]
+        if not candidates:
+            fleet._count("rejects")
+            return 503, json.dumps(
+                {"error": "no ready workers in the fleet",
+                 "request_id": rid}).encode(), \
+                rid_hdr + [("Content-Type", "application/json"),
+                           ("Retry-After", "1")]
+        last_err = None
+        for attempt, slot in enumerate(candidates):
+            endpoint = fleet.endpoint(slot)
+            if endpoint is None:
+                continue
+            if attempt:
+                fleet._count("retries")
+            try:
+                conn = self._conn_to(slot, endpoint)
+                conn.request("POST", path, body=body,
+                             headers={"Content-Type": ctype,
+                                      "X-Request-Id": rid})
+                resp = conn.getresponse()
+                payload = resp.read()
+            except socket.timeout:
+                # maybe admitted: do NOT replay on another worker
+                self._drop_conn(slot)
+                fleet._count("errors")
+                return 504, json.dumps(
+                    {"error": f"worker {slot} timed out",
+                     "request_id": rid}).encode(), \
+                    rid_hdr + [("Content-Type", "application/json")]
+            except _RETRYABLE + (OSError,) as e:
+                self._drop_conn(slot)
+                fleet.mark_suspect(slot, repr(e))
+                last_err = f"{type(e).__name__}: {e}"
+                continue
+            if resp.status == 503 and attempt + 1 < len(candidates):
+                # draining worker: the request was NOT admitted there
+                continue
+            if 200 <= resp.status < 300:
+                fleet._count("completed")
+            hdrs = rid_hdr + [("Content-Type",
+                               resp.getheader("Content-Type",
+                                              "application/json"))]
+            if resp.status in (429, 503):
+                hdrs.append(("Retry-After",
+                             resp.getheader("Retry-After", "0.1")))
+            return resp.status, payload, hdrs
+        fleet._count("rejects")
+        return 503, json.dumps(
+            {"error": "every fleet worker refused the request",
+             "last_error": last_err, "request_id": rid}).encode(), \
+            rid_hdr + [("Content-Type", "application/json"),
+                       ("Retry-After", "1")]
+
+    # ---------------------------------------------------------- lifecycle --
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1}, daemon=True,
+                name="mxtpu-fleet-router")
+            self._thread.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# --------------------------------------------------------------- the fleet --
+
+_LIVE = weakref.WeakSet()
+_collector_installed = False
+
+
+def live_fleets():
+    """ServingFleet instances alive in this process (diagnose)."""
+    return list(_LIVE)
+
+
+class ServingFleet:
+    """Supervise N serving workers behind one router (docs/SERVING.md
+    "Fleet"). The three control surfaces — per-slot supervision,
+    telemetry-driven autoscaling, zero-downtime rollout — run on one
+    monitor thread; the router serves on its own HTTP threads."""
+
+    def __init__(self, model_dir, workers=None, *, run_dir=None,
+                 policy=None, host="127.0.0.1", port=0, config=None,
+                 warmup=True, env=None, cwd=None, name="fleet",
+                 popen=None):
+        import tempfile
+
+        cfg = dict(effective())
+        if isinstance(config, str):
+            cfg.update(_parse(config))
+        elif config:
+            for k, v in config.items():
+                cfg[k] = _coerce(k, v)
+        if policy is not None:
+            cfg["policy"] = _coerce("policy", policy)
+        self.cfg = cfg
+        self.name = str(name)
+        self.model_dir = os.fspath(model_dir)
+        self.run_dir = os.fspath(
+            run_dir or tempfile.mkdtemp(prefix="mxtpu_fleet_"))
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._initial_workers = max(1, int(cfg["min"]
+                                           if workers is None else workers))
+        self._host, self._port = host, int(port)
+        self._warmup = bool(warmup)
+        self.generation = 0
+        self.state = "idle"
+        self._gen_dirs = {}        # generation -> model dir
+        self._desired = {}         # slot -> generation
+        self._next_slot = 0
+        self._routable = []        # slots taking traffic right now
+        self._endpoints = {}       # slot -> (host, port)
+        self._suspect = {}         # slot -> monotonic deadline
+        self._rr = 0
+        self._ring = HashRing()
+        self.rollouts = []
+        self._counters = {"requests": 0, "completed": 0, "retries": 0,
+                          "rejects": 0, "errors": 0}
+        self._count_lock = threading.Lock()
+        self._scaler = Autoscaler(cfg)
+        self._last_completed = None   # (t_mono, fleet completed total)
+        self._last_sample = {}
+        self._lock = threading.RLock()      # census + rollout/scale
+        self._stop_evt = threading.Event()
+        self._monitor = None
+        self._router = None
+        self._summary_at = 0.0
+
+        worker_env = dict(env or {})
+        worker_env.setdefault("MXNET_TPU_GANG_BEAT", str(cfg["beat"]))
+        # workers must find this package without an installed dist
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        worker_env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            os.environ.get("PYTHONPATH", "")
+        # a shared persistent compile cache is what makes rollout cheap:
+        # generation N+1 LOADS the ladder the first generation compiled
+        worker_env.setdefault("MXNET_TPU_CACHE_DIR",
+                              os.environ.get("MXNET_TPU_CACHE_DIR")
+                              or os.path.join(self.run_dir, "cache"))
+        # diagnose run next to the fleet finds the run dir through this
+        worker_env.setdefault("MXTPU_FLEET_DIR", self.run_dir)
+
+        from .. import elastic as _elastic
+
+        self._sup = _elastic.ServingSupervisor(
+            self._command_for, self.run_dir, grace=cfg["grace"],
+            dead_after=cfg["dead_after"], max_restarts=cfg["restarts"],
+            env=worker_env, cwd=cwd, popen=popen)
+
+        from ..telemetry import fleet as _tfleet
+
+        _tfleet.install(self.run_dir)
+        _install_collector()
+        _LIVE.add(self)
+        self._t_start = time.monotonic()
+
+    # -------------------------------------------------------- worker cmds --
+    def _command_for(self, slot, generation):
+        cmd = [sys.executable, "-m", "mxnet_tpu.serving.worker",
+               "--model-dir", self._gen_dirs[generation],
+               "--slot", str(slot), "--generation", str(generation)]
+        if not self._warmup:
+            cmd.append("--no-warmup")
+        return cmd
+
+    def _spawn(self, generation):
+        with self._lock:
+            slot = self._next_slot
+            self._next_slot += 1
+            self._desired[slot] = int(generation)
+        self._sup.spawn(slot, generation)
+        return slot
+
+    # ---------------------------------------------------------- lifecycle --
+    def start(self, wait_ready=True, timeout=None):
+        """Spawn the initial generation, start the router + monitor;
+        with ``wait_ready`` (default) block until every worker passed
+        the health gate (or raise :class:`FleetError`)."""
+        with self._lock:
+            if self.state != "idle":
+                return self
+            self.state = "starting"
+            self.generation = 1
+            self._gen_dirs[1] = self.model_dir
+        for _ in range(self._initial_workers):
+            self._spawn(1)
+        self._router = _RouterFront(self, self._host, self._port).start()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="mxtpu-fleet-monitor")
+        self._monitor.start()
+        _flight.rec("fleet.start", self.name,
+                    f"{self._initial_workers} worker(s) @ {self.url}")
+        if wait_ready:
+            self.wait_ready(timeout=timeout)
+        with self._lock:
+            if self.state == "starting":
+                self.state = "serving"
+        self._write_summary(force=True)
+        return self
+
+    @property
+    def url(self):
+        return self._router.url if self._router is not None else None
+
+    def wait_ready(self, timeout=None, generation=None):
+        """Block until every desired worker of `generation` (default:
+        the active one) passes the health gate; FleetError on timeout."""
+        deadline = time.monotonic() + (self.cfg["ready_timeout"]
+                                       if timeout is None else timeout)
+        while True:
+            gen = self.generation if generation is None else generation
+            want = [s for s, g in self._desired.items() if g == gen]
+            ready = self._gated_ready(want)
+            if want and len(ready) == len(want):
+                # publish to the router NOW — the monitor's next pass
+                # may be a poll period away and the caller is about to
+                # send traffic
+                self._refresh()
+                return ready
+            if time.monotonic() >= deadline:
+                anns = _worker.read_workers(self.run_dir)
+                states = {s: (anns.get(s) or {}).get("state", "absent")
+                          for s in want}
+                raise FleetError(
+                    f"fleet workers not ready within the deadline: "
+                    f"{states}; supervisor: "
+                    f"{ {s: r['state'] for s, r in self._sup.census().items()} }")
+            time.sleep(0.05)
+
+    def stop(self, drain=True):
+        """Retire every worker (graceful drain by default), stop the
+        router + monitor, write the final summary."""
+        with self._lock:
+            if self.state in ("stopped", "idle"):
+                self.state = "stopped"
+                return
+            self.state = "stopping"
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+        self._sup.stop_all(graceful=drain)
+        if self._router is not None:
+            self._router.close()
+        with self._lock:
+            self.state = "stopped"
+            self._routable = []  # _desired kept: the final fleet.json
+            # census is the diagnose report's post-mortem view
+        _flight.rec("fleet.stop", self.name)
+        self._write_summary(force=True)
+
+    # ------------------------------------------------------------ routing --
+    def _gated_ready(self, slots):
+        """Slots (of the given census) passing the announce health gate
+        with a live, pid-matching process."""
+        anns = _worker.read_workers(self.run_dir)
+        census = self._sup.census()
+        out = []
+        for slot in slots:
+            rec = census.get(slot)
+            ann = anns.get(slot)
+            if (rec and rec.get("alive") and gate_ready(ann)
+                    and ann.get("pid") == rec.get("pid")
+                    and ann.get("generation") == rec.get("generation")):
+                out.append(slot)
+                self._endpoints[slot] = (ann.get("host", "127.0.0.1"),
+                                         int(ann["port"]))
+        return out
+
+    def _refresh(self):
+        gen = self.generation
+        want = sorted(s for s, g in self._desired.items() if g == gen)
+        ready = self._gated_ready(want)
+        now = time.monotonic()
+        self._suspect = {s: t for s, t in self._suspect.items() if t > now}
+        routable = [s for s in ready if s not in self._suspect]
+        self._routable = routable or ready
+        if self.cfg["policy"] == "hash":
+            self._ring.rebuild(self._routable)
+
+    def pick(self, model):
+        """Policy-ordered candidate slots for one request."""
+        self._rr += 1
+        depths = None
+        if self.cfg["policy"] == "least_loaded":
+            depths = {s: m.get("queue_depth")
+                      for s, m in self._last_sample.get(
+                          "per_worker", {}).items()}
+        return order_candidates(self.cfg["policy"], model,
+                                self._routable, depths=depths,
+                                rr=self._rr, ring=self._ring)
+
+    def endpoint(self, slot):
+        return self._endpoints.get(slot)
+
+    def mark_suspect(self, slot, why=""):
+        """A connection-level failure against `slot`: deprioritize it
+        until the monitor re-verifies (or the supervisor respawns it)."""
+        self._suspect[slot] = time.monotonic() + 1.0
+        self._routable = [s for s in self._routable if s != slot]
+        _flight.rec("fleet.suspect", f"slot{slot}", why)
+
+    def _count(self, key, n=1):
+        with self._count_lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def models(self):
+        """The served model list (from any ready worker's announce)."""
+        anns = _worker.read_workers(self.run_dir)
+        for slot in self._routable:
+            ann = anns.get(slot)
+            if ann and ann.get("models"):
+                return {"models": ann["models"],
+                        "generation": ann.get("generation")}
+        return {"models": [], "generation": self.generation}
+
+    # ------------------------------------------------------------ scaling --
+    def scale_to(self, n, reason="manual"):
+        """Grow/shrink the active generation to `n` workers (scale-up
+        spawns; scale-down drains the highest slots through exit 75)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"fleet cannot scale below 1 worker (got {n})")
+        with self._lock:
+            gen = self.generation
+            active = sorted(s for s, g in self._desired.items()
+                            if g == gen)
+            if n > len(active):
+                added = [self._spawn(gen) for _ in range(n - len(active))]
+                _flight.rec("fleet.scale", "up",
+                            f"{len(active)} -> {n} ({reason})")
+                _logger.info("fleet: scale up %d -> %d (%s; slots %s)",
+                             len(active), n, reason, added)
+            elif n < len(active):
+                dropped = active[n:]
+                for slot in dropped:
+                    self._desired.pop(slot, None)
+                    self._sup.drain_slot(slot, reason=f"scale-down "
+                                                      f"({reason})")
+                _flight.rec("fleet.scale", "down",
+                            f"{len(active)} -> {n} ({reason})")
+                _logger.info("fleet: scale down %d -> %d (%s; drained "
+                             "%s)", len(active), n, reason, dropped)
+        self._write_summary(force=True)
+        return n
+
+    def _sample(self, now):
+        gen = self.generation
+        active = {s for s, g in self._desired.items() if g == gen}
+        per = worker_metrics(self.run_dir, slots=active)
+        per = {s: m for s, m in per.items()
+               if m.get("generation") == gen}
+        depths = [m["queue_depth"] for m in per.values()
+                  if m.get("queue_depth") is not None]
+        p99s = [m["p99_ms"] for m in per.values()
+                if m.get("p99_ms") is not None]
+        fills = [m["fill"] for m in per.values()
+                 if m.get("fill") is not None]
+        completed = sum(m.get("completed") or 0.0 for m in per.values())
+        rps = None
+        if self._last_completed is not None:
+            t0, c0 = self._last_completed
+            dt = now - t0
+            if dt > 0:
+                rps = max(0.0, (completed - c0) / dt)
+        self._last_completed = (now, completed)
+        sample = {"queue_depth": max(depths) if depths else None,
+                  "p99_ms": max(p99s) if p99s else None,
+                  "fill": max(fills) if fills else None,
+                  "rps": rps, "completed": completed,
+                  "per_worker": per}
+        self._last_sample = sample
+        return sample
+
+    def _autoscale_tick(self, now):
+        sample = self._sample(now)
+        if self.cfg["max"] <= self.cfg["min"]:
+            return  # fixed-size fleet: sampling still feeds the router
+        if self.state != "serving":
+            return
+        with self._lock:
+            active = sum(1 for g in self._desired.values()
+                         if g == self.generation)
+        direction, rec = self._scaler.decide(sample, active, now=now)
+        if direction == "up":
+            self.scale_to(min(self.cfg["max"], active + 1),
+                          reason=f"autoscale: {rec['reason']}")
+        elif direction == "down":
+            self.scale_to(max(self.cfg["min"], active - 1),
+                          reason=f"autoscale: {rec['reason']}")
+        if direction:
+            _flight.rec("fleet.autoscale", direction, rec["reason"])
+
+    # ------------------------------------------------------------ rollout --
+    def rollout(self, new_model_dir, timeout=None):
+        """Zero-downtime model swap: spawn a generation-N+1 worker set
+        from `new_model_dir` (warm from the shared disk compile cache),
+        health-gate every new worker (announce census with zero pending
+        compiles + live ``/healthz``), shift router traffic atomically,
+        then drain generation N through exit 75. Returns the rollout
+        record; raises :class:`FleetError` (old generation untouched)
+        when the gate times out."""
+        import urllib.request
+
+        with self._lock:
+            if self.state != "serving":
+                raise FleetError(
+                    f"rollout needs a serving fleet (state "
+                    f"{self.state!r})")
+            old_gen = self.generation
+            new_gen = old_gen + 1
+            self._gen_dirs[new_gen] = os.fspath(new_model_dir)
+            old_slots = sorted(s for s, g in self._desired.items()
+                               if g == old_gen)
+            n = max(1, len(old_slots))
+            # the autoscaler sits out the swap (state-gated): a census
+            # change mid-rollout would race the generation accounting
+            self.state = "rolling-out"
+        rec = {"generation": new_gen,
+               "model_dir": os.fspath(new_model_dir),
+               "from_generation": old_gen, "t_start": time.time(),
+               "workers": [], "drained": {}, "state": "spawning"}
+        _flight.rec("fleet.rollout", f"gen{new_gen}",
+                    os.fspath(new_model_dir))
+        _logger.info("fleet: rollout -> generation %d (%s), %d worker(s)",
+                     new_gen, new_model_dir, n)
+        new_slots = [self._spawn(new_gen) for _ in range(n)]
+        rec["workers"] = new_slots
+        # ---- health gate: announce-ready + zero pending compiles + a
+        # live /healthz answer from every new worker
+        deadline = time.monotonic() + (self.cfg["ready_timeout"]
+                                       if timeout is None else timeout)
+        rec["state"] = "health-gate"
+        while True:
+            ready = self._gated_ready(new_slots)
+            if len(ready) == len(new_slots):
+                healthy = []
+                for slot in ready:
+                    host, port = self._endpoints[slot]
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://{host}:{port}/healthz",
+                                timeout=2.0) as resp:
+                            ok = json.loads(resp.read()).get(
+                                "status") == "ok"
+                    except (OSError, ValueError):
+                        ok = False
+                    if ok:
+                        healthy.append(slot)
+                if len(healthy) == len(new_slots):
+                    break
+            if time.monotonic() >= deadline:
+                anns = _worker.read_workers(self.run_dir)
+                states = {
+                    s: {"state": (anns.get(s) or {}).get("state",
+                                                         "absent"),
+                        "pending_compiles":
+                        (anns.get(s) or {}).get("pending_compiles")}
+                    for s in new_slots}
+                with self._lock:
+                    for slot in new_slots:
+                        self._desired.pop(slot, None)
+                        self._sup.drain_slot(slot,
+                                             reason="rollout aborted")
+                rec["state"] = "aborted"
+                rec["gate_failures"] = states
+                self.rollouts.append(rec)
+                with self._lock:
+                    self.generation = old_gen
+                    self._gen_dirs.pop(new_gen, None)
+                    self.state = "serving"
+                self._write_summary(force=True)
+                raise FleetError(
+                    f"rollout to generation {new_gen} aborted: health "
+                    f"gate not passed within the deadline — {states} "
+                    "(the old generation keeps serving)")
+            time.sleep(0.05)
+        # ---- atomic traffic shift, then drain the old generation
+        with self._lock:
+            self.generation = new_gen
+        self._refresh()
+        rec["state"] = "draining-old"
+        rec["t_shift"] = time.time()
+        _flight.rec("fleet.shift", f"gen{new_gen}",
+                    f"{len(new_slots)} worker(s) live")
+        with self._lock:
+            for slot in old_slots:
+                self._desired.pop(slot, None)
+                self._sup.drain_slot(slot,
+                                     reason=f"rollout gen{new_gen}")
+        drain_deadline = time.monotonic() + self.cfg["drain_timeout"]
+        while time.monotonic() < drain_deadline:
+            self._sup.poll()
+            left = [s for s in old_slots if s in self._sup.slots]
+            if not left:
+                break
+            time.sleep(0.05)
+        for ev in self._sup.events:
+            if ev["kind"] in ("drained", "drain_killed") \
+                    and ev["slot"] in old_slots:
+                rec["drained"][str(ev["slot"])] = ev.get("exit_code")
+        anns = _worker.read_workers(self.run_dir)
+        rec["old_final"] = {
+            str(s): {k: (anns.get(s) or {}).get(k)
+                     for k in ("state", "admitted", "answered", "failed",
+                               "drained")}
+            for s in old_slots}
+        rec["state"] = "done"
+        rec["t_done"] = time.time()
+        self.rollouts.append(rec)
+        with self._lock:
+            self.state = "serving"
+        _logger.info("fleet: rollout to generation %d complete (old "
+                     "generation exits: %s)", new_gen, rec["drained"])
+        self._write_summary(force=True)
+        return rec
+
+    # ------------------------------------------------------------ monitor --
+    def _monitor_loop(self):
+        next_tick = 0.0
+        while not self._stop_evt.is_set():
+            try:
+                self._sup.poll()
+                self._refresh()
+                now = time.monotonic()
+                if now >= next_tick:
+                    next_tick = now + self.cfg["interval"]
+                    self._autoscale_tick(now)
+                self._write_summary()
+            except Exception:
+                _logger.exception("fleet: monitor pass failed (fleet "
+                                  "keeps serving)")
+            self._stop_evt.wait(0.05)
+
+    # -------------------------------------------------------------- state --
+    def stats(self, light=False):
+        """The fleet's aggregate observability snapshot (router /stats,
+        fleet.json, diagnose)."""
+        with self._lock:
+            desired = dict(self._desired)
+            gen = self.generation
+        base = {"name": self.name, "state": self.state,
+                "generation": gen, "policy": self.cfg["policy"],
+                "desired": sum(1 for g in desired.values() if g == gen),
+                "ready": len(self._routable)}
+        if light:
+            return base
+        census = self._sup.census()
+        anns = _worker.read_workers(self.run_dir)
+        per = self._last_sample.get("per_worker", {})
+        workers = {}
+        for slot, g in sorted(desired.items()):
+            rec = census.get(slot) or {}
+            ann = anns.get(slot) or {}
+            m = per.get(slot) or {}
+            workers[str(slot)] = {
+                "generation": g, "state": rec.get("state"),
+                "alive": rec.get("alive"), "pid": rec.get("pid"),
+                "restarts": rec.get("restarts"),
+                "port": ann.get("port"), "ready": gate_ready(ann),
+                "models": ann.get("models"),
+                "queue_depth": m.get("queue_depth"),
+                "p99_ms": m.get("p99_ms"), "rps": m.get("rps"),
+                "shard_age_s": m.get("age_s")}
+        base.update({
+            "url": self.url, "run_dir": self.run_dir,
+            "uptime_s": round(time.monotonic() - self._t_start, 1),
+            "workers": workers,
+            "router": dict(self._counters),
+            "autoscaler": self._scaler.describe(),
+            "sample": {k: self._last_sample.get(k) for k in
+                       ("queue_depth", "p99_ms", "fill", "rps")},
+            "rollouts": [
+                {k: v for k, v in r.items() if k != "old_final"}
+                for r in self.rollouts[-8:]],
+            "supervisor": {"restarts_total": self._sup.restarts_total,
+                           "drained_total": self._sup.drained_total},
+        })
+        return base
+
+    def describe(self):
+        """stats() + config + supervisor events (fleet.json)."""
+        out = self.stats()
+        out["config"] = dict(self.cfg)
+        out["events"] = list(self._sup.events[-64:])
+        return out
+
+    def _write_summary(self, force=False):
+        now = time.monotonic()
+        if not force and now - self._summary_at < 1.0:
+            return
+        self._summary_at = now
+        from .. import elastic as _elastic
+
+        try:
+            rec = self.describe()
+            rec["updated"] = time.time()
+            _elastic._atomic_json(
+                os.path.join(self.run_dir, "fleet.json"), rec)
+        except OSError as e:
+            _logger.warning("fleet: could not write fleet.json: %s", e)
+
+
+# --------------------------------------------------- telemetry collector ---
+
+def _collect_serving_fleet():
+    """Scrape-time gauges for the most recent live fleet in this
+    process: rollout generation, census, fleet-wide completion rate and
+    the router/autoscale counters (the per-worker gauge re-exports come
+    from :mod:`mxnet_tpu.telemetry.fleet`'s shard collector)."""
+    from ..telemetry import registry as _registry
+
+    fleets = sorted(_LIVE, key=lambda f: f._t_start)
+    if not fleets:
+        return
+    fl = fleets[-1]
+    st = fl.stats(light=True)
+    _registry.gauge("mxtpu_fleet_generation",
+                    "Active fleet model generation (bumps per rollout)"
+                    ).set(st["generation"])
+    _registry.gauge("mxtpu_fleet_workers_desired",
+                    "Workers the fleet wants in the active generation"
+                    ).set(st["desired"])
+    _registry.gauge("mxtpu_fleet_workers_ready",
+                    "Workers currently routable").set(st["ready"])
+    rps = fl._last_sample.get("rps")
+    _registry.gauge("mxtpu_fleet_rps",
+                    "Fleet-wide completion rate over the last "
+                    "autoscaler interval").set(rps or 0.0)
+    router = _registry.counter("mxtpu_fleet_router_requests_total",
+                               "Router requests by outcome",
+                               labels=("outcome",))
+    with fl._count_lock:
+        counters = dict(fl._counters)
+    for outcome, n in counters.items():
+        router.set_total(n, outcome)
+    scale = _registry.counter("mxtpu_fleet_autoscale_total",
+                              "Autoscaler actions", labels=("direction",))
+    for direction, n in fl._scaler.decisions.items():
+        scale.set_total(n, direction)
+    _registry.counter("mxtpu_fleet_worker_restarts_total",
+                      "Fleet worker slot restarts").set_total(
+                          fl._sup.restarts_total)
+    _registry.counter("mxtpu_fleet_workers_drained_total",
+                      "Deliberately drained fleet workers (rollout / "
+                      "scale-down / stop)").set_total(
+                          fl._sup.drained_total)
+
+
+def _install_collector():
+    global _collector_installed
+    if _collector_installed:
+        return
+    _collector_installed = True
+    from ..telemetry import export as _export
+
+    _export.register_collector("serving_fleet", _collect_serving_fleet)

@@ -62,6 +62,10 @@ class HttpFrontEnd:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
             server_version = "mxtpu-serving/0.1"
+            # keep-alive clients (the fleet router's persistent upstream
+            # connections, loadgen's KeepAliveClient) otherwise hit the
+            # Nagle x delayed-ACK 40ms stall on every request
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):  # stay quiet under load
                 pass

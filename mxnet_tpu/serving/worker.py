@@ -1,0 +1,296 @@
+"""Serving-fleet worker process: one ModelServer replica behind the router.
+
+The child half of :class:`mxnet_tpu.serving.fleet.ServingFleet` —
+launched as ``python -m mxnet_tpu.serving.worker --model-dir DIR`` by the
+serving-mode supervisor (:class:`mxnet_tpu.elastic.ServingSupervisor`),
+which also sets the gang env (``MXTPU_GANG_DIR`` / ``MXTPU_WORKER_ID`` /
+``MXTPU_GANG_GENERATION``) so the heartbeat daemon, telemetry shard and
+exit-code excepthook arm themselves at ``import mxnet_tpu``.
+
+Lifecycle::
+
+    load serving.json spec -> ModelContainer -> ModelServer.start()
+    -> warmup (disk compile cache: a warm pod loads, never compiles)
+    -> HttpFrontEnd on an ephemeral port
+    -> atomically announce worker-<slot>.json (port, models, readiness,
+       pending-compile census, compile-service stats)
+    -> serve until SIGTERM -> drain (answer EVERYTHING admitted)
+    -> final announce (admitted/answered) -> exit 75 (EX_TEMPFAIL)
+
+The **announce file** is the router's census record: the fleet only
+routes to a worker whose announce says ``ready`` with ``pending_compiles
+== 0`` (the rollout health gate), and reads the final announce to prove
+a drained generation answered everything it admitted. Live queue depth /
+p99 / rps ride separately in the telemetry shard the heartbeat co-writes
+every beat.
+
+Model dir layout — one ``serving.json`` describing the served set::
+
+    {"models": [
+      {"kind": "demo", "name": "model0", "seed": 0, "dim": 16,
+       "hidden": 32, "classes": 10},                  # deterministic MLP
+      {"kind": "checkpoint", "name": "m", "prefix": "m", "epoch": 3,
+       "example_shape": [16]},                        # save_checkpoint pair
+      {"kind": "onnx", "name": "x", "file": "x.onnx",
+       "example_shape": [16]}
+    ]}
+
+``demo`` models are seeded, so every worker (and every generation served
+from the same spec) computes bit-identical responses — the router-
+transparency property the fleet tests assert. Relative ``prefix`` /
+``file`` paths resolve inside the model dir, which is what makes
+``fleet.rollout(new_model_dir)`` a pure pointer swap.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .. import log as _log
+
+__all__ = ["SPEC_FILE", "demo_spec", "write_spec", "load_container",
+           "announce_path", "read_workers", "main"]
+
+_logger = _log.get_logger("mxnet_tpu.serving.worker")
+
+SPEC_FILE = "serving.json"
+_ANNOUNCE = "worker-{slot}.json"
+
+
+# ----------------------------------------------------------- model specs ---
+
+def demo_spec(models=2, dim=16, classes=10, hidden=32, seed=0,
+              buckets=None):
+    """The loadgen demo-container spec as ``serving.json`` entries: N
+    seeded MLPs (same seeds/shapes as ``tools/loadgen.py``'s in-process
+    container, so responses are reproducible across workers and
+    generations)."""
+    entries = []
+    for i in range(int(models)):
+        entries.append({"kind": "demo", "name": f"model{i}",
+                        "seed": int(seed) + i * 101, "dim": int(dim),
+                        "hidden": int(hidden) + 8 * i,
+                        "classes": int(classes),
+                        "buckets": list(buckets) if buckets else None})
+    return entries
+
+
+def write_spec(model_dir, models):
+    """Write ``serving.json`` under `model_dir`; returns its path."""
+    os.makedirs(os.fspath(model_dir), exist_ok=True)
+    path = os.path.join(os.fspath(model_dir), SPEC_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"models": list(models)}, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def build_demo_model(seed, dim=16, hidden=32, classes=10):
+    """One deterministic demo MLP (seeded init — bit-identical across
+    processes for the same spec entry)."""
+    import mxnet_tpu as mx
+    from ..gluon import nn
+
+    mx.random.seed(int(seed))
+    net = nn.HybridSequential()
+    net.add(nn.Dense(int(hidden), activation="relu"),
+            nn.Dense(int(classes)))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, int(dim))))
+    return net
+
+
+def load_container(model_dir):
+    """Build the :class:`~mxnet_tpu.serving.model.ModelContainer` a
+    worker serves from `model_dir`'s ``serving.json``. Returns
+    ``(container, spec)``; raises ValueError naming the offending entry
+    on a malformed spec."""
+    from .model import ModelContainer
+
+    model_dir = os.fspath(model_dir)
+    path = os.path.join(model_dir, SPEC_FILE)
+    try:
+        with open(path) as f:
+            spec = json.load(f)
+    except OSError as e:
+        raise ValueError(f"no serving spec at {path!r}: {e}") from e
+    except ValueError as e:
+        raise ValueError(f"malformed serving spec {path!r}: {e}") from e
+    entries = spec.get("models")
+    if not isinstance(entries, list) or not entries:
+        raise ValueError(f"serving spec {path!r} has no 'models' list")
+    container = ModelContainer()
+    for i, ent in enumerate(entries):
+        if not isinstance(ent, dict) or "kind" not in ent \
+                or "name" not in ent:
+            raise ValueError(f"spec entry #{i} needs 'kind' and 'name': "
+                             f"{ent!r}")
+        kind, name = ent["kind"], ent["name"]
+        buckets = ent.get("buckets") or None
+        if kind == "demo":
+            dim = int(ent.get("dim", 16))
+            net = build_demo_model(ent.get("seed", 0), dim=dim,
+                                   hidden=ent.get("hidden", 32),
+                                   classes=ent.get("classes", 10))
+            container.add_block(name, net, example_shape=(dim,),
+                                buckets=buckets)
+        elif kind == "checkpoint":
+            container.add_checkpoint(
+                name, os.path.join(model_dir, ent["prefix"]),
+                int(ent.get("epoch", 0)),
+                example_shape=tuple(ent["example_shape"]),
+                dtype=ent.get("dtype", "float32"), buckets=buckets,
+                input_name=ent.get("input_name"))
+        elif kind == "onnx":
+            container.add_onnx(
+                name, os.path.join(model_dir, ent["file"]),
+                example_shape=tuple(ent["example_shape"]),
+                dtype=ent.get("dtype", "float32"), buckets=buckets,
+                input_name=ent.get("input_name"))
+        else:
+            raise ValueError(
+                f"spec entry #{i} ({name!r}): unknown kind {kind!r}; "
+                "expected demo | checkpoint | onnx")
+    return container, spec
+
+
+# -------------------------------------------------------- announce files ---
+
+def announce_path(run_dir, slot):
+    return os.path.join(os.fspath(run_dir),
+                        _ANNOUNCE.format(slot=int(slot)))
+
+
+def _write_announce(run_dir, slot, payload):
+    from .. import elastic as _elastic
+
+    os.makedirs(os.fspath(run_dir), exist_ok=True)
+    return _elastic._atomic_json(announce_path(run_dir, slot), payload)
+
+
+def read_workers(run_dir):
+    """Parse every ``worker-<slot>.json`` under `run_dir` into
+    ``{slot: record}`` (torn/unreadable files skipped — the writer is
+    mid-replace)."""
+    out = {}
+    try:
+        names = os.listdir(os.fspath(run_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("worker-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(os.fspath(run_dir), name)) as f:
+                rec = json.load(f)
+            out[int(rec["slot"])] = rec
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+# ------------------------------------------------------------ the worker ---
+
+def _serving_compile_stats():
+    from .. import compile as _compile
+
+    st = _compile.stats().get("serving", {})
+    return {k: st.get(k, 0) for k in ("hits", "misses", "disk_hits",
+                                      "compiles", "compile_ms",
+                                      "corrupt")}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxnet_tpu.serving.worker",
+        description="one serving-fleet worker replica (see "
+                    "docs/SERVING.md 'Fleet')")
+    ap.add_argument("--model-dir", required=True,
+                    help="directory holding serving.json (+ model files)")
+    ap.add_argument("--slot", type=int,
+                    default=int(os.environ.get("MXTPU_WORKER_ID", 0)),
+                    help="fleet slot id (default MXTPU_WORKER_ID)")
+    ap.add_argument("--generation", type=int,
+                    default=int(os.environ.get("MXTPU_GANG_GENERATION",
+                                               1)),
+                    help="fleet model generation "
+                         "(default MXTPU_GANG_GENERATION)")
+    ap.add_argument("--run-dir",
+                    default=os.environ.get("MXTPU_GANG_DIR"),
+                    help="shared fleet dir (announce + heartbeat files; "
+                         "default MXTPU_GANG_DIR)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="HTTP port (default 0 = ephemeral, announced)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the pre-traffic ladder warmup (the worker "
+                         "announces pending compiles and the rollout "
+                         "health gate will refuse it — a test seam)")
+    ap.add_argument("--poll", type=float, default=0.05)
+    args = ap.parse_args(argv)
+    if not args.run_dir:
+        ap.error("no run dir (pass --run-dir or set MXTPU_GANG_DIR)")
+
+    from .. import preempt as _preempt
+    from ..telemetry import fleet as _tfleet
+    from . import HttpFrontEnd, ModelServer
+
+    t0 = time.monotonic()
+    container, spec = load_container(args.model_dir)
+    server = ModelServer(container,
+                         name=f"fleet-w{args.slot}").start()
+    pending = sum(len(m.buckets) for m in container)
+    warm_report = None
+    if not args.no_warmup:
+        warm_report = server.warmup()
+        pending = 0
+    front = HttpFrontEnd(server, host=args.host, port=args.port).start()
+
+    def announce(state, **extra):
+        rec = {"slot": args.slot, "generation": args.generation,
+               "pid": os.getpid(), "host": args.host, "port": front.port,
+               "url": front.url, "model_dir": os.fspath(args.model_dir),
+               "models": server.models(), "state": state,
+               "ready": state == "serving" and pending == 0,
+               "pending_compiles": pending,
+               "compile_serving": _serving_compile_stats(),
+               "startup_s": round(time.monotonic() - t0, 3),
+               "t_wall": time.time()}
+        rec.update(extra)
+        _write_announce(args.run_dir, args.slot, rec)
+        return rec
+
+    # the telemetry shard (written on every heartbeat) carries the HTTP
+    # port + slot too, so the fleet scrape can name each worker endpoint
+    _tfleet.set_shard_info(http_port=front.port, fleet_slot=args.slot,
+                           fleet_generation=args.generation)
+    announce("serving", warmup=warm_report)
+    _logger.info("fleet worker %d (generation %d): serving %s on %s "
+                 "(pending compiles: %d)", args.slot, args.generation,
+                 server.models(), front.url, pending)
+
+    _preempt.install()
+    try:
+        while not _preempt.requested():
+            time.sleep(args.poll)
+    except KeyboardInterrupt:
+        pass  # second-signal path: preempt already flagged the drain
+    drained = server.drain(timeout=30.0)
+    stats = server.stats()["models"]
+    announce("drained", drained=bool(drained),
+             admitted=sum(m["submitted"] for m in stats.values()),
+             answered=sum(m["completed"] for m in stats.values()),
+             failed=sum(m["failed"] for m in stats.values()))
+    front.close()
+    # records the drain event and raises SystemExit(75) so the
+    # serving-mode supervisor retires (or reschedules) the slot
+    _preempt.drain(save=False, exit=True)
+    return 0  # unreachable: drain() exits
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,9 +118,44 @@ def test_disk_entries_are_crc_manifested(cache_dir):
     for b in bins:
         with open(os.path.join(d, b[:-4] + ".json")) as f:
             meta = json.load(f)
-        assert meta["size"] == os.path.getsize(os.path.join(d, b))
+        # the .bin is framed (magic + embedded CRC meta + payload) so a
+        # load never depends on the bin/json pairing; the sidecar must
+        # mirror the embedded meta and size the raw payload
+        with open(os.path.join(d, b), "rb") as f:
+            emeta, payload = C._unframe(f.read())
+        assert emeta == meta
+        assert meta["size"] == len(payload)
         assert meta["fingerprint"] == C.fingerprint()
         assert "crc32" in meta and "site" in meta
+
+
+def test_framed_entry_survives_mismatched_sidecar(cache_dir):
+    """The concurrent-cold-writer race (a serving fleet's replicas
+    warming the same ladder): interleaved renames can pair one writer's
+    .bin with the OTHER writer's .json, and serialized executables are
+    not byte-identical across processes. The framed .bin self-verifies,
+    so a mixed pair still loads — zero recompiles, zero corrupt."""
+    C.reset_stats()
+    fn = C.jit(lambda x: x - 3, site="svc-mixed", token=("mix", 1))
+    x = _jnp_ones((4,))
+    fn(x)
+    d = os.path.join(cache_dir, "exec", C.fingerprint())
+    jsons = [n for n in os.listdir(d) if n.endswith(".json")]
+    assert jsons
+    for n in jsons:  # simulate the other writer's sidecar landing last
+        with open(os.path.join(d, n)) as f:
+            meta = json.load(f)
+        meta["crc32"] = (meta["crc32"] + 1) % (1 << 32)
+        meta["size"] = meta["size"] + 17
+        with open(os.path.join(d, n), "w") as f:
+            json.dump(meta, f)
+    C.clear_memory()
+    C.reset_stats()
+    out = fn(x)
+    assert float(out.sum()) == float((x - 3).sum())
+    st = C.stats()["svc-mixed"]
+    assert st["disk_hits"] == 1 and st["compiles"] == 0
+    assert st["corrupt"] == 0
 
 
 def test_corrupt_entry_falls_back_to_recompile(cache_dir):

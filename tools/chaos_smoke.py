@@ -65,6 +65,13 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     ladder takes an injected ``serving.batch`` fault — the request
     fails typed, the server keeps serving int8, and the ladder census
     stays intact with ``weight_dtype: int8`` still reported,
+  * the SERVING-FLEET drill (phase 13): a 2-worker ``ServingFleet``
+    under closed-loop load takes a worker SIGKILL (router retries to
+    the live worker — zero client errors — and the serving-mode
+    supervisor restarts the slot) and then a mid-load
+    ``fleet.rollout()`` (generation 2 health-gated warm from the disk
+    compile cache with zero compiles, traffic shifted, generation 1
+    drained through exit 75 with zero dropped admitted requests),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -393,6 +400,163 @@ def straggler_drill(root=None):
     return 0
 
 
+def fleet_drill(root=None):
+    """Phase 13: the serving fleet under fire — worker SIGKILL mid-load,
+    then a mid-load zero-downtime rollout.
+
+    A 2-worker :class:`~mxnet_tpu.serving.fleet.ServingFleet` serves the
+    seeded demo models while closed-loop keep-alive clients drive the
+    router. Drill A SIGKILLs one worker's process: the router must retry
+    refused connections onto the live worker (ZERO client-visible
+    errors) and the serving-mode supervisor must restart the slot.
+    Drill B calls ``fleet.rollout(v2_dir)`` mid-load: the health gate
+    admits only warm workers (zero pending compiles — generation 2
+    loads its ladder from the shared disk cache, ``compiles == 0``),
+    traffic shifts, the old generation drains through exit 75 with
+    every admitted request answered, and the responses flip to the v2
+    model — all with zero dropped admitted requests end to end."""
+    import json as _json
+    import signal
+    import threading
+
+    import numpy as np
+
+    import loadgen
+    from mxnet_tpu.serving import fleet as fleet_mod
+    from mxnet_tpu.serving import worker as worker_mod
+
+    root = root or tempfile.mkdtemp(prefix="chaos_fleet_")
+    v1 = os.path.join(root, "v1")
+    v2 = os.path.join(root, "v2")
+    worker_mod.write_spec(v1, worker_mod.demo_spec(models=1, seed=130))
+    worker_mod.write_spec(v2, worker_mod.demo_spec(models=1, seed=131))
+    fl = fleet_mod.ServingFleet(
+        v1, workers=2, run_dir=os.path.join(root, "run"),
+        config={"min": 2, "max": 2, "beat": 0.2, "grace": 20},
+        name="chaos-fleet")
+    fl.start(timeout=90)
+
+    lock = threading.Lock()
+    stop = threading.Event()
+    completed, rejected, errors = [0], [0], []
+    responses = []               # (t_mono, first output value)
+    pool = [np.random.RandomState(i).randn(1, 16).astype(np.float32)
+            for i in range(8)]
+
+    def load_worker(tid):
+        cl = loadgen.KeepAliveClient(fl.url)
+        i = 0
+        while not stop.is_set():
+            body = _json.dumps(
+                {"data": pool[(tid + i) % len(pool)].tolist()}).encode()
+            try:
+                status, payload, _ = cl.request(
+                    "POST", "/v1/models/model0:predict", body=body,
+                    headers={"Content-Type": "application/json"})
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                i += 1
+                continue
+            if status == 200:
+                with lock:
+                    completed[0] += 1
+                    if (tid + i) % len(pool) == 0:
+                        out = _json.loads(payload)["outputs"][0][0][0]
+                        responses.append((time.monotonic(), out))
+            elif status in (429, 503):
+                with lock:
+                    rejected[0] += 1
+            else:
+                with lock:
+                    errors.append(f"HTTP {status}")
+            i += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=load_worker, args=(t,),
+                                daemon=True) for t in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)  # a steady admitted stream before any fault
+
+    # ---- drill A: SIGKILL one worker under load --------------------------
+    victim = 0
+    pid = fl.stats()["workers"][str(victim)]["pid"]
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.monotonic() + 60.0
+    recovered = False
+    while time.monotonic() < deadline:
+        w = fl.stats()["workers"].get(str(victim)) or {}
+        if w.get("ready") and w.get("restarts", 0) >= 1 \
+                and w.get("pid") != pid:
+            recovered = True
+            break
+        time.sleep(0.1)
+    if not recovered:
+        stop.set()
+        fl.stop()
+        print(f"FAIL: slot {victim} not restarted after SIGKILL: "
+              f"{fl.stats()['workers'].get(str(victim))}")
+        return 1
+    retries_a = fl.stats()["router"]["retries"]
+    if errors:
+        stop.set()
+        fl.stop()
+        print(f"FAIL: SIGKILL drill leaked {len(errors)} client "
+              f"error(s): {errors[:3]}")
+        return 1
+    print(f"  fleet SIGKILL drill: slot {victim} (pid {pid}) killed "
+          f"under load -> router retried ({retries_a} retries, 0 client "
+          f"errors), supervisor restarted the slot")
+
+    # ---- drill B: zero-downtime rollout under load -----------------------
+    pre = completed[0]
+    rec = fl.rollout(v2, timeout=90)
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    stats = fl.stats()
+    anns = worker_mod.read_workers(fl.run_dir)
+    fl.stop()
+    if errors:
+        print(f"FAIL: rollout dropped requests — {len(errors)} client "
+              f"error(s): {errors[:3]}")
+        return 1
+    if rec["state"] != "done" or \
+            any(code != 75 for code in rec["drained"].values()):
+        print(f"FAIL: rollout did not retire generation 1 via exit 75: "
+              f"{ {k: rec[k] for k in ('state', 'drained')} }")
+        return 1
+    for slot, final in rec["old_final"].items():
+        if final.get("failed") or \
+                final.get("answered") != final.get("admitted"):
+            print(f"FAIL: drained worker {slot} dropped admitted "
+                  f"requests: {final}")
+            return 1
+    gen2 = {s: a for s, a in anns.items() if a.get("generation") == 2}
+    if len(gen2) != 2 or any(
+            a["compile_serving"]["compiles"] != 0 for a in gen2.values()):
+        print(f"FAIL: generation 2 recompiled instead of warming from "
+              f"the disk cache: "
+              f"{ {s: a['compile_serving'] for s, a in gen2.items()} }")
+        return 1
+    if completed[0] <= pre:
+        print("FAIL: no traffic completed through generation 2")
+        return 1
+    # the traffic must actually be the NEW model now
+    vals = sorted(set(round(v, 6) for _, v in responses))
+    if len(vals) < 2:
+        print(f"FAIL: responses never changed across the rollout: {vals}")
+        return 1
+    print(f"  fleet rollout drill: generation 2 warmed from the disk "
+          f"cache (0 compiles, {next(iter(gen2.values()))['compile_serving']['disk_hits']} disk hits), "
+          f"old generation exits {sorted(rec['drained'].values())}, "
+          f"{completed[0]} requests completed / 0 dropped "
+          f"({stats['router']['retries']} router retries total)")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--epochs", type=int, default=2)
@@ -416,6 +580,10 @@ def main(argv=None):
                         help="skip the phase-10 supervised straggler-"
                              "detection drill (subprocess gang; same "
                              "spawn caveat)")
+    parser.add_argument("--skip-fleet-drill", action="store_true",
+                        help="skip the phase-13 serving-fleet drills "
+                             "(worker SIGKILL + mid-load rollout; "
+                             "spawns worker subprocesses)")
     args = parser.parse_args(argv)
 
     if args.serve_drill:
@@ -1024,6 +1192,17 @@ def main(argv=None):
           f"(ladder census {census12}, calib mode "
           f"{_quant.last_calibration()['mode']})")
     qserver.drain(timeout=10.0)
+
+    # phase 13: the serving fleet — a worker SIGKILLed under load is
+    # retried by the router (zero client errors) and restarted by the
+    # serving-mode supervisor; a mid-load rollout health-gates a warm
+    # generation 2 (zero compiles — disk-cache loads only), shifts
+    # traffic, drains generation 1 through exit 75 with every admitted
+    # request answered
+    if not args.skip_fleet_drill:
+        rc = fleet_drill(root=os.path.join(ckpt_dir, "fleet"))
+        if rc:
+            return rc
 
     # integrity: finite params, manifest verifies end to end
     for name, p in net2.collect_params().items():

@@ -301,6 +301,89 @@ def check_serving():
     return out
 
 
+def check_fleet():
+    """Serving fleet (docs/SERVING.md "Fleet"): autoscaler knobs, the
+    live fleet in this process (if any), and the last run's fleet.json —
+    worker census with per-worker rps/queue/p99 from the telemetry
+    shards, autoscaler state + last decision, rollout generation
+    history, router retry/reject counters."""
+    _p("---------Serving Fleet---------")
+    out = {"MXNET_TPU_FLEET": os.environ.get("MXNET_TPU_FLEET"),
+           "MXTPU_FLEET_DIR": os.environ.get("MXTPU_FLEET_DIR")}
+    _p(f"MXNET_TPU_FLEET={out['MXNET_TPU_FLEET'] or '<unset>'}  "
+       "(min/max/up_queue/up_p99_ms/k/idle_rps/cooldown/policy/... — "
+       "docs/SERVING.md 'Fleet')")
+    try:
+        from mxnet_tpu.serving import fleet as fleet_mod
+
+        out["effective"] = fleet_mod.describe()
+        _p("effective     :", {k: out["effective"][k] for k in
+                               ("min", "max", "policy", "k",
+                                "up_queue", "up_p99_ms", "idle_rps",
+                                "cooldown", "interval")})
+        live = [f.stats() for f in fleet_mod.live_fleets()]
+        out["live_fleets"] = live
+        if not live:
+            _p("live fleets   : none in this process")
+        run_dir = out["MXTPU_FLEET_DIR"]
+        for st in live:
+            _p(f"fleet {st['name']!r}: {st['state']} generation "
+               f"{st['generation']}, {st['ready']}/{st['desired']} "
+               f"ready @ {st.get('url')}")
+            run_dir = run_dir or st.get("run_dir")
+        if not run_dir:
+            _p("run dir       : <none> (MXTPU_FLEET_DIR unset and no "
+               "live fleet)")
+            return out
+        out["run_dir"] = run_dir
+        try:
+            with open(os.path.join(run_dir, "fleet.json")) as f:
+                summary = json.load(f)
+        except (OSError, ValueError) as e:
+            out["summary_error"] = str(e)
+            _p(f"run dir       : {run_dir} (no readable fleet.json: {e})")
+            return out
+        out["summary"] = summary
+        _p(f"last run      : {os.path.join(run_dir, 'fleet.json')}")
+        _p(f"  state       : {summary.get('state')}  generation "
+           f"{summary.get('generation')}  workers "
+           f"{summary.get('ready')}/{summary.get('desired')} ready  "
+           f"policy {summary.get('policy')}")
+        router = summary.get("router") or {}
+        _p(f"  router      : {router.get('requests', 0)} requests, "
+           f"{router.get('retries', 0)} retries, "
+           f"{router.get('rejects', 0)} rejects, "
+           f"{router.get('errors', 0)} errors")
+        auto = summary.get("autoscaler") or {}
+        last = auto.get("last_action") or auto.get("last")
+        _p(f"  autoscaler  : {'on' if auto.get('enabled') else 'off'}  "
+           f"decisions {auto.get('decisions')}  last "
+           f"{ {k: last.get(k) for k in ('direction', 'reason', 'workers')} if last else None}")
+        for r in summary.get("rollouts", []):
+            _p(f"  rollout     : gen {r.get('generation')} "
+               f"({r.get('state')}) <- {r.get('model_dir')} "
+               f"drained {r.get('drained')}")
+        _p(f"  {'slot':<5s} {'gen':>3s} {'state':<9s} {'ready':<5s} "
+           f"{'rps':>8s} {'queue':>6s} {'p99ms':>8s} {'restarts':>8s}")
+        workers = summary.get("workers") or {}
+        from mxnet_tpu.serving.fleet import worker_metrics
+
+        live_m = worker_metrics(run_dir)
+        out["worker_metrics"] = live_m
+        for slot, w in sorted(workers.items(), key=lambda kv: int(kv[0])):
+            m = live_m.get(int(slot)) or {}
+            _p(f"  {slot:<5s} {w.get('generation', '?'):>3} "
+               f"{str(w.get('state')):<9s} {str(w.get('ready')):<5s} "
+               f"{str(m.get('rps') if m.get('rps') is not None else w.get('rps')):>8s} "
+               f"{str(m.get('queue_depth')):>6s} "
+               f"{str(m.get('p99_ms')):>8s} "
+               f"{str(w.get('restarts')):>8s}")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("fleet import failed:", e)
+    return out
+
+
 def check_watchdog():
     """Watchdog knobs + the most recent crash bundle, if one exists
     (docs/ROBUSTNESS.md) — the first thing to read after a wedged run."""
@@ -775,6 +858,7 @@ SECTIONS = (
     ("analysis", check_analysis),
     ("compile_cache", check_compile_cache),
     ("serving", check_serving),
+    ("serving_fleet", check_fleet),
     ("quantization", check_quantization),
     ("watchdog", check_watchdog),
     ("preempt", check_preempt),

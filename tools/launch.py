@@ -26,6 +26,14 @@ launcher therefore
     python tools/launch.py -n 4 python train.py --kv-store dist_sync
     python tools/launch.py --supervise -n 2 python train.py
 
+  * serving mode (``--serve-fleet``): the inference counterpart — an
+    N-worker ``mxnet_tpu.serving.ServingFleet`` (one ModelServer process
+    per worker) behind the router front door, with per-slot restart,
+    telemetry-driven autoscaling and zero-downtime rollout
+    (docs/SERVING.md "Fleet")::
+
+    python tools/launch.py --serve-fleet --model-dir ./models -n 4 --http-port 8080
+
 Signal handling (all modes): the first SIGINT/SIGTERM forwards SIGTERM to
 every child — a graceful drain, their ``mxnet_tpu.preempt`` handlers
 finish the step and checkpoint — then escalates to SIGKILL after a grace
@@ -171,6 +179,48 @@ def launch_ssh(hostfile, command, coordinator_port=9357, grace=15.0):
     return _wait_all(procs, grace=grace)
 
 
+def serve_fleet(args):
+    """``--serve-fleet``: one address over -n ModelServer worker
+    processes (serving-mode supervision, telemetry-driven autoscaling,
+    ``fleet.rollout`` for zero-downtime model swaps — the serving
+    counterpart of ``--supervise``). The launcher process runs the
+    router; the first SIGINT/SIGTERM drains every worker (exit 75) and
+    returns 0."""
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from mxnet_tpu.serving.fleet import ServingFleet
+
+    fleet = ServingFleet(args.model_dir, workers=args.num_workers,
+                         run_dir=args.run_dir, policy=args.policy,
+                         port=args.http_port)
+    fleet.start()
+    print(f"fleet: {fleet.url} ({args.num_workers} worker(s), run dir "
+          f"{fleet.run_dir})", flush=True)
+    stop = {"n": 0}
+
+    def _on_signal(signum, frame):
+        stop["n"] += 1
+
+    prev = {}
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev[s] = signal.signal(s, _on_signal)
+    except ValueError:
+        prev = {}
+    try:
+        while not stop["n"]:
+            time.sleep(0.2)
+        print("fleet: draining", flush=True)
+        fleet.stop(drain=stop["n"] < 2)
+    finally:
+        for s, h in prev.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, TypeError):
+                pass
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Launch a distributed job (jax.distributed rendezvous)")
@@ -218,9 +268,29 @@ def main(argv=None):
                         "(mxtpu_fleet_* rank-shard sums, "
                         "mxtpu_gang_straggler_* skew verdict) — one "
                         "scrape for the whole gang")
+    p.add_argument("--serve-fleet", action="store_true",
+                   help="serve a model dir with an N-worker ServingFleet "
+                        "behind the router front door (-n workers, "
+                        "--model-dir required; autoscaling/routing via "
+                        "MXNET_TPU_FLEET — docs/SERVING.md 'Fleet'). "
+                        "SIGTERM drains the fleet and exits 0")
+    p.add_argument("--model-dir", default=None,
+                   help="[serve-fleet] directory holding serving.json")
+    p.add_argument("--http-port", type=int, default=0,
+                   help="[serve-fleet] router port (default 0 = pick "
+                        "free, printed on stdout)")
+    p.add_argument("--policy", default=None,
+                   choices=("least_loaded", "hash", "round_robin"),
+                   help="[serve-fleet] routing policy override")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command to launch")
     args = p.parse_args(argv)
+
+    if args.serve_fleet:
+        if not args.model_dir:
+            p.error("--serve-fleet requires --model-dir")
+        return serve_fleet(args)
+
     if not args.command:
         p.error("no command given")
 

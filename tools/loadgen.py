@@ -28,6 +28,11 @@ default      in-process ModelServer over --models small MLPs
 --via-http   same server, but driven through the JSON/HTTP front end
              (socket path exercised end to end)
 --url URL    an already-running external front end
+--workers N  multi-process mode: an N-worker ``ServingFleet`` (one
+             ModelServer process per worker behind the router front
+             door) driven closed-loop over HTTP — the 1→N rps scaling
+             measurement (bench.py's ``serving_fleet_rps_*`` line runs
+             it at workers=1 and workers=4)
 --dtype D    model-pair mode: ONE embedding-lookup fixture served as
              fp32 and as its entropy-calibrated int8 twin from the same
              warm ladder; ``--dtype both`` drives each variant with the
@@ -35,11 +40,19 @@ default      in-process ModelServer over --models small MLPs
              int8-vs-float rps ratio as one JSON line (the ROADMAP
              item-4 acceptance measurement)
 
+Every HTTP path drives **persistent keep-alive connections** (one
+``http.client`` connection per worker thread, reconnect on error):
+per-request TCP connects would dominate router-path measurements and
+understate rps. Connect time is measured separately from request time
+and reported as ``connects`` / ``reconnects`` / ``connect_ms_mean``
+alongside the request-latency percentiles.
+
 Examples::
 
     JAX_PLATFORMS=cpu python tools/loadgen.py --duration 30
     python tools/loadgen.py --mode open --rate 2000 --duration 10
     python tools/loadgen.py --via-http --duration 5
+    python tools/loadgen.py --workers 4 --duration 10
 
 The last stdout line is one JSON report (bench.py --serve embeds it into
 the BENCH_r06+ metric series).
@@ -265,6 +278,97 @@ def run_pair(duration=20.0, concurrency=16, vocab=50_000, embed_dim=512,
     return report
 
 
+# ------------------------------------------------- keep-alive HTTP client --
+
+class KeepAliveClient:
+    """One persistent HTTP/1.1 connection per load-worker thread.
+
+    A new TCP connect per request (the old urllib path) costs more than
+    a router-dispatched predict on loopback, so it both understates rps
+    and drowns the router's own overhead in the measurement. This client
+    reuses the connection, transparently reconnecting on a
+    connection-level failure, and accounts **connect time separately**
+    from request time: :meth:`request` returns the milliseconds spent
+    (re)connecting for that call so the caller can keep the request
+    latency sample clean and report the connect cost on its own line.
+    """
+
+    def __init__(self, url, timeout=10.0):
+        import urllib.parse
+
+        p = urllib.parse.urlsplit(url)
+        self._host = p.hostname or "127.0.0.1"
+        self._port = p.port or (443 if p.scheme == "https" else 80)
+        self._timeout = timeout
+        self._conn = None
+        self.connects = 0
+        self.connect_ms = 0.0
+
+    def _ensure(self):
+        import http.client
+
+        if self._conn is None:
+            import socket
+
+            t0 = time.perf_counter()
+            conn = http.client.HTTPConnection(self._host, self._port,
+                                              timeout=self._timeout)
+            conn.connect()
+            # a reused connection without TCP_NODELAY eats the Nagle x
+            # delayed-ACK stall (~40ms) on every request — even loopback
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            dt = (time.perf_counter() - t0) * 1e3
+            self.connects += 1
+            self.connect_ms += dt
+            self._conn = conn
+            return conn, dt
+        return self._conn, 0.0
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def request(self, method, path, body=None, headers=None):
+        """-> (status, payload bytes, connect_ms for THIS call). Retries
+        once through a fresh connection when the reused one died (the
+        server closed an idle keep-alive)."""
+        import http.client
+
+        connect_ms = 0.0
+        for attempt in (0, 1):
+            conn, dt = self._ensure()
+            connect_ms += dt
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                return resp.status, resp.read(), connect_ms
+            except (ConnectionError, http.client.HTTPException, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise RuntimeError("unreachable")  # pragma: no cover
+
+
+def _connect_fields(report, clients, threads):
+    """Fold the per-thread keep-alive connect accounting into a report:
+    connect time is reported SEPARATELY from the request-latency
+    percentiles (which exclude it)."""
+    connects = sum(c.connects for c in clients)
+    connect_ms = sum(c.connect_ms for c in clients)
+    report["connects"] = connects
+    report["reconnects"] = max(0, connects - threads)
+    report["connect_ms_total"] = round(connect_ms, 3)
+    report["connect_ms_mean"] = round(connect_ms / connects, 3) \
+        if connects else None
+    return report
+
+
 _PHASES = ("queue_wait", "batch_collect", "h2d", "compute", "respond",
            "total")
 _PHASE_CAP = 200000  # bound the per-phase sample memory on long runs
@@ -333,23 +437,34 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
     pre_misses = pre.get("misses", 0)
 
     front = None
+    clients, tl = [], threading.local()
+    client_lock = threading.Lock()
     if via_http:
         front = serving.HttpFrontEnd(server).start()
 
         def do_request(name, x):
-            import urllib.request
-
+            # one keep-alive connection per worker thread: connect time
+            # is measured inside the client and subtracted from the
+            # request latency sample by the caller
+            cl = getattr(tl, "client", None)
+            if cl is None:
+                cl = tl.client = KeepAliveClient(front.url)
+                with client_lock:
+                    clients.append(cl)
             body = json.dumps({"data": x.tolist()}).encode()
-            req = urllib.request.Request(
-                f"{front.url}/v1/models/{name}:predict", data=body,
+            status, payload, connect_ms = cl.request(
+                "POST", f"/v1/models/{name}:predict", body=body,
                 headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=10.0) as resp:
-                return json.loads(resp.read()).get("phases")
+            if status in (429, 503):
+                raise serving.ServerBusyError(name, 0, 0)
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}: {payload[:120]!r}")
+            return json.loads(payload).get("phases"), connect_ms
     else:
         def do_request(name, x):
             fut = server.submit(name, x)
             fut.result(10.0)
-            return fut.breakdown()
+            return fut.breakdown(), 0.0
 
     pool = [np.random.RandomState(i).randn(1, dim).astype(np.float32)
             for i in range(64)]
@@ -370,8 +485,8 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
             x = pool[(tid * 7 + i) % len(pool)]
             t0 = time.perf_counter()
             try:
-                bd = do_request(name, x)
-                record((time.perf_counter() - t0) * 1e3)
+                bd, connect_ms = do_request(name, x)
+                record((time.perf_counter() - t0) * 1e3 - connect_ms)
                 phases.record(bd)
             except serving.ServerBusyError:
                 with lock:
@@ -479,6 +594,10 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
         "traced_requests": phases.traced,
     }
     report.update(_percentiles(sorted(lats)))
+    if via_http:
+        _connect_fields(report, clients, concurrency)
+        for cl in clients:
+            cl.close()
     if front is not None:
         front.close()
     if own_server:
@@ -490,7 +609,8 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
 
 def run_http(url, duration=30.0, concurrency=8, dim=16):
     """Closed-loop drive of an EXTERNAL front end at `url` (model list
-    discovered via GET /v1/models)."""
+    discovered via GET /v1/models) over per-thread keep-alive
+    connections; connect time reported separately from request time."""
     import urllib.request
 
     import numpy as np
@@ -502,35 +622,45 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
             for i in range(64)]
     lock = threading.Lock()
     lats, completed, rejected, errors = [], [0], [0], []
+    clients = []
     phases = _PhaseAgg(lock)
     stop_at = time.perf_counter() + duration
 
     def worker(tid):
+        cl = KeepAliveClient(url)
+        with lock:
+            clients.append(cl)
         i = 0
         while time.perf_counter() < stop_at:
             name = names[(tid + i) % len(names)]
             body = json.dumps(
                 {"data": pool[(tid * 7 + i) % len(pool)].tolist()}).encode()
-            req = urllib.request.Request(
-                f"{url.rstrip('/')}/v1/models/{name}:predict", data=body,
-                headers={"Content-Type": "application/json"})
             t0 = time.perf_counter()
             try:
-                with urllib.request.urlopen(req, timeout=10.0) as resp:
-                    payload = json.loads(resp.read())
-                with lock:
-                    lats.append((time.perf_counter() - t0) * 1e3)
-                    completed[0] += 1
-                phases.record(payload.get("phases"))
-            except urllib.error.HTTPError as e:
-                with lock:
-                    if e.code in (429, 503):
-                        rejected[0] += 1
-                    else:
-                        errors.append(f"HTTP {e.code}")
+                status, payload, connect_ms = cl.request(
+                    "POST", f"/v1/models/{name}:predict", body=body,
+                    headers={"Content-Type": "application/json"})
             except Exception as e:
                 with lock:
                     errors.append(f"{type(e).__name__}: {e}")
+                i += 1
+                continue
+            if status in (429, 503):
+                with lock:
+                    rejected[0] += 1
+                time.sleep(0.001)
+            elif status != 200:
+                with lock:
+                    errors.append(f"HTTP {status}")
+            else:
+                with lock:
+                    lats.append((time.perf_counter() - t0) * 1e3
+                                - connect_ms)
+                    completed[0] += 1
+                try:
+                    phases.record(json.loads(payload).get("phases"))
+                except ValueError:
+                    pass
             i += 1
 
     threads = [threading.Thread(target=worker, args=(t,), daemon=True)
@@ -546,11 +676,64 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
         "url": url, "duration_s": round(elapsed, 2), "models": names,
         "concurrency": concurrency, "completed": completed[0],
         "rejected": rejected[0], "errors": len(errors),
+        "first_errors": errors[:3],
         "rps": round(completed[0] / elapsed, 1) if elapsed else 0.0,
         "phase_breakdown": phases.report(),
         "traced_requests": phases.traced,
     }
     report.update(_percentiles(sorted(lats)))
+    _connect_fields(report, clients, concurrency)
+    for cl in clients:
+        cl.close()
+    return report
+
+
+# ------------------------------------------------- multi-process (fleet) --
+
+def run_fleet(workers=2, duration=10.0, concurrency=8, models=2, dim=16,
+              policy=None, run_dir=None, beat=0.25):
+    """Multi-process mode: an N-worker :class:`ServingFleet` (one
+    ModelServer process per worker behind the router) driven by the
+    same keep-alive closed loop as ``--url``. The report carries the
+    fleet's router counters (retries/rejects) and per-worker census so
+    the 1→N scaling number is auditable. Autoscaling is pinned off
+    (min == max == workers): this harness measures the router path at a
+    fixed census."""
+    import tempfile
+
+    from mxnet_tpu.serving import fleet as fleet_mod
+    from mxnet_tpu.serving import worker as worker_mod
+
+    root = run_dir or tempfile.mkdtemp(prefix="loadgen_fleet_")
+    model_dir = os.path.join(root, "models")
+    worker_mod.write_spec(model_dir,
+                          worker_mod.demo_spec(models=models, dim=dim))
+    fl = fleet_mod.ServingFleet(
+        model_dir, workers=workers, run_dir=os.path.join(root, "run"),
+        policy=policy,
+        config={"min": workers, "max": workers, "beat": beat},
+        name=f"loadgen-{workers}w")
+    t0 = time.perf_counter()
+    fl.start()
+    startup_s = time.perf_counter() - t0
+    try:
+        report = run_http(fl.url, duration=duration,
+                          concurrency=concurrency, dim=dim)
+        stats = fl.stats()
+    finally:
+        fl.stop()
+    report.update({
+        "harness": "loadgen-fleet",
+        "workers": workers,
+        "policy": stats["policy"],
+        "fleet_startup_s": round(startup_s, 2),
+        "router": stats["router"],
+        "per_worker": {
+            slot: {k: w.get(k) for k in ("rps", "queue_depth", "p99_ms",
+                                         "restarts")}
+            for slot, w in stats["workers"].items()},
+        "run_dir": fl.run_dir,
+    })
     return report
 
 
@@ -576,6 +759,14 @@ def main(argv=None):
     ap.add_argument("--url", default=None,
                     help="drive an EXTERNAL front end instead of building "
                          "an in-process server")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="multi-process mode: spawn an N-worker "
+                         "ServingFleet and drive the router closed-loop "
+                         "(the 1->N rps scaling measurement)")
+    ap.add_argument("--policy", default=None,
+                    choices=("least_loaded", "hash", "round_robin"),
+                    help="fleet routing policy (--workers mode; default "
+                         "least_loaded)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the pre-traffic bucket warmup (recompiles "
                          "will then land inside the measured window)")
@@ -615,6 +806,21 @@ def main(argv=None):
         print(json.dumps(report), flush=True)
         errs = sum(s["errors"] for s in report["variants"].values())
         return 0 if errs == 0 else 1
+
+    if args.workers:
+        report = run_fleet(workers=args.workers, duration=args.duration,
+                           concurrency=args.concurrency,
+                           models=args.models, dim=args.dim,
+                           policy=args.policy)
+        print(f"loadgen fleet: {args.workers} worker(s) -> "
+              f"{report['rps']} req/s, p50 {report.get('p50_ms')}ms "
+              f"p99 {report.get('p99_ms')}ms, "
+              f"{report['router'].get('retries', 0)} router retries, "
+              f"{report['reconnects']} reconnects "
+              f"(connect {report.get('connect_ms_mean')}ms mean)",
+              file=sys.stderr, flush=True)
+        print(json.dumps(report), flush=True)
+        return 0 if report.get("errors", 0) == 0 else 1
 
     if args.url:
         report = run_http(args.url, duration=args.duration,
