@@ -48,6 +48,14 @@ BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
 auto-detected from the chip generation — v5e 197, v5p 459, v4 275, ...;
 an on-chip measured peak is also reported as measured_peak_tflops);
 BENCH_TRAIN_CPU_BATCH/_ITERS size the --train smoke.
+
+Per-family ``kernel_vs_xla_<family>`` lines are emitted BY DEFAULT
+(disable with BENCH_SKIP_KERNELS=1, run just them with
+``--kernels-only``): the kernel-layer autotuner (opperf --kernels)
+timing each Pallas kernel family against its XLA baseline and
+refreshing the persisted dispatch table. Off-TPU lines carry
+``interpret: true`` — interpreter numerics-health lines, not chip perf.
+BENCH_KERNEL_RUNS sizes the timing loop.
 """
 import json
 import os
@@ -132,7 +140,13 @@ def main(argv=None):
                     help="emit ONLY the serving metric")
     ap.add_argument("--dataplane-only", action="store_true",
                     help="emit ONLY the host data-plane metric")
+    ap.add_argument("--kernels-only", action="store_true",
+                    help="emit ONLY the per-family kernel-vs-XLA lines")
     args = ap.parse_args(argv)
+
+    if args.kernels_only:
+        bench_kernels()
+        return
 
     if args.serve_only:
         bench_serve()
@@ -243,6 +257,10 @@ def main(argv=None):
     # BENCH_SKIP_DATAPLANE=1 opts out
     if not os.environ.get("BENCH_SKIP_DATAPLANE"):
         bench_dataplane()
+    # per-family Pallas-kernel-vs-XLA speedup lines (the kernel-layer
+    # trajectory); BENCH_SKIP_KERNELS=1 opts out
+    if not os.environ.get("BENCH_SKIP_KERNELS"):
+        bench_kernels()
 
 
 def bench_train(ctx, batch, dtype, iters, model):
@@ -351,6 +369,15 @@ def bench_train_cpu():
     }
     _mfu_xla_fields(line, "trainer", iters / elapsed)
     _gradcomms_fields(line, steps=iters)
+    # optimizer-phase split from the step telemetry: the fused step runs
+    # fwd+bwd+optimizer (incl. the kernel-layer opt_sgd/opt_adam dispatch)
+    # as ONE executable, so a healthy line shows the optimizer phase
+    # collapsed to ~0 with its cost folded into compute — a regression
+    # that re-splits the step shows up here as a nonzero optimizer_ms
+    rep = trainer.step_report()
+    if rep and rep.get("phases"):
+        line["optimizer_ms"] = round(rep["phases"].get("optimizer", 0.0), 3)
+        line["compute_ms"] = round(rep["phases"].get("compute", 0.0), 3)
     print(json.dumps(_compile_fields(line)), flush=True)
 
 
@@ -570,6 +597,48 @@ def bench_dataplane():
     }
     iter_bench._persist(line)
     print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_kernels():
+    """Per-family kernel-vs-XLA speedup lines from the kernel-layer
+    autotuner (benchmark/opperf.py bench_kernels): one
+    ``kernel_vs_xla_<family>`` JSON line per registry family, recording
+    the measured speedup, the winner the dispatch table now routes to,
+    and the shape bucket that was timed. Off-TPU the kernel side runs
+    in the Pallas INTERPRETER — those lines carry ``interpret: true``
+    and a deliberately honest (usually <1x) speedup: they track kernel
+    NUMERICS health on CPU hosts, not performance; only
+    ``interpret: false`` lines belong in the chip perf series. The run
+    also refreshes the persisted dispatch table, so the bench doubles
+    as the autotune pass. BENCH_SKIP_KERNELS=1 opts out."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmark"))
+    import opperf
+
+    runs = int(os.environ.get("BENCH_KERNEL_RUNS", 5))
+    res = opperf.bench_kernels(runs=runs, warmup=2)
+    platform = "tpu" if any(not r.get("interpret")
+                            for r in res["results"]) else "cpu"
+    for r in res["results"]:
+        k_ms, x_ms = r.get("kernel_ms"), r.get("xla_ms")
+        line = {
+            "metric": f"kernel_vs_xla_{r['family']}",
+            "value": round(x_ms / k_ms, 3) if k_ms and x_ms else None,
+            "unit": "x_speedup",
+            "winner": r["winner"],
+            "kernel_ms": k_ms,
+            "xla_ms": x_ms,
+            "bucket": r["bucket"],
+            # interpret=true means the Pallas interpreter, NOT a chip
+            # kernel — never compare these values against TPU lines
+            "interpret": bool(r.get("interpret")),
+            "platform": platform,
+        }
+        if r.get("error"):
+            line["error"] = r["error"]
+        print(json.dumps(line), flush=True)
 
 
 def _peak_tflops():

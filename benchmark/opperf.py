@@ -191,6 +191,118 @@ def bench_dispatch(chain_len=16, bulk=16, size=_DEFAULT_SIZE, iters=250,
     }
 
 
+def _kernel_cases():
+    """(family, builder) shape cases for the kernel autotuner. Builders
+    return (args, kwargs) concrete enough to jit both sides; each case
+    lands in ONE dispatch-table bucket."""
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(0)
+
+    def f32(*shape):
+        return jnp.asarray(r.standard_normal(shape, dtype=np.float32))
+
+    # NB: static scalars (scale, thr) ride in kwargs so the jit wrapper
+    # below only traces the array positions — they bake into the kernel
+
+    def flash():
+        q, k, v = f32(1, 2, 128, 64), f32(1, 2, 128, 64), f32(1, 2, 128, 64)
+        return (q, k, v), {"scale": 0.125, "causal": True}
+
+    def opt_sgd():
+        n = 65536
+        return (f32(n), f32(n), f32(n), jnp.float32(0.05)), \
+            {"momentum": 0.9, "wd": 1e-4}
+
+    def opt_adam():
+        n = 65536
+        return (f32(n), f32(n), f32(n), f32(n), jnp.float32(1e-3)), \
+            {"wd": 1e-4}
+
+    def int8_gemm():
+        qx = jnp.asarray(r.integers(-127, 128, (128, 256)), dtype=jnp.int8)
+        w = jnp.asarray(r.integers(-127, 128, (256, 256)), dtype=jnp.int8)
+        sc = jnp.asarray(r.random(256), dtype=jnp.float32) * 0.01
+        return (qx, w, sc), {"bias": f32(256), "relu": True}
+
+    def decode():
+        q, k, v = f32(2, 2, 64), f32(2, 2, 256, 64), f32(2, 2, 256, 64)
+        lens = jnp.asarray([256, 100], dtype=jnp.int32)
+        return (q, k, v, lens), {"scale": 0.125}
+
+    def twobit_c():
+        n = 65536
+        return (f32(n), f32(n) * 0.1), {"thr": 0.5}
+
+    def twobit_d():
+        codes = jnp.asarray(r.integers(-4, 5, 65536), dtype=jnp.int8)
+        return (codes,), {"thr": 0.5}
+
+    return [("flash_attention", flash), ("opt_sgd", opt_sgd),
+            ("opt_adam", opt_adam), ("int8_gemm", int8_gemm),
+            ("decode_attention", decode), ("twobit_compress", twobit_c),
+            ("twobit_decompress", twobit_d)]
+
+
+def _time_jitted(fn, args, runs, warmup):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / runs * 1e3
+
+
+def bench_kernels(runs=10, warmup=3, families=None):
+    """The kernel autotuner: time each registry family's Pallas kernel
+    against its XLA baseline per shape bucket, record the winner in the
+    persisted dispatch table (mxnet_tpu/kernels/table.py). Off-TPU the
+    kernel side runs in the Pallas interpreter — rows are stamped
+    ``interpret: true`` and honestly lose to XLA (the table then routes
+    dispatch to XLA, which IS the tuned decision for this backend)."""
+    import jax
+    from mxnet_tpu import kernels as klayer
+    from mxnet_tpu.kernels import table as ktable
+
+    interp = not klayer.on_tpu()
+    t_start = time.time()
+    results = []
+    for fam, build in _kernel_cases():
+        if families and fam not in families:
+            continue
+        args, kwargs = build()
+        e = klayer.entry(fam)
+        if not e.supports(*args, **kwargs):
+            continue
+        bucket = e.bucket(*args, **kwargs)
+        kfn = jax.jit(
+            lambda *a, _e=e, _kw=kwargs: _e.kernel(*a, interpret=interp,
+                                                   **_kw))
+        xfn = jax.jit(lambda *a, _e=e, _kw=kwargs: _e.xla(*a, **_kw))
+        try:
+            k_ms = _time_jitted(kfn, args, runs, warmup)
+        except Exception as exc:  # kernel unbuildable here: XLA wins
+            row = ktable.record(fam, bucket, "xla", None, None,
+                                interpret=interp)
+            results.append({"family": fam, "bucket": bucket,
+                            "error": str(exc)[:80], **row})
+            continue
+        x_ms = _time_jitted(xfn, args, runs, warmup)
+        winner = "kernel" if k_ms < x_ms else "xla"
+        row = ktable.record(fam, bucket, winner, k_ms, x_ms,
+                            interpret=interp)
+        results.append({"family": fam, "bucket": bucket, **row})
+    stamp = {"when": time.time(), "duration_s": round(
+        time.time() - t_start, 2), "runs": runs, "interpret": interp,
+        "cases": len(results),
+        "argv": " ".join(sys.argv[1:]) or "--kernels"}
+    ktable.set_opperf_stamp(stamp)
+    path = ktable.save()
+    return {"table_path": path, "stamp": stamp, "results": results}
+
+
 def run_benchmark(ops, size=_DEFAULT_SIZE, runs=10, warmup=2):
     results = []
     for name in ops:
@@ -214,11 +326,44 @@ def main():
     parser.add_argument("--dispatch", action="store_true",
                         help="run the engine-bulking dispatch-overhead "
                              "microbench instead of per-op timings")
+    parser.add_argument("--kernels", action="store_true",
+                        help="autotune the Pallas kernel layer: time "
+                             "kernel vs XLA per (family, shape bucket) "
+                             "and persist the winner dispatch table")
+    parser.add_argument("--families", type=str, default="",
+                        help="comma-separated kernel families for "
+                             "--kernels (default: all registered)")
     parser.add_argument("--chain", type=int, default=16,
                         help="op-chain length for --dispatch")
     parser.add_argument("--bulk", type=int, default=16,
                         help="bulk_size for the bulked side of --dispatch")
     args = parser.parse_args()
+
+    if args.kernels:
+        fams = [f for f in args.families.split(",") if f] or None
+        res = bench_kernels(runs=args.runs, warmup=args.warmup,
+                            families=fams)
+        if args.output_format == "json":
+            print(json.dumps(res, indent=2))
+        else:
+            where = res["table_path"] or "(memory only — set " \
+                "MXNET_TPU_CACHE_DIR to persist)"
+            print(f"kernel dispatch table -> {where}")
+            print(f"{'Family':<20s} {'Bucket':<34s} {'Kernel ms':>10s} "
+                  f"{'XLA ms':>9s} {'Speedup':>8s} {'Winner':>7s}")
+            for r in res["results"]:
+                k = r.get("kernel_ms")
+                x = r.get("xla_ms")
+                sp = r.get("speedup")
+                tag = r["winner"] + ("*" if r.get("interpret") else "")
+                print(f"{r['family']:<20s} {r['bucket']:<34s} "
+                      f"{k if k is not None else '-':>10} "
+                      f"{x if x is not None else '-':>9} "
+                      f"{sp if sp is not None else '-':>8} {tag:>7s}")
+            if any(r.get("interpret") for r in res["results"]):
+                print("* kernel timed in the Pallas INTERPRETER (no TPU "
+                      "here) — not a hardware speed claim")
+        return
 
     if args.dispatch:
         res = bench_dispatch(chain_len=args.chain, bulk=args.bulk,

@@ -1,0 +1,155 @@
+"""KV-cache decode attention — registry family ``decode_attention``.
+
+The serving decode step attends ONE new query per sequence against a
+padded KV cache: ``q (B, H, D)`` vs ``k/v (B, H, S, D)`` with a per-
+sequence valid length. The dense XLA path materializes the (B, H, S)
+score tensor in HBM and reads the whole padded cache; this kernel is
+single-query flash — online softmax over k blocks held in VMEM, with
+per-sequence lengths arriving through SMEM so fully-padded cache blocks
+are skipped outright (the ROADMAP item 1 continuous-batching
+prerequisite: decode cost tracks the *filled* cache, not the bucket).
+
+Contract: ``(q, k, v, lengths int32 (B,), scale) -> (B, H, D)`` where
+positions ``>= lengths[b]`` are masked out. ``lengths`` must be >= 1
+per row (a zero-length sequence has no attention distribution; the
+dense baseline NaNs on it too).
+
+Tolerance vs the XLA baseline: f32 rtol=2e-5/atol=2e-5 (same softmax-
+normalizer reassociation as flash_attention).
+"""
+from __future__ import annotations
+
+import functools as _functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decode_attention_reference"]
+
+
+def decode_attention_reference(q, k, v, lengths, scale):
+    """Dense masked single-query attention (the XLA dispatch baseline)."""
+    s = jnp.einsum("bhd,bhkd->bhk", q, k) * scale
+    smax = k.shape[2]
+    mask = jnp.arange(smax)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bhkd->bhd", p, v)
+
+
+def _decode_body(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                 acc_ref, *, scale, block_k, n_kb, n_heads):
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = len_ref[bh // n_heads]
+
+    # a block that starts at/after the valid length is pure padding —
+    # skip it entirely (this is where decode cost stops tracking S_max)
+    @pl.when(ki * block_k < seq_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # (1, d)
+        k_blk = k_ref[0].astype(jnp.float32)    # (block_k, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < seq_len, s, -jnp.inf)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _kernel(q, k, v, lengths, scale, block_k=128, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    smax = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, 1, d)
+    k3 = k.reshape(bh, smax, d)
+    v3 = v.reshape(bh, smax, d)
+    n_kb = smax // block_k
+    grid = (bh, n_kb)
+    body = _functools.partial(_decode_body, scale=float(scale),
+                              block_k=int(block_k), n_kb=n_kb,
+                              n_heads=h)
+    out = pl.pallas_call(
+        body,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths (B,)
+            pl.BlockSpec((1, 1, d), lambda i, kk: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, kk: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q3, k3, v3)
+    return out.reshape(b, h, d)
+
+
+def _xla(q, k, v, lengths, scale, block_k=128):
+    del block_k
+    return decode_attention_reference(q, k, v, lengths, scale)
+
+
+def _pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _bucket(q, k, v, lengths, scale, block_k=128):
+    b, h, d = q.shape
+    return (f"bh{_pow2(b * h)}_s{_pow2(k.shape[2])}_d{d}_"
+            f"{jnp.dtype(q.dtype).name}_k{block_k}")
+
+
+def _supports(q, k, v, lengths, scale, block_k=128):
+    if q.ndim != 3 or k.ndim != 4:
+        return False
+    d, smax = q.shape[2], k.shape[2]
+    return (smax % block_k == 0 and d % 8 == 0 and 0 < d <= 512
+            and lengths.ndim == 1 and lengths.shape[0] == q.shape[0])
+
+
+def _register():
+    from . import register_kernel
+
+    register_kernel(
+        "decode_attention", kernel=_kernel, xla=_xla, bucket=_bucket,
+        supports=_supports, default_tpu=True,
+        tolerance="f32 rtol=2e-5 atol=2e-5 vs dense masked softmax "
+                  "(normalizer reassociated across k blocks)")
+
+
+_register()

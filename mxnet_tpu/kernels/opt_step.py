@@ -1,0 +1,191 @@
+"""Fused optimizer step — registry families ``opt_sgd`` / ``opt_adam``.
+
+The reference's optimizer layer is per-op CUDA (optimizer_op-inl.h
+SGDMomKernel / AdamUpdateKernel); our XLA baseline is already one fused
+executable per step, so the win here is tighter: one Pallas program
+reads weight+grad+state tiles from VMEM once and writes the updated
+tensors, with the learning rate arriving through SMEM (a traced scalar —
+LR schedules never force a retrace). ``parallel/opt_rules.py`` routes
+the ShardedTrainer's sgd(momentum) and adam rules through
+``kernels.dispatch`` so the step timeline's optimizer phase stays folded
+into compute and the update itself stops being XLA's guess.
+
+Tensors of any shape are flattened and padded to (rows, 128) lanes —
+the f32 VPU tile — and the grid walks row blocks; padding lanes compute
+garbage that is sliced off (all operations are non-signalling on zeros).
+
+Tolerance vs the XLA baseline: BIT-EXACT for f32 tensors. The kernel
+body is the same IEEE op sequence as ``ops/optimizer_op.py``
+(rescale → clip → momentum/moment update → weight update) evaluated in
+f32; tests assert equality with ``==``, not allclose. Non-f32 weights
+(the multi-precision bf16 path) fall back to XLA — the baseline computes
+those in input dtype and a kernel would not match it bitwise.
+"""
+from __future__ import annotations
+
+import functools as _functools
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128       # f32 VPU lane width
+_BLOCK_ROWS = 256  # rows per grid step: 256*128*4B = 128 KiB per operand
+
+
+def _pad_rows(n):
+    rows = -(-n // _LANES)
+    return -(-rows // _BLOCK_ROWS) * _BLOCK_ROWS
+
+
+def _to_tiles(x):
+    """Flatten to (padded_rows, 128) f32 lanes."""
+    flat = x.reshape(-1)
+    rows = _pad_rows(flat.size)
+    pad = rows * _LANES - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(rows, _LANES)
+
+
+def _from_tiles(t, shape, size):
+    return t.reshape(-1)[:size].reshape(shape)
+
+
+def _prep(g_ref, rescale, clip):
+    g = g_ref[...] * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _sgd_mom_body(lr_ref, w_ref, g_ref, m_ref, w_out, m_out, *,
+                  momentum, wd, rescale, clip):
+    lr = lr_ref[0, 0]
+    w = w_ref[...]
+    g = _prep(g_ref, rescale, clip)
+    m_new = momentum * m_ref[...] - lr * (g + wd * w)
+    w_out[...] = w + m_new
+    m_out[...] = m_new
+
+
+def _adam_body(lr_ref, w_ref, g_ref, mean_ref, var_ref, w_out, mean_out,
+               var_out, *, beta1, beta2, epsilon, wd, rescale, clip):
+    lr = lr_ref[0, 0]
+    w = w_ref[...]
+    # adam-family prep: wd*weight folds in BEFORE the clip
+    # (optimizer_op._prep_grad_wd — ordering is part of the bit contract)
+    g = g_ref[...] * rescale + wd * w
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    mean_new = beta1 * mean_ref[...] + (1 - beta1) * g
+    var_new = beta2 * var_ref[...] + (1 - beta2) * jnp.square(g)
+    w_out[...] = w - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    mean_out[...] = mean_new
+    var_out[...] = var_new
+
+
+def _run(body, lr, tensors, n_out, interpret):
+    """Common pallas_call plumbing: SMEM scalar lr + row-blocked VMEM
+    operands, one output struct per updated tensor."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape, size = tensors[0].shape, tensors[0].size
+    tiles = [_to_tiles(t) for t in tensors]
+    rows = tiles[0].shape[0]
+    grid = (rows // _BLOCK_ROWS,)
+    blk = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    outs = pl.pallas_call(
+        body,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] +
+                 [blk] * len(tiles),
+        out_specs=[blk] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
+                   for _ in range(n_out)],
+        interpret=interpret,
+    )(lr_arr, *tiles)
+    return tuple(_from_tiles(o, shape, size) for o in outs)
+
+
+# ---- registry wiring -------------------------------------------------
+
+def _kernel_sgd(w, g, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, interpret=False):
+    body = _functools.partial(_sgd_mom_body, momentum=float(momentum),
+                              wd=float(wd), rescale=float(rescale_grad),
+                              clip=float(clip_gradient))
+    return _run(body, lr, [w, g, mom], 2, interpret)
+
+
+def _xla_sgd(w, g, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+             clip_gradient=-1.0):
+    from ..ops import optimizer_op as _op
+
+    return _op.sgd_mom_update.fn(
+        w, g, mom, lr=lr, momentum=momentum, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+
+
+def _kernel_adam(w, g, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, interpret=False):
+    body = _functools.partial(_adam_body, beta1=float(beta1),
+                              beta2=float(beta2), epsilon=float(epsilon),
+                              wd=float(wd), rescale=float(rescale_grad),
+                              clip=float(clip_gradient))
+    return _run(body, lr, [w, g, mean, var], 3, interpret)
+
+
+def _xla_adam(w, g, mean, var, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+              wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    from ..ops import optimizer_op as _op
+
+    return _op.adam_update.fn(
+        w, g, mean, var, lr=lr, beta1=beta1, beta2=beta2,
+        epsilon=epsilon, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient)
+
+
+def _bucket(w, *rest, **kw):
+    """Element-count bucket (pow2): the kernel is elementwise over the
+    flattened weight, so only the padded tile count and dtype matter."""
+    n = 1
+    for s in w.shape:
+        n *= s
+    p = 1
+    while p < n:
+        p *= 2
+    return f"n{p}_{jnp.dtype(w.dtype).name}"
+
+
+def _supports(w, *tensors_then_lr, **kw):
+    """f32 tensors only (the bit-exactness contract) with a scalar lr
+    and static-float hyperparameters (they bake into the kernel body);
+    anything else — e.g. the bf16 multi-precision path or a traced wd —
+    stays on XLA."""
+    *tensors, lr = tensors_then_lr
+    if jnp.ndim(lr) != 0:
+        return False
+    if w.size == 0:
+        return False
+    for v in kw.values():
+        if v is not None and not isinstance(v, (bool, int, float)):
+            return False
+    f32 = jnp.dtype(jnp.float32)
+    return all(jnp.dtype(t.dtype) == f32 for t in (w, *tensors))
+
+
+def _register():
+    from . import register_kernel
+
+    tol = ("bit-exact vs ops/optimizer_op.py for f32 tensors (same IEEE "
+           "op order); non-f32 falls back to XLA")
+    register_kernel("opt_sgd", kernel=_kernel_sgd, xla=_xla_sgd,
+                    bucket=_bucket, supports=_supports, tolerance=tol)
+    register_kernel("opt_adam", kernel=_kernel_adam, xla=_xla_adam,
+                    bucket=_bucket, supports=_supports, tolerance=tol)
+
+
+_register()

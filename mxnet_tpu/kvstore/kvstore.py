@@ -572,8 +572,11 @@ class _DistKVStore(KVStore):
             return
         if meta.get("thr") is not None:
             # summed 2-bit codes rescale to the original dtype
-            agg = NDArray(piece.reshape(shape).astype(meta["dtype"])
-                          * meta["thr"])
+            from .. import kernels as _kernels
+
+            agg = NDArray(_kernels.dispatch(
+                "twobit_decompress", piece.reshape(shape), meta["thr"],
+                dtype=meta["dtype"]))
         else:
             agg = NDArray(piece.reshape(shape))
         if self._updater is not None:
@@ -701,10 +704,16 @@ class _DistKVStore(KVStore):
         thr = float(self._compression.get("threshold", 0.5))
         raw = value._data
         res = self._residuals.get(key)
-        g = raw if res is None else raw + res
-        codes = jnp.where(g >= thr, jnp.int8(1),
-                          jnp.where(g <= -thr, jnp.int8(-1), jnp.int8(0)))
-        self._residuals[key] = g - codes.astype(g.dtype) * thr
+        if res is None:
+            res = jnp.zeros_like(raw)
+        # fused add-residual + threshold-quantize + residual-out in one
+        # pass (registry family twobit_compress; XLA baseline is the
+        # same compare/select/multiply soup this used to inline)
+        from .. import kernels as _kernels
+
+        codes, new_res = _kernels.dispatch("twobit_compress", raw, res,
+                                           thr)
+        self._residuals[key] = new_res
         return codes, {"shape": tuple(raw.shape),
                        "dtype": str(raw.dtype), "thr": thr}
 
@@ -714,7 +723,11 @@ class _DistKVStore(KVStore):
         codes across keys instead)."""
         codes, meta = self._quantize(key, value)
         summed = self._cross_host_sum(NDArray(codes))._data
-        return NDArray(summed.astype(meta["dtype"]) * meta["thr"])
+        from .. import kernels as _kernels
+
+        return NDArray(_kernels.dispatch("twobit_decompress", summed,
+                                         meta["thr"],
+                                         dtype=meta["dtype"]))
 
     def barrier(self):
         """Cross-host rendezvous, deadline-bounded via the

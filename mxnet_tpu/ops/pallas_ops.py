@@ -1,16 +1,15 @@
-"""Pallas TPU kernels for the hot ops.
+"""Pallas-backed attention ops — the op-registration shim over the
+kernel layer.
 
-The framework's compute path is XLA; where XLA's fusion falls short the
-reference drops to hand-written CUDA (`src/operator/contrib/
-transformer.cu` fused attention). The TPU analogue is Pallas: this module
-implements FLASH ATTENTION — blocked online-softmax attention that never
-materializes the (S, S) score matrix in HBM — as `_contrib_flash_attention`.
-
-Forward runs the Pallas kernel (VMEM-blocked, MXU matmuls per tile);
-backward is the blocked flash recurrence as well (custom_vjp recomputing
-probabilities tile-by-tile), so training memory stays O(S*block) end to
-end. Falls back to the dense XLA path when Pallas is unavailable or the
-shape fails the kernel's static constraints.
+The flash attention kernel itself lives in
+``mxnet_tpu/kernels/flash.py`` (PR 16 moved it into the kernel
+registry); this module keeps the *op* surface — ``_contrib_flash_
+attention`` and the serving-decode ``_contrib_decode_attention`` — and
+routes through :func:`mxnet_tpu.kernels.dispatch`, which picks kernel
+vs dense-XLA per (backend, shape bucket) from the autotuned dispatch
+table and LATCHES the Pallas-unavailable fallback (one ``log.warning``
++ ``mxtpu_kernels_fallback_total{family}`` per process, never a silent
+per-call re-probe — the old behavior here was exactly that bug).
 
 parity role: contrib transformer attention + the long-context machinery
 of SURVEY §5.7 (composes with parallel/ring_attention for the sharded
@@ -18,227 +17,15 @@ case: ring over devices, flash within a device).
 """
 from __future__ import annotations
 
-import functools as _functools
-
-import jax
-import jax.numpy as jnp
-
 from .registry import register
 
-__all__ = ["flash_attention_reference"]
+# Re-exported for callers and tests that treat this module as the home
+# of the attention numerics (tests/test_pallas.py imports both).
+from ..kernels.flash import flash_attention_reference  # noqa: F401
+from ..kernels.flash import flash_forward as _flash_forward  # noqa: F401, unused-import
+from ..kernels.decode_attention import decode_attention_reference  # noqa: F401
 
-
-def flash_attention_reference(q, k, v, scale, causal):
-    """Dense attention oracle (and autodiff path)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        qlen, klen = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((qlen, klen), bool))
-        s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
-
-
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, block_q, block_k, n_kb):
-    """One (batch*head, q-block, k-block) program. The TPU grid iterates
-    its LAST dimension sequentially, so the online-softmax state (m, l,
-    acc) carries across k blocks in VMEM scratch — only (block, d) tiles
-    ever live in VMEM, whatever the sequence length (the FlashAttention
-    recurrence)."""
-    from jax.experimental import pallas as pl
-
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    def compute():
-        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-        k_blk = k_ref[0].astype(jnp.float32)  # (block_k, d)
-        v_blk = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m = m_ref[...]
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        m_ref[...] = m_new
-        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        # blocks entirely above the diagonal contribute nothing
-        @pl.when(ki * block_k < (qi + 1) * block_q)
-        def _():
-            compute()
-    else:
-        compute()
-
-    @pl.when(ki == n_kb - 1)
-    def _finish():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
-
-
-def _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                   interpret=False):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    bh = b * h
-    q3 = q.reshape(bh, sq, d)
-    k3 = k.reshape(bh, sk, d)
-    v3 = v.reshape(bh, sk, d)
-    n_kb = sk // block_k
-    grid = (bh, sq // block_q, n_kb)
-    kernel = _functools.partial(_flash_kernel, scale=scale, causal=causal,
-                                block_q=block_q, block_k=block_k,
-                                n_kb=n_kb)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q3, k3, v3)
-    return out.reshape(b, h, sq, d)
-
-
-def _causal_mask(s, qi, ci, bq, bk):
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    k_pos = ci * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
-
-
-def _flash_backward(q, k, v, out, cot, scale, causal, bq, bk):
-    """Blocked flash backward (FlashAttention eq. 13-16) in pure JAX:
-    probabilities are recomputed per (q-block, k-block) tile, so live
-    memory stays O(S * block) — no (S, S) tensor ever exists, matching
-    the forward kernel's memory contract for training too."""
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    nbq, nbk = sq // bq, sk // bk
-    f32 = jnp.float32
-
-    def per_head(q2, k2, v2, o2, do2):
-        qb = q2.reshape(nbq, bq, d).astype(f32)
-        kb = k2.reshape(nbk, bk, d).astype(f32)
-        vb = v2.reshape(nbk, bk, d).astype(f32)
-        dob = do2.reshape(nbq, bq, d).astype(f32)
-        Dvec = (do2.astype(f32) * o2.astype(f32)).sum(-1).reshape(nbq, bq)
-
-        # pass 1: per-row max and normalizer (scan over k blocks)
-        def ml_one(qi, qblk):
-            def step(carry, kc):
-                m, l = carry
-                kcblk, ci = kc
-                s = qblk @ kcblk.T * scale
-                if causal:
-                    s = _causal_mask(s, qi, ci, bq, bk)
-                m_new = jnp.maximum(m, s.max(-1))
-                l = l * jnp.exp(m - m_new) + \
-                    jnp.exp(s - m_new[:, None]).sum(-1)
-                return (m_new, l), None
-
-            init = (jnp.full((bq,), -jnp.inf, f32), jnp.zeros((bq,), f32))
-            (m, l), _ = jax.lax.scan(step, init,
-                                     (kb, jnp.arange(nbk)))
-            return m, jnp.maximum(l, 1e-30)
-
-        m, l = jax.vmap(ml_one)(jnp.arange(nbq), qb)
-
-        # dq: per q block, accumulate over k blocks
-        def dq_one(qi, qblk, doblk, mrow, lrow, Drow):
-            def step(acc, kc):
-                kcblk, vcblk, ci = kc
-                s = qblk @ kcblk.T * scale
-                if causal:
-                    s = _causal_mask(s, qi, ci, bq, bk)
-                p = jnp.exp(s - mrow[:, None]) / lrow[:, None]
-                dp = doblk @ vcblk.T
-                ds = p * (dp - Drow[:, None])
-                return acc + ds @ kcblk * scale, None
-
-            acc, _ = jax.lax.scan(step, jnp.zeros((bq, d), f32),
-                                  (kb, vb, jnp.arange(nbk)))
-            return acc
-
-        dq = jax.vmap(dq_one)(jnp.arange(nbq), qb, dob, m, l, Dvec)
-
-        # dk, dv: per k block, accumulate over q blocks
-        def dkv_one(ci, kcblk, vcblk):
-            def step(carry, qc):
-                dk_acc, dv_acc = carry
-                qblk, doblk, mrow, lrow, Drow, qi = qc
-                s = qblk @ kcblk.T * scale
-                if causal:
-                    s = _causal_mask(s, qi, ci, bq, bk)
-                p = jnp.exp(s - mrow[:, None]) / lrow[:, None]
-                dp = doblk @ vcblk.T
-                ds = p * (dp - Drow[:, None])
-                return (dk_acc + ds.T @ qblk * scale,
-                        dv_acc + p.T @ doblk), None
-
-            init = (jnp.zeros((bk, d), f32), jnp.zeros((bk, d), f32))
-            (dk_acc, dv_acc), _ = jax.lax.scan(
-                step, init, (qb, dob, m, l, Dvec, jnp.arange(nbq)))
-            return dk_acc, dv_acc
-
-        dk, dv = jax.vmap(dkv_one)(jnp.arange(nbk), kb, vb)
-        return (dq.reshape(sq, d), dk.reshape(sk, d), dv.reshape(sk, d))
-
-    flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
-    dq, dk, dv = jax.vmap(per_head)(flat(q), flat(k), flat(v), flat(out),
-                                    flat(cot))
-    return (dq.reshape(q.shape).astype(q.dtype),
-            dk.reshape(k.shape).astype(k.dtype),
-            dv.reshape(v.shape).astype(v.dtype))
-
-
-@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
-
-
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                         interpret)
-    return out, (q, k, v, out)
-
-
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, cot):
-    q, k, v, out = res
-    return _flash_backward(q, k, v, out, cot, scale, causal, block_q,
-                           block_k)
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
+__all__ = ["flash_attention_reference", "decode_attention_reference"]
 
 
 @register("_contrib_flash_attention")
@@ -246,40 +33,43 @@ def _contrib_flash_attention(q, k, v, scale=None, causal=False,
                              block_q=128, block_k=128, interpret=False):
     """Fused attention over (B, H, S, D) tensors.
 
-    Pallas flash kernel when the shape is kernel-friendly (S divisible
-    by the block sizes, D a multiple of 8 up to 512 — the statically
-    checkable Mosaic constraints); dense XLA fallback otherwise.
-    `interpret=True` runs the kernel in the Pallas interpreter (CPU CI).
-    Training memory stays O(S*block): the backward is the blocked flash
-    recurrence, not a dense recompute."""
+    Dispatches to the Pallas flash kernel (registry family
+    ``flash_attention``) when the shape passes the statically checkable
+    Mosaic constraints AND the dispatch table (or the on-TPU default)
+    picks it; dense XLA softmax otherwise. `interpret=True` forces the
+    kernel through the Pallas interpreter (CPU CI). Training memory
+    stays O(S*block): the backward is the blocked flash recurrence, not
+    a dense recompute."""
     if q.ndim != 4:
         raise ValueError(
             f"flash_attention expects (B, H, S, D) inputs, got rank "
             f"{q.ndim}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
-    use_pallas = (sq % block_q == 0) and (sk % block_k == 0) \
-        and d % 8 == 0 and d <= 512 and _pallas_ok() \
-        and (_on_tpu() or interpret)
-    if use_pallas:
-        return _flash(q, k, v, float(scale), bool(causal),
-                      int(block_q), int(block_k), bool(interpret))
-    return flash_attention_reference(q, k, v, scale, causal)
+    from .. import kernels as _kernels
+
+    return _kernels.dispatch(
+        "flash_attention", q, k, v, float(scale), causal=bool(causal),
+        block_q=int(block_q), block_k=int(block_k),
+        interpret=bool(interpret) or None)
 
 
-@_functools.lru_cache(maxsize=1)
-def _pallas_ok():
-    try:
-        from jax.experimental import pallas  # noqa: F401
+@register("_contrib_decode_attention")
+def _contrib_decode_attention(q, k, v, lengths, scale=None, block_k=128,
+                              interpret=False):
+    """Single-query decode attention: ``q (B, H, D)`` against a padded
+    KV cache ``k/v (B, H, S, D)`` with per-sequence valid ``lengths
+    (B,)`` (each >= 1). Registry family ``decode_attention`` — the
+    Pallas kernel skips fully-padded cache blocks so decode cost tracks
+    the filled cache; dense masked softmax otherwise."""
+    if q.ndim != 3 or k.ndim != 4:
+        raise ValueError(
+            f"decode_attention expects q (B, H, D) and k/v (B, H, S, D),"
+            f" got ranks {q.ndim}/{k.ndim}")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    from .. import kernels as _kernels
 
-        return True
-    except ImportError:
-        return False
-
-
-def _on_tpu():
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
+    return _kernels.dispatch(
+        "decode_attention", q, k, v, lengths, float(scale),
+        block_k=int(block_k), interpret=bool(interpret) or None)

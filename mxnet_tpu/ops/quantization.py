@@ -99,6 +99,16 @@ def _quantized_fully_connected(data, weight, scale, bias=None, num_hidden=1,
         data = data.reshape((data.shape[0], -1))
     s_x = _scale(jnp.float32(min_calib_range), jnp.float32(max_calib_range))
     qx = _quantize(data, s_x)
+    if qx.ndim == 2:
+        # MXU-tiled Pallas GEMM with the dequant+bias epilogue fused in
+        # VMEM (registry family int8_gemm) where the dispatch table
+        # proved it; the XLA baseline is this op's original
+        # dot_general+epilogue, so routing is bit-exact either way
+        from .. import kernels as _kernels
+
+        return _kernels.dispatch(
+            "int8_gemm", qx, weight, s_x * scale,
+            bias=None if (bias is None or no_bias) else bias)
     acc = jax.lax.dot_general(
         qx, weight, (((qx.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32)

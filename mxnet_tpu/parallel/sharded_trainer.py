@@ -367,6 +367,8 @@ class ShardedTrainer:
         hypers = tuple(sorted(
             (k, v) for k, v in vars(self._opt).items()
             if isinstance(v, (int, float, bool, str, type(None)))))
+        from .. import kernels as _kernels
+
         blob = "\n".join([
             repr(self._net), repr(self._loss_fn), self._opt_name,
             repr(hypers), repr(self._wd), repr(self._wd_mult),
@@ -374,7 +376,11 @@ class ShardedTrainer:
             repr(sorted(self._rules.items())),
             repr(self._mesh.describe()),
             repr((self._donate, self._zero, self._remat, self._accum,
-                  self._nan_guard, self._grad_scatter))])
+                  self._nan_guard, self._grad_scatter)),
+            # kernel-dispatch identity: a retuned table or a flipped
+            # MXNET_TPU_KERNELS must not reuse an executable traced
+            # under the old routing
+            _kernels.token_salt()])
         return ("trainer", kind,
                 hashlib.sha1(blob.encode()).hexdigest()[:16])
 

@@ -75,7 +75,10 @@ def set_shard_info(**fields):
 
 
 def _atomic_json(path, obj):
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid alone is not unique enough: the gang heartbeat thread and a
+    # final main-thread write_shard can race on the same tmp name, and
+    # the loser's os.replace dies with FileNotFoundError (worker exit 1)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         json.dump(obj, f, default=repr)
         f.flush()

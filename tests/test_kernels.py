@@ -1,0 +1,467 @@
+"""Kernel layer (mxnet_tpu/kernels/): registry, dispatch, numerics.
+
+Numeric contracts asserted here (each family's ``tolerance`` field):
+
+* opt_sgd / opt_adam / int8_gemm / twobit_* are **bit-exact vs their XLA
+  baseline under jit** — both sides compiled, XLA applies the same FMA
+  contraction to both, so ``==`` holds elementwise. (Eager-vs-jit is NOT
+  bit-exact — op-by-op eager dispatch skips contraction — so the eager
+  comparisons below use a 1-ULP-scale allclose instead.)
+* flash_attention / decode_attention reorder the softmax reduction
+  (online/blocked), so they carry an rtol=2e-5 float32 contract.
+
+Dispatch semantics: table winner routes, corrupt table loads empty and
+falls back to untuned defaults, ``MXNET_TPU_KERNELS=0`` restores the
+baseline numerics bit-exactly, Pallas-unavailable latches with one
+warning, and bucket keys feed the distcheck pass-4 churn sweep.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kernels
+from mxnet_tpu.kernels import table as ktable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAMILIES = ("decode_attention", "flash_attention", "int8_gemm",
+            "opt_adam", "opt_sgd", "twobit_compress", "twobit_decompress")
+
+
+@pytest.fixture
+def kernel_cache_dir(tmp_path, monkeypatch):
+    """Fresh disk cache for the dispatch table; memory-only afterwards."""
+    from mxnet_tpu import compile as C
+
+    d = str(tmp_path / "cache")
+    monkeypatch.setenv("MXNET_TPU_CACHE_DIR", d)
+    C.configure(cache_dir=d)
+    ktable.invalidate()
+    yield d
+    C.configure(cache_dir=None)
+    ktable.invalidate()
+
+
+def _jit(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+# ===================================================================== #
+# registry census                                                       #
+# ===================================================================== #
+
+def test_registry_census():
+    assert kernels.families() == sorted(FAMILIES)
+    for fam in FAMILIES:
+        e = kernels.entry(fam)
+        assert callable(e.kernel) and callable(e.xla)
+        assert callable(e.bucket) and callable(e.supports)
+        assert e.tolerance, f"{fam}: numeric contract undocumented"
+    # serving-decode families default to the kernel on TPU
+    assert kernels.entry("flash_attention").default_tpu
+    assert kernels.entry("decode_attention").default_tpu
+
+
+# ===================================================================== #
+# per-family interpret-mode numerics vs the XLA baseline                #
+# ===================================================================== #
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_vs_xla(causal):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
+               for _ in range(3))
+    e = kernels.entry("flash_attention")
+    out = e.kernel(q, k, v, 0.125, causal=causal, interpret=True)
+    ref = e.xla(q, k, v, 0.125, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_attention_kernel_vs_xla():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    B, H, S, D = 2, 2, 256, 64
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    # ragged: one row stops mid-block, exercising the position mask AND
+    # the whole-block skip
+    lengths = jnp.asarray([S, 100], np.int32)
+    e = kernels.entry("decode_attention")
+    out = e.kernel(q, k, v, lengths, 0.125, interpret=True)
+    ref = e.xla(q, k, v, lengths, 0.125)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # positions past `lengths` must not leak into the output: growing
+    # the padded tail must not change row 1
+    k2 = k.at[1, :, 100:].set(1e4)
+    out2 = e.kernel(q, k2, v, lengths, 0.125, interpret=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _opt_inputs(n=5000, seed=2):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(n).astype(np.float32) * s)
+            for s in (1.0, 0.1, 0.01, 0.001)]
+
+
+def test_opt_sgd_bit_exact_under_jit():
+    w, g, mom, _ = _opt_inputs()
+    e = kernels.entry("opt_sgd")
+    kw = dict(momentum=0.9, wd=1e-4, rescale_grad=0.5, clip_gradient=1.0)
+    kfn = _jit(lambda *a: e.kernel(*a, interpret=True, **kw))
+    xfn = _jit(lambda *a: e.xla(*a, **kw))
+    w_k, m_k = kfn(w, g, mom, 0.05)
+    w_x, m_x = xfn(w, g, mom, 0.05)
+    assert np.array_equal(np.asarray(w_k), np.asarray(w_x))
+    assert np.array_equal(np.asarray(m_k), np.asarray(m_x))
+    # ... and the eager op it replaces (1-ULP-scale tolerance: the eager
+    # path skips the FMA contraction jit applies to both sides above)
+    from mxnet_tpu.ops import optimizer_op as op
+
+    w_e, m_e = op.sgd_mom_update.fn(w, g, mom, lr=0.05, **kw)
+    np.testing.assert_allclose(np.asarray(w_k), np.asarray(w_e),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_e),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_opt_adam_bit_exact_under_jit():
+    w, g, mean, var = _opt_inputs(seed=3)
+    var = abs(var)
+    e = kernels.entry("opt_adam")
+    kw = dict(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=1e-4,
+              rescale_grad=1.0, clip_gradient=-1.0)
+    kfn = _jit(lambda *a: e.kernel(*a, interpret=True, **kw))
+    xfn = _jit(lambda *a: e.xla(*a, **kw))
+    got = kfn(w, g, mean, var, 0.001)
+    want = xfn(w, g, mean, var, 0.001)
+    for a, b in zip(got, want):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    from mxnet_tpu.ops import optimizer_op as op
+
+    eager = op.adam_update.fn(w, g, mean, var, lr=0.001, **kw)
+    for a, b in zip(got, eager):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("relu,bias", [(False, False), (True, True)])
+def test_int8_gemm_bit_exact_under_jit(relu, bias):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    qx = jnp.asarray(rng.randint(-127, 128, (48, 96)).astype(np.int8))
+    w = jnp.asarray(rng.randint(-127, 128, (64, 96)).astype(np.int8))
+    scale = jnp.asarray((rng.rand(64) * 0.01 + 1e-4).astype(np.float32))
+    b = jnp.asarray(rng.randn(64).astype(np.float32)) if bias else None
+    e = kernels.entry("int8_gemm")
+    kfn = _jit(lambda *a: e.kernel(*a, bias=b, relu=relu, interpret=True))
+    xfn = _jit(lambda *a: e.xla(*a, bias=b, relu=relu))
+    out_k = kfn(qx, w, scale)
+    out_x = xfn(qx, w, scale)
+    assert out_k.shape == (48, 64)
+    # the XLA baseline IS the quantization.py fused-op math — bit
+    # equality here is the int8-GEMM-vs-fused-ops exactness contract
+    assert np.array_equal(np.asarray(out_k), np.asarray(out_x))
+    if relu:
+        assert float(np.asarray(out_k).min()) >= 0.0
+
+
+def test_int8_gemm_matches_quantized_fc_op():
+    """End to end through the _contrib_quantized_fully_connected op (the
+    registry consumer): same answer with kernels enabled and disabled."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 16).astype(np.float32)
+    w = (rng.randn(8, 16) * 0.1).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    absmax = np.abs(w).max(axis=1)
+    scale = (absmax / 127.0).astype(np.float32)
+    qw = np.clip(np.round(w / scale[:, None]), -127, 127).astype(np.int8)
+
+    def run():
+        return mx.nd.invoke(
+            "_contrib_quantized_fully_connected", mx.nd.array(x),
+            mx.nd.array(qw, dtype="int8"), mx.nd.array(scale),
+            mx.nd.array(b), num_hidden=8, min_calib_range=float(x.min()),
+            max_calib_range=float(x.max())).asnumpy()
+
+    base = run()
+    os.environ["MXNET_TPU_KERNELS"] = "0"
+    try:
+        off = run()
+    finally:
+        os.environ.pop("MXNET_TPU_KERNELS", None)
+    assert np.array_equal(base, off)
+    rel = np.abs(base - (x @ w.T + b)).max() / np.abs(x @ w.T + b).max()
+    assert rel < 0.05
+
+
+def test_twobit_bit_exact_under_jit():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(6)
+    g = jnp.asarray(rng.randn(4096).astype(np.float32))
+    res = jnp.asarray(rng.randn(4096).astype(np.float32) * 0.1)
+    ce = kernels.entry("twobit_compress")
+    de = kernels.entry("twobit_decompress")
+    # thr is a STATIC hyperparameter (baked into the kernel body), so it
+    # must be closed over, not traced through jit
+    ckfn = _jit(lambda a, b: ce.kernel(a, b, 0.5, interpret=True))
+    cxfn = _jit(lambda a, b: ce.xla(a, b, 0.5))
+    codes_k, res_k = ckfn(g, res)
+    codes_x, res_x = cxfn(g, res)
+    assert codes_k.dtype == np.int8
+    assert np.array_equal(np.asarray(codes_k), np.asarray(codes_x))
+    assert np.array_equal(np.asarray(res_k), np.asarray(res_x))
+    assert set(np.unique(np.asarray(codes_k))) <= {-1, 0, 1}
+    dk = _jit(lambda c: de.kernel(c, 0.5, interpret=True))(codes_k)
+    dx = _jit(lambda c: de.xla(c, 0.5))(codes_x)
+    assert np.array_equal(np.asarray(dk), np.asarray(dx))
+
+
+# ===================================================================== #
+# dispatch routing                                                      #
+# ===================================================================== #
+
+def _flash_args():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
+               for _ in range(3))
+    return q, k, v, 0.125
+
+
+def test_dispatch_env_disabled_restores_baseline_bitexact(monkeypatch):
+    q, k, v, scale = _flash_args()
+    e = kernels.entry("flash_attention")
+    monkeypatch.setenv("MXNET_TPU_KERNELS", "0")
+    assert not kernels.enabled()
+    assert kernels.choice_for("flash_attention", q, k, v, scale) \
+        == ("xla", "env_disabled")
+    out = kernels.dispatch("flash_attention", q, k, v, scale)
+    # the opt-out IS the baseline: same callable, bit-identical result
+    assert np.array_equal(np.asarray(out), np.asarray(e.xla(q, k, v, scale)))
+
+
+def test_dispatch_untuned_default_and_interpret_forced():
+    q, k, v, scale = _flash_args()
+    choice, reason = kernels.choice_for("flash_attention", q, k, v, scale)
+    if kernels.on_tpu():  # pragma: no cover - CPU CI
+        assert (choice, reason) == ("kernel", "untuned_default_tpu")
+    else:
+        assert (choice, reason) == ("xla", "untuned_default")
+    kernels.reset_stats()
+    out = kernels.dispatch("flash_attention", q, k, v, scale,
+                           interpret=True)
+    assert out.shape == q.shape
+    st = kernels.dispatch_stats()["flash_attention"]
+    assert st["kernel"] == 1
+    assert st["reasons"] == {"interpret_forced": 1}
+
+
+def test_dispatch_unsupported_shape_falls_back():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(8)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 100, 64).astype(np.float32))
+               for _ in range(3))  # 100 % 128 != 0
+    assert kernels.choice_for("flash_attention", q, k, v, 0.125) \
+        == ("xla", "unsupported_shape")
+    out = kernels.dispatch("flash_attention", q, k, v, 0.125,
+                           interpret=True)  # still safe: routes to XLA
+    assert out.shape == q.shape
+
+
+def test_dispatch_tuned_table_routes(kernel_cache_dir):
+    q, k, v, scale = _flash_args()
+    e = kernels.entry("flash_attention")
+    bucket = e.bucket(q, k, v, scale)
+    ktable.record("flash_attention", bucket, "kernel", 1.0, 2.0)
+    assert ktable.save()
+    ktable.invalidate()
+    assert kernels.choice_for("flash_attention", q, k, v, scale) \
+        == ("kernel", "tuned")
+    ktable.record("flash_attention", bucket, "xla", 2.0, 1.0)
+    assert kernels.choice_for("flash_attention", q, k, v, scale) \
+        == ("xla", "tuned")
+
+
+def test_dispatch_table_corrupt_entry_falls_back(kernel_cache_dir):
+    from mxnet_tpu.telemetry import registry as treg
+
+    q, k, v, scale = _flash_args()
+    e = kernels.entry("flash_attention")
+    bucket = e.bucket(q, k, v, scale)
+    ktable.record("flash_attention", bucket, "kernel", 1.0, 2.0)
+    path = ktable.save()
+    # torn write: flip bytes INSIDE the entries payload so json still
+    # parses but the CRC no longer matches
+    with open(path, "r", encoding="utf-8") as f:
+        raw = f.read()
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(raw.replace('"winner": "kernel"', '"winner": "xlaaaa"'))
+    m = treg.get("mxtpu_kernels_table_corrupt_total")
+    before = sum(m.series().values()) if m is not None else 0
+    ktable.invalidate()
+    t = ktable.load()
+    assert t["entries"] == {}  # corrupt loads EMPTY, never raises
+    assert ktable.census()["corrupt_seen"]
+    assert "CRC" in ktable.census()["corrupt_seen"]
+    m = treg.get("mxtpu_kernels_table_corrupt_total")
+    assert sum(m.series().values()) == before + 1
+    # dispatch falls back to the untuned default, and still answers
+    assert kernels.choice_for("flash_attention", q, k, v, scale)[1] \
+        in ("untuned_default", "untuned_default_tpu")
+    out = kernels.dispatch("flash_attention", q, k, v, scale)
+    assert out.shape == q.shape
+    # unparseable garbage loads empty too
+    with open(path, "wb") as f:
+        f.write(b"\x00garbage\xff")
+    ktable.invalidate()
+    assert ktable.load()["entries"] == {}
+
+
+def test_pallas_unavailable_latches_once(monkeypatch, caplog):
+    import logging
+
+    q, k, v, scale = _flash_args()
+    monkeypatch.setattr(kernels, "pallas_available", lambda: False)
+    kernels._warned_families.discard("flash_attention")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.kernels"):
+        for _ in range(3):
+            out = kernels.dispatch("flash_attention", q, k, v, scale)
+    assert out.shape == q.shape
+    warns = [r for r in caplog.records if "Pallas unavailable" in r.message]
+    assert len(warns) == 1  # latched: one warning, not one per call
+    assert "flash_attention" in kernels.fallback_report()["warned_families"]
+
+
+def test_token_salt_tracks_dispatch_state(monkeypatch, kernel_cache_dir):
+    ktable.invalidate()
+    base = kernels.token_salt()
+    monkeypatch.setenv("MXNET_TPU_KERNELS", "0")
+    assert kernels.token_salt() != base  # flipped gate -> new executable
+    monkeypatch.delenv("MXNET_TPU_KERNELS")
+    assert kernels.token_salt() == base
+    q, k, v, scale = _flash_args()
+    e = kernels.entry("flash_attention")
+    ktable.record("flash_attention", e.bucket(q, k, v, scale), "kernel",
+                  1.0, 2.0)
+    assert kernels.token_salt() != base  # retuned table -> new identity
+
+
+# ===================================================================== #
+# distcheck pass 4 — dispatch keys must not churn                       #
+# ===================================================================== #
+
+def test_dispatch_keys_no_churn():
+    from mxnet_tpu.analysis import distcheck
+
+    q, k, v, scale = _flash_args()
+    distcheck.reset_cache_stats()
+    kernels.reset_stats()
+    for _ in range(6):
+        kernels.choice_for("flash_attention", q, k, v, scale)
+    stats = distcheck.cache_stats()
+    site = stats.get(("dispatch", "kernels.flash_attention"))
+    assert site is not None, stats
+    # a pure bucketing function: ONE legitimate miss, then hits
+    assert site["misses"] == 1 and site["hits"] == 5
+    assert not [i for i in distcheck.check_churn()
+                if "kernels.flash_attention" in i.node]
+    distcheck.reset_cache_stats()
+
+
+# ===================================================================== #
+# autotuner — opperf --kernels writes the persisted table               #
+# ===================================================================== #
+
+def test_opperf_kernels_writes_table(kernel_cache_dir):
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    import opperf
+
+    res = opperf.bench_kernels(runs=2, warmup=1,
+                               families=["twobit_compress",
+                                         "twobit_decompress"])
+    assert res["table_path"] and os.path.exists(res["table_path"])
+    assert len(res["results"]) == 2
+    for r in res["results"]:
+        assert r["winner"] in ("kernel", "xla")
+        if not kernels.on_tpu():
+            assert r["interpret"] is True  # honest off-TPU stamp
+    ktable.invalidate()  # force the disk round-trip (CRC verifies)
+    t = ktable.load()
+    assert len(t["entries"]) == 2
+    assert t["opperf"]["runs"] == 2
+    assert ktable.census()["corrupt_seen"] is None \
+        or "CRC" not in ktable.census()["corrupt_seen"]
+    # the measured winner now routes dispatch for that exact bucket
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(9)
+    g = jnp.asarray(rng.randn(65536).astype(np.float32))
+    r0 = jnp.zeros_like(g)
+    choice, reason = kernels.choice_for("twobit_compress", g, r0, 0.5)
+    assert reason == "tuned"
+    key = "twobit_compress|" + \
+        kernels.entry("twobit_compress").bucket(g, r0, 0.5)
+    assert choice == t["entries"][key]["winner"]
+
+
+# ===================================================================== #
+# trainer integration — fused optimizer step parity                     #
+# ===================================================================== #
+
+@pytest.mark.slow
+def test_trainer_parity_kernels_on_vs_off():
+    """Three ShardedTrainer steps land on identical weights with the
+    kernel layer enabled and with MXNET_TPU_KERNELS=0 — the end-to-end
+    numerics-parity opt-out contract."""
+    from mxnet_tpu.gluon import loss as gloss, nn
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    def run():
+        mx.random.seed(0)
+        net = nn.Dense(4)
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(np.random.RandomState(0).randn(8, 6)
+                        .astype(np.float32))
+        y = mx.nd.array(np.random.RandomState(1).randint(0, 4, 8)
+                        .astype(np.float32))
+        net(x)  # materialize deferred shapes
+        tr = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            mesh=DeviceMesh({"dp": 1}), nan_guard=False)
+        for _ in range(3):
+            tr.step(x, y).wait_to_read()
+        return {k: v.data().asnumpy() for k, v in
+                net.collect_params().items()}
+
+    base = run()
+    os.environ["MXNET_TPU_KERNELS"] = "0"
+    try:
+        off = run()
+    finally:
+        os.environ.pop("MXNET_TPU_KERNELS", None)
+    # gluon's global name counter differs between runs (dense0 vs
+    # dense1) — compare positionally on the sorted suffix
+    def vals(d):
+        return [d[k] for k in sorted(d, key=lambda n: n.split("_", 1)[-1])]
+
+    assert len(base) == len(off)
+    for a, b in zip(vals(base), vals(off)):
+        assert np.array_equal(a, b)

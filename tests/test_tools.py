@@ -237,6 +237,42 @@ def test_mxlint_raw_jit_rule_scoping(tmp_path):
 
 
 @pytest.mark.lint
+def test_mxlint_raw_pallas_call_rule(tmp_path):
+    """raw-pallas-call fires on pl.pallas_call outside mxnet_tpu/kernels/
+    (with a did-you-mean pointing at the registry) and is exempt inside
+    kernels/ — the one blessed home of raw Pallas call sites."""
+    import mxlint
+
+    src = ("from jax.experimental import pallas as pl\n"
+           "def f(x):\n"
+           "    return pl.pallas_call(lambda i, o: None)(x)\n")
+    ops = tmp_path / "mxnet_tpu" / "ops"
+    ops.mkdir(parents=True)
+    bad = ops / "planted.py"
+    bad.write_text(src)
+    findings = [f for f in mxlint.run([str(bad)], root=str(tmp_path))
+                if f.rule == "raw-pallas-call"]
+    assert len(findings) == 1
+    assert "register_kernel" in findings[0].message
+    assert "kernels.dispatch" in findings[0].message
+
+    kern = tmp_path / "mxnet_tpu" / "kernels"
+    kern.mkdir(parents=True)
+    ok = kern / "mykernel.py"
+    ok.write_text(src)
+    assert [f for f in mxlint.run([str(ok)], root=str(tmp_path))
+            if f.rule == "raw-pallas-call"] == []
+
+    # the real tree carries zero raw-pallas-call debt: flash moved into
+    # the registry, so the baseline must not need a single entry
+    findings = [f for f in mxlint.run(["mxnet_tpu"])
+                if f.rule == "raw-pallas-call"]
+    assert findings == []
+    with open(mxlint.DEFAULT_BASELINE) as fh:
+        assert "raw-pallas-call" not in fh.read()
+
+
+@pytest.mark.lint
 def test_mxlint_serving_blocking_call_rule(tmp_path):
     """serving-blocking-call: serving/ code may not block outside a
     watchdog.sync span — device syncs and zero-arg waits fire; callables

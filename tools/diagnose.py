@@ -778,6 +778,98 @@ def check_gradcomms():
     return out
 
 
+def check_kernels():
+    """Pallas kernel layer (docs/PERFORMANCE.md "Pallas kernel layer"):
+    registry census, dispatch-table location/entries/staleness, per-
+    family dispatch win/loss + fallback latches, and the last
+    ``opperf --kernels`` autotune run — everything needed to answer
+    "which op families actually run their Pallas kernel here, and did
+    anything fall back silently?"."""
+    _p("---------Kernels----------")
+    out = {}
+    try:
+        from mxnet_tpu import kernels as klayer
+
+        fams = klayer.families()
+        out["families"] = fams
+        out["enabled"] = klayer.enabled()
+        out["pallas_available"] = klayer.pallas_available()
+        out["on_tpu"] = klayer.on_tpu()
+        gate = "" if klayer.enabled() else "  [MXNET_TPU_KERNELS=0 — " \
+            "every family forced to XLA]"
+        _p(f"registry      : {len(fams)} families "
+           f"({', '.join(fams)}){gate}")
+        _p(f"pallas        : "
+           f"{'available' if out['pallas_available'] else 'UNAVAILABLE'}"
+           f", backend={'tpu' if out['on_tpu'] else 'non-tpu'}")
+
+        census = klayer.table.census()
+        out["table"] = census
+        if census["path"] is None:
+            _p("dispatch table: memory-only (no MXNET_TPU_CACHE_DIR)")
+        else:
+            state = "present" if census["exists"] else "ABSENT"
+            _p(f"dispatch table: {census['path']} [{state}] "
+               f"fp={census['fingerprint']} backend={census['backend']}")
+        w = census["winners"]
+        _p(f"  entries     : {census['entries']} "
+           f"(kernel wins {w.get('kernel', 0)}, "
+           f"xla wins {w.get('xla', 0)})")
+        for fam, rec in sorted(census["per_family"].items()):
+            _p(f"    {fam:<20s} kernel={rec.get('kernel', 0)} "
+               f"xla={rec.get('xla', 0)}")
+        if census["corrupt_seen"]:
+            _p(f"  corrupt     : {census['corrupt_seen']}")
+        op = census["opperf"]
+        if op is None:
+            _p("  autotune    : never run for this fingerprint "
+               "(benchmark/opperf.py --kernels)")
+        else:
+            import datetime as _dt
+
+            when = _dt.datetime.fromtimestamp(
+                op["when"]).strftime("%Y-%m-%d %H:%M:%S")
+            _p(f"  autotune    : {when} ({op.get('cases')} cases, "
+               f"{op.get('duration_s')}s, "
+               f"interpret={op.get('interpret')})")
+
+        stats = klayer.dispatch_stats()
+        out["dispatch_stats"] = stats
+        out["fallback"] = klayer.fallback_report()
+        if not stats:
+            _p("dispatches    : none this process")
+        for fam, rec in stats.items():
+            reasons = ", ".join(f"{k}={v}" for k, v in
+                                sorted(rec["reasons"].items()))
+            _p(f"  {fam:<20s} kernel={rec['kernel']} xla={rec['xla']} "
+               f"({reasons})")
+        warned = out["fallback"]["warned_families"]
+        if warned:
+            _p(f"latched       : {', '.join(warned)} (Pallas "
+               f"unavailable — warned once, counting in "
+               f"mxtpu_kernels_fallback_total)")
+
+        from mxnet_tpu.telemetry import registry as _treg
+
+        snap = {}
+        for metric in ("mxtpu_kernels_dispatch_total",
+                       "mxtpu_kernels_fallback_total",
+                       "mxtpu_kernels_table_corrupt_total"):
+            m = _treg.get(metric)
+            if m is not None:
+                vals = {",".join(k) or "total": v
+                        for k, v in m.series().items()}
+                if vals:
+                    snap[metric] = vals
+        out["counters"] = snap
+        for metric, vals in snap.items():
+            _p(f"  {metric}: {vals}")
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("kernels import failed:", e)
+    return out
+
+
 def check_quantization():
     """Int8 quantization state (docs/PERFORMANCE.md "Int8 inference"):
     the last calibration run in this process (mode / histogram bins /
@@ -859,6 +951,7 @@ SECTIONS = (
     ("compile_cache", check_compile_cache),
     ("serving", check_serving),
     ("serving_fleet", check_fleet),
+    ("kernels", check_kernels),
     ("quantization", check_quantization),
     ("watchdog", check_watchdog),
     ("preempt", check_preempt),

@@ -53,6 +53,15 @@ print-call        a bare ``print()`` inside the ``mxnet_tpu/`` package:
                   are exempt; the few user-facing table printers that ARE
                   an API contract (``Block.summary``,
                   ``visualization.print_summary``) are baselined.
+raw-pallas-call   a direct ``pl.pallas_call(...)`` outside
+                  ``mxnet_tpu/kernels/`` — hand-rolled Pallas call sites
+                  bypass the kernel registry, so they get no autotuned
+                  per-shape dispatch, no XLA fallback when Pallas is
+                  unavailable, and no fallback/dispatch telemetry.
+                  Did you mean: implement the kernel in
+                  ``mxnet_tpu/kernels/``, wire it with
+                  ``kernels.register_kernel(...)`` and call it through
+                  ``kernels.dispatch(family, ...)``.
 serving-blocking-call
                   a blocking call in ``serving/`` code outside a
                   ``watchdog.sync(...)`` span: device syncs
@@ -99,7 +108,7 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 RULES = ("bare-except", "host-sync", "raw-jax-compat", "raw-jit",
          "unseeded-random", "no-schema-doc", "unused-import",
          "mutable-default", "unbounded-sync", "partition-spec-literal",
-         "serving-blocking-call", "print-call")
+         "serving-blocking-call", "print-call", "raw-pallas-call")
 
 # serving/ blocking-call vocabulary: device syncs (flagged regardless of
 # arguments) and waits that are unbounded only in their zero-arg form
@@ -165,6 +174,8 @@ class _Linter(ast.NodeVisitor):
         self.is_parallel = "/parallel/" in rel.replace(os.sep, "/")
         # serving/ code must never wait unboundedly outside watchdog.sync
         self.is_serving = "serving" in rel.replace(os.sep, "/").split("/")[:-1]
+        # kernels/ is the one home of raw pl.pallas_call sites
+        self.is_kernels = "kernels" in rel.replace(os.sep, "/").split("/")[:-1]
         self._serving_pending = []  # (node, message) resolved in finish()
         # print-call applies only inside the mxnet_tpu package (tools/,
         # tests and standalone scripts print by design)
@@ -244,6 +255,13 @@ class _Linter(ast.NodeVisitor):
                              "bypasses the watchdog — route through "
                              "mxnet_tpu.watchdog.sync so a wedge raises "
                              "StallError with a crash bundle")
+            if func.attr == "pallas_call" and not self.is_kernels:
+                self.add(node, "raw-pallas-call",
+                         "raw pl.pallas_call outside mxnet_tpu/kernels/ "
+                         "bypasses the kernel registry (no autotuned "
+                         "dispatch, no XLA fallback, no telemetry) — did "
+                         "you mean kernels.register_kernel(...) + "
+                         "kernels.dispatch(family, ...)?")
             chain = _dotted(func)
             if chain is not None:
                 self._check_np_random(node, chain)
