@@ -22,6 +22,17 @@ through the stack:
                        watchdog deadline converts into a crash bundle +
                        failed batch (server keeps serving), ``preempt``
                        the SIGTERM-mid-load drain drill
+    ``modelbus.publish``  every bus record publish (modelbus.py), fired
+                       AFTER the finite gate — ``nan`` poisons the
+                       record's first parameter (in-transit corruption
+                       the SUBSCRIBER must reject + quarantine; the
+                       poison-rejection drill of chaos phase 14),
+                       ``delay``/``hang`` stall the publisher
+    ``modelbus.apply``  every subscriber apply attempt, on the raw
+                       payload bytes — ``corrupt`` flips bytes the CRC
+                       validation must catch (reject: crc_mismatch),
+                       ``delay``/``hang`` stall the watcher, ``raise``
+                       rejects as apply_error
 
 Faults are configured programmatically (:func:`configure`) or through the
 ``MXNET_TPU_FAULTS`` environment variable — read once, at first use, so

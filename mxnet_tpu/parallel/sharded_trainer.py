@@ -230,6 +230,14 @@ class ShardedTrainer:
         # so a preemption drain (or watchdog abort) can write a final one
         self._ckpt_manager = None
         self._ckpt_epoch = 0
+        # model-bus publishing (publish_to): armed, every K-th successful
+        # step streams a versioned weight record into the bus directory
+        self._bus = None
+        self._bus_every = 1
+        self._bus_rollback = True
+        self._bus_model = None
+        self._bus_topk = None
+        self.published_versions = []
         self._place_params()
         # one env var (MXNET_TPU_PREEMPT) arms graceful SIGTERM drains
         from .. import preempt as _preempt
@@ -666,6 +674,8 @@ class ShardedTrainer:
             raise
         _tsteps.end_step(flops=self._step_flops(),
                          devices=self._mesh.num_devices)
+        if self._bus is not None and self._t % self._bus_every == 0:
+            self.publish_update()
         return out
 
     def _step_flops(self):
@@ -826,6 +836,62 @@ class ShardedTrainer:
             tuple(h._data for h in self._train_handles),
             tuple(h._data for h in self._aux_handles), x_raw)
         return NDArray(out)
+
+    # -------------------------------------------------------- model bus ---
+    def publish_to(self, bus, every=1, compress_threshold=None,
+                   model=None, topk=None, rollback=True):
+        """Stream live weight updates into a model bus: every `every`-th
+        successful step publishes a version-stamped record of the
+        current params (+ aux) into `bus` (a directory path or a
+        :class:`~mxnet_tpu.modelbus.ModelBus`) for serving workers to
+        apply between batches (docs/SERVING.md "Online updates").
+
+        Small params ride as full tensors; params at or above
+        `compress_threshold` elements ride int8 per-row compressed;
+        `topk` ({param_name: k}) publishes only the k most-changed rows
+        of the named (embedding-table-shaped) params. A non-finite
+        update is never published (the nan-guard signal, re-checked at
+        the bus). With `rollback` (default), a publish that finds the
+        bus head quarantined by a subscriber first re-publishes the
+        newest good version — the ROADMAP's "rollback = re-publish
+        version N" contract.
+
+        Returns the :class:`~mxnet_tpu.modelbus.ModelBus`.
+        """
+        from ..modelbus import ModelBus
+
+        self._bus = bus if isinstance(bus, ModelBus) \
+            else ModelBus(bus, compress_threshold=compress_threshold)
+        self._bus_every = max(1, int(every))
+        self._bus_rollback = bool(rollback)
+        self._bus_model = model
+        self._bus_topk = dict(topk) if topk else None
+        return self._bus
+
+    def publish_update(self):
+        """Publish the current weights to the armed bus NOW (the per-K
+        step hook calls this; explicit calls are fine too). Collective —
+        every process gathers; only the writer rank writes. Returns the
+        published version (None on non-writer ranks, a skipped
+        non-finite update, or no armed bus)."""
+        if self._bus is None:
+            return None
+        # host gathers are collective (ZeRO shards allgather) — run them
+        # on EVERY process before the writer-rank gate
+        params = [(n, self._host_copy(h._data))
+                  for n, h in zip(self._param_names, self._train_handles)]
+        aux = [(n, self._host_copy(h._data))
+               for n, h in zip(self._aux_names, self._aux_handles)]
+        if not self._is_writer_rank():
+            return None
+        if self._bus_rollback:
+            self._bus.auto_rollback(worker="publisher")
+        version = self._bus.publish(params, step=self._t, aux=aux,
+                                    model=self._bus_model,
+                                    topk=self._bus_topk)
+        if version is not None:
+            self.published_versions.append(version)
+        return version
 
     # ------------------------------------------------------- checkpoint ---
     def _host_copy(self, arr):

@@ -23,6 +23,12 @@ users") asks for, built from the pieces the stack already has:
   (answer everything admitted, exit 75 via ``preempt``).
 * **HttpFrontEnd** (``http.py``) — a small JSON-over-HTTP front so
   external clients / ``tools/loadgen.py``'s socket mode can drive it.
+* **Online updates** (``mxnet_tpu.modelbus``) — a training gang streams
+  version-stamped weight records into a shared bus directory
+  (``ShardedTrainer.publish_to``); ``ModelServer.watch_bus`` validates
+  each version (CRC / shape-dtype census / finiteness) and flips the
+  served weights between batches with ZERO recompiles, quarantining and
+  rolling back poisoned updates (docs/SERVING.md "Online updates").
 * **ServingFleet** (``fleet.py`` + ``worker.py``) — N worker processes
   behind one router front door: serving-mode supervision (per-slot
   restart via the exit-code ladder), least-loaded / consistent-hash

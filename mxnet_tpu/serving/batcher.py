@@ -62,7 +62,7 @@ class ServingFuture:
     ``timeout_ms`` default applies."""
 
     __slots__ = ("model", "t_submit", "t_done", "_event", "_result",
-                 "_error", "_trace")
+                 "_error", "_trace", "model_version")
 
     def __init__(self, model):
         self.model = model
@@ -72,6 +72,9 @@ class ServingFuture:
         self._result = None
         self._error = None
         self._trace = None
+        # the model-bus version the answering batch executed under
+        # (stamped at fulfilment; None until then / on failure)
+        self.model_version = None
 
     def done(self):
         return self._event.is_set()
@@ -354,14 +357,14 @@ class BucketBatcher:
                 # the wedged-device scenario the watchdog converts into a
                 # crash bundle + StallError, preempt = SIGTERM mid-load
                 _faults.point("serving.batch")
-                return model.run(x, rows)
+                return model.run_versioned(x, rows)
 
             t0 = time.monotonic()
             for r in reqs:
                 if r.fut._trace is not None:
                     r.fut._trace.mark("run_begin", t0)
             try:
-                outs = _watchdog.sync(
+                outs, model_version = _watchdog.sync(
                     "serving.batch", run,
                     label=f"{model.name} bucket={bucket} rows={rows}")
             except BaseException as e:
@@ -379,6 +382,7 @@ class BucketBatcher:
                 sliced = [o[off:off + r.n] for o in outs]
                 if r.fut._trace is not None:
                     r.fut._trace.mark("run_end", t_run_end)
+                r.fut.model_version = model_version
                 r.fut._fulfill(sliced[0] if len(sliced) == 1 else sliced)
                 if r.fut._trace is not None:
                     r.fut._trace.finish(bucket=bucket)

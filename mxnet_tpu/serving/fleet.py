@@ -713,7 +713,7 @@ class ServingFleet:
     def __init__(self, model_dir, workers=None, *, run_dir=None,
                  policy=None, host="127.0.0.1", port=0, config=None,
                  warmup=True, env=None, cwd=None, name="fleet",
-                 popen=None):
+                 bus_dir=None, popen=None):
         import tempfile
 
         cfg = dict(effective())
@@ -771,6 +771,12 @@ class ServingFleet:
                               or os.path.join(self.run_dir, "cache"))
         # diagnose run next to the fleet finds the run dir through this
         worker_env.setdefault("MXTPU_FLEET_DIR", self.run_dir)
+        # live weight streaming: every worker of every generation
+        # subscribes to the same bus (the trainer's publish_to target)
+        self.bus_dir = os.fspath(bus_dir) if bus_dir \
+            else os.environ.get("MXTPU_MODELBUS_DIR")
+        if self.bus_dir:
+            worker_env.setdefault("MXTPU_MODELBUS_DIR", self.bus_dir)
 
         from .. import elastic as _elastic
 
@@ -1193,9 +1199,11 @@ class ServingFleet:
                 "models": ann.get("models"),
                 "queue_depth": m.get("queue_depth"),
                 "p99_ms": m.get("p99_ms"), "rps": m.get("rps"),
-                "shard_age_s": m.get("age_s")}
+                "shard_age_s": m.get("age_s"),
+                "model_bus": ann.get("model_bus")}
         base.update({
             "url": self.url, "run_dir": self.run_dir,
+            "bus_dir": self.bus_dir,
             "uptime_s": round(time.monotonic() - self._t_start, 1),
             "workers": workers,
             "router": dict(self._counters),

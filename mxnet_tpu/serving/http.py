@@ -4,7 +4,13 @@ Endpoints (TF-Serving-flavoured paths, JSON bodies)::
 
     POST /v1/models/<name>:predict   {"data": [[...], ...]}
                                      -> {"model":..., "outputs": [[...]],
+                                     "model_version":...,
                                      "request_id":..., "phases": {...}}
+                                     ("model_version" is the model-bus
+                                     version the answering batch ran
+                                     under — 0 until a live weight
+                                     update lands; docs/SERVING.md
+                                     "Online updates")
                                      (request id from the caller's
                                      X-Request-Id header or minted here,
                                      echoed back as a header; "phases"
@@ -160,6 +166,7 @@ class HttpFrontEnd:
                     outs = out if isinstance(out, list) else [out]
                     body = {"model": name,
                             "outputs": [o.tolist() for o in outs],
+                            "model_version": fut.model_version,
                             "request_id": fut.request_id or rid}
                     bd = fut.breakdown()
                     if bd is not None:

@@ -68,7 +68,8 @@ class ServedModel:
     """
 
     def __init__(self, name, forward, param_raws, aux_raws, example_shape,
-                 dtype="float32", buckets=None, weight_dtype=None):
+                 dtype="float32", buckets=None, weight_dtype=None,
+                 param_names=None, aux_names=None):
         from .. import compile as _compile
 
         self.name = str(name)
@@ -90,6 +91,16 @@ class ServedModel:
         self.buckets = _config._coerce("buckets", buckets)
         self._praws = tuple(param_raws)
         self._araws = tuple(aux_raws)
+        # the model-bus census surface: param names (when the loader
+        # knows them) + the version/pinned-tuple pair behind live weight
+        # swaps. _pinned is rebound as ONE tuple — a batch reads it once,
+        # so every request in a batch sees exactly one consistent
+        # (params, aux, version) triple however often swap_params runs
+        self.param_names = list(param_names) if param_names else None
+        self.aux_names = list(aux_names) if aux_names else None
+        self._version = 0
+        self._swaps = 0
+        self._pinned = (self._praws, self._araws, 0)
         # donation of the (freshly staged, never reused) input batch is a
         # memory win on accelerators; CPU jaxlib only warns about it, so
         # gate on platform (the compile service additionally strips
@@ -150,20 +161,100 @@ class ServedModel:
             arr = arr.astype(self.dtype)
         return arr
 
+    # ------------------------------------------------------- live swaps ---
+    @property
+    def version(self):
+        """The model-bus version of the pinned parameters (0 = the
+        load-time weights, never swapped)."""
+        return self._version
+
+    @property
+    def swaps(self):
+        """How many times swap_params flipped the pinned weights."""
+        return self._swaps
+
+    def pinned(self):
+        """The current ``(param_raws, aux_raws, version)`` triple as one
+        consistent read (what a batch executes against)."""
+        return self._pinned
+
+    def census(self):
+        """Per-param ``{name, shape, dtype}`` lists — the shape/dtype
+        contract a bus record must match to be applied here."""
+        def ents(raws, names):
+            return [{"name": names[i] if names else None,
+                     "shape": list(r.shape), "dtype": str(r.dtype)}
+                    for i, r in enumerate(raws)]
+        return {"params": ents(self._praws, self.param_names),
+                "aux": ents(self._araws, self.aux_names)}
+
+    def swap_params(self, raws, version, aux_raws=None):
+        """Atomically flip the served weights to `raws` (host or device
+        arrays in param order), stamping `version`.
+
+        Shapes and dtypes MUST match the live census — that is what
+        keeps every compiled bucket executable valid (same avals → the
+        in-memory jit cache hits; the swap costs only ``device_put`` of
+        the new buffers, ZERO recompiles). The flip itself is one tuple
+        rebind: in-flight batches finish on the old weights, the next
+        batch runs wholly on the new ones.
+        """
+        import jax
+
+        cur_p, cur_a, _v = self._pinned
+
+        def staged(news, curs, kind):
+            news = tuple(news)
+            if len(news) != len(curs):
+                raise ValueError(
+                    f"model {self.name!r}: swap_params got {len(news)} "
+                    f"{kind} arrays, serving {len(curs)}")
+            out = []
+            for i, (new, cur) in enumerate(zip(news, curs)):
+                a = _np.asarray(new) if not hasattr(new, "sharding") \
+                    else new
+                if tuple(a.shape) != tuple(cur.shape) \
+                        or str(a.dtype) != str(cur.dtype):
+                    raise ValueError(
+                        f"model {self.name!r}: swap_params {kind}[{i}] "
+                        f"is {a.shape}/{a.dtype}, serving "
+                        f"{cur.shape}/{cur.dtype} — the bus census must "
+                        "match (shape-changing updates need a rollout)")
+                out.append(jax.device_put(
+                    a, getattr(cur, "sharding", None)))
+            return tuple(out)
+
+        new_p = staged(raws, cur_p, "param")
+        new_a = staged(aux_raws if aux_raws is not None else cur_a,
+                       cur_a, "aux")
+        self._praws = new_p
+        self._araws = new_a
+        self._version = int(version)
+        self._swaps += 1
+        self._pinned = (new_p, new_a, int(version))   # the atomic flip
+        return self._pinned
+
     # -------------------------------------------------------------- run ---
+    def run_versioned(self, x, rows=None):
+        """:meth:`run`, plus the model version the batch executed under
+        — read from the pinned triple ONCE, so the whole batch (and its
+        response stamps) is consistent across a concurrent swap."""
+        import jax
+
+        praws, araws, version = self._pinned
+        out = self._fn(praws, araws, x)
+        outs = out if isinstance(out, tuple) else (out,)
+        host = jax.device_get(outs)
+        n = x.shape[0] if rows is None else rows
+        return [_np.asarray(o)[:n] for o in host], version
+
     def run(self, x, rows=None):
         """Execute the compiled forward on a (padded) batch and return the
         outputs as host numpy arrays, sliced to ``rows``. BLOCKS on the
         device→host copy — the batcher always calls this inside a
         ``watchdog.sync('serving.batch', ...)`` span, so a wedged batch
         surfaces as a StallError + crash bundle, never a hung server."""
-        import jax
-
-        out = self._fn(self._praws, self._araws, x)
-        outs = out if isinstance(out, tuple) else (out,)
-        host = jax.device_get(outs)
-        n = x.shape[0] if rows is None else rows
-        return [_np.asarray(o)[:n] for o in host]
+        return self.run_versioned(x, rows)[0]
 
     def warmup(self):
         """Compile (or disk-load) every bucket executable ahead of
@@ -220,8 +311,21 @@ class ServedModel:
                     h._data = orig
 
         fwd._serving_token = ("block", repr(block), tuple(params))
-        praws = tuple(h._data for h in handles)
-        return cls(name, fwd, praws, (), example_shape, dtype, buckets)
+        # a REAL snapshot, not an alias: a ShardedTrainer over the same
+        # block donates its param buffers every step, which would tear
+        # the served weights out from under in-flight batches in a
+        # train-and-serve process (the model-bus topology).  Round-trip
+        # through host so the snapshot also sheds any mesh sharding the
+        # trainer put on the source buffers — serving inputs live on the
+        # default device, and a committed multi-device parameter would
+        # make the jitted forward reject the batch.
+        import jax
+        import jax.numpy as jnp
+
+        praws = tuple(jnp.asarray(_np.asarray(jax.device_get(h._data)))
+                      for h in handles)
+        return cls(name, fwd, praws, (), example_shape, dtype, buckets,
+                   param_names=list(params))
 
     @classmethod
     def from_symbol(cls, name, sym, arg_params=None, aux_params=None,
@@ -270,7 +374,8 @@ class ServedModel:
                               input_name, tuple(pnames))
         praws = tuple(_as_raw(arg_params[n]) for n in pnames)
         araws = tuple(_as_raw(aux_params[n]) for n in aux_names)
-        return cls(name, fwd, praws, araws, example_shape, dtype, buckets)
+        return cls(name, fwd, praws, araws, example_shape, dtype, buckets,
+                   param_names=pnames, aux_names=aux_names)
 
     @classmethod
     def from_checkpoint(cls, name, prefix, epoch, example_shape,

@@ -56,6 +56,7 @@ class ModelServer:
         self._draining = False
         self._t_start = None
         self._drain_event = None
+        self._bus_watcher = None
         self._lock = threading.Lock()
 
     # ---------------------------------------------------------- lifecycle --
@@ -75,6 +76,27 @@ class ModelServer:
         """Pre-compile every model's bucket ladder (+ replay the compile
         service's warmup manifest) BEFORE admitting traffic."""
         return self._container.warmup()
+
+    def watch_bus(self, bus, poll=0.25, worker=None):
+        """Subscribe this server to a model bus (a directory path or a
+        :class:`~mxnet_tpu.modelbus.ModelBus`): a background watcher
+        validates each new version (CRC / census / finiteness) and flips
+        every census-matching served model between batches — live weight
+        updates with zero recompiles (docs/SERVING.md "Online updates").
+        Returns the :class:`~mxnet_tpu.modelbus.BusWatcher`."""
+        from ..modelbus import BusWatcher
+
+        with self._lock:
+            if self._bus_watcher is None:
+                self._bus_watcher = BusWatcher(
+                    self, bus, poll=poll,
+                    worker=worker or self.name).start()
+        return self._bus_watcher
+
+    @property
+    def bus_watcher(self):
+        """The active bus watcher, or None (not subscribed)."""
+        return self._bus_watcher
 
     @property
     def started(self):
@@ -132,6 +154,8 @@ class ModelServer:
         SIGTERM path: ``preempt`` raises the flag, the serving loop calls
         this, then exits 75 for the gang scheduler to reschedule."""
         self._draining = True
+        if self._bus_watcher is not None:
+            self._bus_watcher.stop()   # no weight flips mid-drain
         ok = True
         for b in self._batchers.values():
             ok = b.drain(timeout=timeout) and ok
@@ -151,6 +175,8 @@ class ModelServer:
     def stop(self):
         """Hard stop (drainless): queued requests fail. Prefer
         drain() → stop() — stop after a drain is a no-op join."""
+        if self._bus_watcher is not None:
+            self._bus_watcher.stop()
         for b in self._batchers.values():
             b.stop()
         self._started = False
@@ -188,6 +214,8 @@ class ModelServer:
                 buckets=list(b.model.buckets),
                 dtype=b.model.dtype,
                 weight_dtype=b.model.weight_dtype,
+                model_version=b.model.version,
+                weight_swaps=b.model.swaps,
                 draining=b.draining)
         return {
             "name": self.name,
@@ -196,6 +224,8 @@ class ModelServer:
             "uptime_s": round(time.monotonic() - self._t_start, 1)
             if self._t_start else None,
             "models": models,
+            "model_bus": self._bus_watcher.stats()
+            if self._bus_watcher is not None else None,
             "last_drain": self._drain_event,
         }
 

@@ -226,6 +226,13 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0,
                     help="HTTP port (default 0 = ephemeral, announced)")
+    ap.add_argument("--bus-dir",
+                    default=os.environ.get("MXTPU_MODELBUS_DIR"),
+                    help="model-bus directory to watch for live weight "
+                         "updates (default MXTPU_MODELBUS_DIR; unset = "
+                         "no bus subscription)")
+    ap.add_argument("--bus-poll", type=float, default=0.25,
+                    help="bus watcher poll interval, seconds")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the pre-traffic ladder warmup (the worker "
                          "announces pending compiles and the rollout "
@@ -249,6 +256,12 @@ def main(argv=None):
         warm_report = server.warmup()
         pending = 0
     front = HttpFrontEnd(server, host=args.host, port=args.port).start()
+    watcher = None
+    if args.bus_dir:
+        # live weight streaming: validate + apply bus versions between
+        # batches; the ladder compiled above survives every swap
+        watcher = server.watch_bus(args.bus_dir, poll=args.bus_poll,
+                                   worker=f"w{args.slot}")
 
     def announce(state, **extra):
         rec = {"slot": args.slot, "generation": args.generation,
@@ -258,6 +271,8 @@ def main(argv=None):
                "ready": state == "serving" and pending == 0,
                "pending_compiles": pending,
                "compile_serving": _serving_compile_stats(),
+               "model_bus": watcher.stats() if watcher is not None
+               else None,
                "startup_s": round(time.monotonic() - t0, 3),
                "t_wall": time.time()}
         rec.update(extra)

@@ -253,6 +253,41 @@ def _collect_trace():
                         _trace.size())
 
 
+def _collect_modelbus():
+    mod = sys.modules.get("mxnet_tpu.modelbus")
+    if mod is None:
+        return
+    st = mod.stats()
+    for key, help_ in (
+            ("published", "Bus update records published"),
+            ("applied", "Bus versions applied to live served models"),
+            ("rejected", "Bus versions rejected + quarantined by a "
+                         "subscriber (CRC / census / finiteness)"),
+            ("rollbacks", "Rollback re-publications of a good version "
+                          "after a quarantined head"),
+            ("torn_skips", "Torn/partial bus records skipped "
+                           "(warn-once latched)"),
+            ("publish_skipped_nonfinite", "Updates the publisher's "
+                                          "finite gate refused")):
+        _registry.counter(f"mxtpu_modelbus_{key}_total",
+                          help_).set_total(st.get(key, 0))
+    ver = _registry.gauge("mxtpu_serving_model_version",
+                          "Model-bus version pinned by each served "
+                          "model (0 = load-time weights)",
+                          labels=("model",))
+    srv = sys.modules.get("mxnet_tpu.serving.server")
+    if srv is not None:
+        for s in srv.live_servers():
+            for m in s.container:
+                ver.set(m.version, m.name)
+    age = _registry.gauge("mxtpu_serving_model_age_steps",
+                          "Bounded staleness: newest published trainer "
+                          "step minus the applied one, per watcher",
+                          labels=("worker",))
+    for w in mod.live_watchers():
+        age.set(w.age_steps(), w.worker)
+
+
 def _collect_preempt():
     mod = sys.modules.get("mxnet_tpu.preempt")
     if mod is None:
@@ -305,6 +340,7 @@ def _ensure_defaults():
     register_collector("memory", _collect_memory)
     register_collector("flight", _collect_flight)
     register_collector("trace", _collect_trace)
+    register_collector("modelbus", _collect_modelbus)
     register_collector("preempt", _collect_preempt)
     register_collector("gang", _collect_gang)
 

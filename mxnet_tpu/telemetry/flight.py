@@ -24,6 +24,11 @@ Recorded event kinds (the coarse seams, never the per-op hot path):
     ``preempt.request`` / ``preempt.drain`` preemption lifecycle
     ``io.error``                    prefetch worker failure
     ``oom``                         RESOURCE_EXHAUSTED surfaced
+    ``modelbus.*``                  live-weight-bus lifecycle (publish,
+                                    apply, reject, rollback, torn_skip,
+                                    skip_nonfinite) — a crash bundle
+                                    shows the last applied/rejected
+                                    model version
     ``gang.*``                      elastic gang lifecycle (state, spawn,
                                     exit, restart, peer_lost, peer_kill,
                                     heartbeat_lost, postmortem)

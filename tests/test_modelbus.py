@@ -1,0 +1,492 @@
+"""Model bus: live weight streaming from a training gang into a serving
+fleet (mxnet_tpu/modelbus.py, docs/SERVING.md "Online updates").
+
+Headline guarantees under test:
+
+* record discipline — payload-then-manifest atomic writes with a CRC32
+  manifest; full / int8-per-row / top-k-sparse-row encodings round-trip
+  through the ONE decode seam (``decode_update``), and the publisher's
+  finite gate never lets a NaN update onto the bus;
+* subscriber validation — CRC corruption, census mismatch, and decoded
+  non-finiteness each REJECT + quarantine the version while serving
+  stays pinned on the last good one; torn manifests are skipped through
+  the warn-once latch (counter keeps the true total);
+* atomic flips — a version applies between batches as ONE pinned-tuple
+  rebind: every response's outputs are consistent with its stamped
+  ``model_version`` even while swaps hammer the server, and the warmed
+  bucket ladder survives every flip with ZERO recompiles;
+* compressed apply == full apply — the watcher's int8-row apply is
+  bit-equal to manually decoding the record and swapping the raws;
+* rollback = re-publish — a quarantined bus head triggers one idempotent
+  re-publication of the newest good version, and subscribers converge;
+* end to end — a real fleet worker subprocess subscribed via
+  ``--bus-dir`` flips its served weights mid-load; every in-flight HTTP
+  response sees exactly one consistent (version, outputs) pair.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, modelbus, serving
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.modelbus import BusWatcher, ModelBus, decode_update
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+def make_net(seed, dim=8, hidden=16, classes=4):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"), nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, dim)))
+    return net
+
+
+def net_params(net, delta=0.0):
+    """``[(name, host array + delta)]`` in collect_params order — the
+    publisher's view of a gluon net."""
+    return [(n, p.data().asnumpy() + delta)
+            for n, p in net.collect_params().items()]
+
+
+@pytest.fixture()
+def servers():
+    """Cleanup registry: every server appended here is drained."""
+    out = []
+    yield out
+    for s in out:
+        try:
+            s.drain(timeout=10.0)
+        except Exception:
+            pass
+    faults.reset()
+
+
+def serve(net, servers, name="m", dim=8):
+    c = serving.ModelContainer()
+    c.add_block(name, net, example_shape=(dim,), buckets=(2, 4))
+    server = serving.ModelServer(c, max_wait_ms=1.0).start()
+    servers.append(server)
+    return server, next(iter(c))
+
+
+# ----------------------------------------------------- record round-trip ---
+
+def test_roundtrip_full_and_int8(tmp_path):
+    bus = ModelBus(tmp_path / "bus", compress_threshold=64)
+    rs = np.random.RandomState(0)
+    w = rs.randn(32, 16).astype(np.float32)      # 512 elems -> int8_rows
+    w[3] = 0.0                                   # zero row: exact decode
+    b = rs.randn(8).astype(np.float32)           # small -> full
+    v = bus.publish([("w", w), ("b", b)], step=7, aux=[("mean", b * 2)])
+    assert v == 1
+    manifest, blob = bus.read(v)                 # size+CRC verified
+    assert manifest["step"] == 7
+    assert [e["encoding"] for e in manifest["params"]] == \
+        ["int8_rows", "full"]
+    (dw, db), (dmean,) = decode_update(manifest, blob)
+    assert np.array_equal(db, b)                 # full rides exact
+    assert np.array_equal(dmean, b * 2)
+    assert np.array_equal(dw[3], w[3])           # zero row exact
+    # int8-per-row: error bounded by half a quantization step per row
+    step_sz = np.abs(w).max(axis=1, keepdims=True) / 127.0
+    assert (np.abs(dw - w) <= step_sz * 0.5 + 1e-7).all()
+    assert dw.dtype == w.dtype and dw.shape == w.shape
+
+
+def test_topk_rows_diff_against_previous_publish(tmp_path):
+    bus = ModelBus(tmp_path / "bus")
+    rs = np.random.RandomState(1)
+    table = rs.randn(64, 8).astype(np.float32)
+    v1 = bus.publish([("table", table)], step=1, topk={"table": 4})
+    m1 = bus.latest()
+    # nothing to diff against yet -> self-contained full record
+    assert m1["params"][0]["encoding"] == "full"
+    assert m1["base_version"] is None
+
+    new = table.copy()
+    hot = [3, 17, 40, 63]
+    new[hot] += 5.0                              # the k most-changed rows
+    new += rs.randn(*new.shape).astype(np.float32) * 1e-4  # background drift
+    v2 = bus.publish([("table", new)], step=2, topk={"table": 4})
+    manifest, blob = bus.read(v2)
+    ent = manifest["params"][0]
+    assert ent["encoding"] == "topk_rows" and ent["rows"] == 4
+    assert manifest["base_version"] == v1
+    params, _aux = decode_update(manifest, blob, base_params=[table])
+    dec = params[0]
+    assert np.array_equal(dec[hot], new[hot])    # hot rows ride exact
+    cold = [i for i in range(64) if i not in hot]
+    assert np.array_equal(dec[cold], table[cold])  # cold rows = base
+
+
+def test_finite_gate_never_publishes_nan(tmp_path):
+    bus = ModelBus(tmp_path / "bus")
+    before = modelbus.stats()
+    bad = np.ones((4, 4), np.float32)
+    bad[1, 2] = np.nan
+    assert bus.publish([("w", bad)], step=1) is None
+    assert bus.manifests() == [] and bus.versions() == []
+    after = modelbus.stats()
+    assert after["publish_skipped_nonfinite"] == \
+        before["publish_skipped_nonfinite"] + 1
+    assert after["published"] == before["published"]
+
+
+def test_torn_manifest_skipped_with_warn_once_latch(tmp_path, monkeypatch):
+    warns = []
+    monkeypatch.setattr(
+        modelbus._logger, "warning",
+        lambda msg, *a, **k: warns.append(msg % a if a else msg))
+    bus = ModelBus(tmp_path / "bus")
+    v = bus.publish([("w", np.ones((2, 2), np.float32))], step=1)
+    (tmp_path / "bus" / "v00000009.json").write_text("{ torn")
+    before = modelbus.stats()["torn_skips"]
+    assert [m["version"] for m in bus.manifests()] == [v]
+    assert [m["version"] for m in bus.manifests()] == [v]
+    # the counter saw both skips; the log saw exactly one line
+    assert bus.torn_skips == 2
+    assert modelbus.stats()["torn_skips"] == before + 2
+    assert len([w for w in warns if "torn" in w]) == 1
+
+
+# -------------------------------------------------- subscriber validation ---
+
+def test_crc_corruption_quarantined(tmp_path, servers):
+    net = make_net(20)
+    server, model = serve(net, servers)
+    bus = ModelBus(tmp_path / "bus")
+    v = bus.publish(net_params(net, delta=0.5), step=1)
+    blob = bytearray((tmp_path / "bus" / f"v{v:08d}.update").read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    (tmp_path / "bus" / f"v{v:08d}.update").write_bytes(bytes(blob))
+
+    w = BusWatcher(server, bus, worker="t-crc")
+    assert w.poll_once() is None
+    assert w.rejected == {v: "crc_mismatch"}
+    assert bus.quarantined() == {v}
+    assert model.version == 0 and w.applied_version == 0
+    (rej,) = [r for r in bus.rejects() if r["version"] == v]
+    assert rej["worker"] == "t-crc" and rej["reason"] == "crc_mismatch"
+    # quarantined versions are never retried
+    assert w.poll_once() is None
+
+
+def test_census_mismatch_rejected(tmp_path, servers):
+    net = make_net(21)
+    server, model = serve(net, servers)
+    bus = ModelBus(tmp_path / "bus")
+    v = bus.publish([("w", np.ones((3, 3), np.float32))], step=1)
+    w = BusWatcher(server, bus, worker="t-census")
+    assert w.poll_once() is None
+    assert w.rejected == {v: "census_mismatch"}
+    assert model.version == 0
+
+
+def test_poisoned_update_rejected_serving_stays_pinned(tmp_path, servers):
+    net = make_net(22)
+    server, model = serve(net, servers)
+    bus = ModelBus(tmp_path / "bus")
+    w = BusWatcher(server, bus, worker="t-poison")
+    good = bus.publish(net_params(net, delta=0.25), step=1)
+    assert w.poll_once() == good
+
+    # in-transit poison: the injection point fires AFTER the finite
+    # gate, so the record publishes and the SUBSCRIBER must catch it
+    faults.configure("modelbus.publish:nan@1", seed=0)
+    try:
+        poisoned = bus.publish(net_params(net, delta=0.75), step=2)
+    finally:
+        faults.reset()
+    assert poisoned is not None
+    assert w.poll_once() is None
+    assert w.rejected[poisoned] == "nonfinite"
+    assert poisoned in bus.quarantined()
+    assert model.version == good and w.applied_version == good
+
+
+# ------------------------------------------------------------ live swaps ---
+
+def test_swap_applies_new_weights_with_zero_recompiles(tmp_path, servers):
+    from mxnet_tpu import compile as _compile
+
+    net = make_net(23)
+    server, model = serve(net, servers)
+    server.warmup()
+    misses0 = _compile.stats()["serving"]["misses"]
+    x = np.random.RandomState(3).randn(2, 8).astype(np.float32)
+    y0 = np.asarray(server.predict("m", x, timeout=10.0))
+
+    bus = ModelBus(tmp_path / "bus")
+    v = bus.publish(net_params(net, delta=0.5), step=9)
+    w = BusWatcher(server, bus, worker="t-swap")
+    assert w.poll_once() == v
+
+    fut = server.submit("m", x)
+    y1 = np.asarray(fut.result(10.0))
+    assert fut.model_version == v            # responses carry the version
+    assert not np.allclose(y0, y1)           # the weights really flipped
+    assert model.version == v and model.swaps == 1
+    assert w.age_steps() == 0 and w.applied_models == ["m"]
+    assert _compile.stats()["serving"]["misses"] == misses0
+    st = server.stats()
+    assert st["models"]["m"]["model_version"] == v
+    assert st["models"]["m"]["weight_swaps"] == 1
+    assert st["model_bus"] is None           # watch_bus() not used here
+
+
+def test_compressed_apply_bit_equal_to_full_apply(tmp_path, servers):
+    """The decode seam: a watcher applying an int8-compressed record
+    leaves the SAME device bytes as manually decoding the record and
+    swapping the raws — compression changes the wire format, never the
+    applied weights."""
+    import jax
+
+    net_a, net_b = make_net(24), make_net(24)
+    server_a, model_a = serve(net_a, servers, name="a")
+    server_b, model_b = serve(net_b, servers, name="b")
+    bus = ModelBus(tmp_path / "bus", compress_threshold=32)
+    v = bus.publish(net_params(net_a, delta=0.5), step=1)
+    assert "int8_rows" in {e["encoding"]
+                           for e in bus.latest()["params"]}
+
+    w = BusWatcher(server_a, bus, worker="t-seam")
+    assert w.poll_once() == v                      # the watcher's apply
+    manifest, blob = bus.read(v)
+    params, aux = decode_update(manifest, blob)    # the manual apply
+    # net_b carries its own gluon auto-prefix, so the record maps onto
+    # it positionally (collect_params order) — the watcher's fallback
+    model_b.swap_params(params, v)
+
+    for ra, rb in zip(model_a.pinned()[0], model_b.pinned()[0]):
+        assert np.array_equal(np.asarray(jax.device_get(ra)),
+                              np.asarray(jax.device_get(rb)))
+    x = np.random.RandomState(4).randn(3, 8).astype(np.float32)
+    assert np.array_equal(
+        np.asarray(server_a.predict("a", x, timeout=10.0)),
+        np.asarray(server_b.predict("b", x, timeout=10.0)))
+
+
+def test_atomic_flip_every_response_consistent_with_its_version(
+        tmp_path, servers):
+    """Hammer swaps under load: output = bias = the version constant, so
+    a torn flip (some new params, some old, or a version stamp that does
+    not match the weights) is directly visible in any response."""
+    net = make_net(25)
+    params = list(net.collect_params().values())
+    for p in params:
+        p.set_data(mx.nd.zeros(p.shape))
+    server, model = serve(net, servers)
+    bus = ModelBus(tmp_path / "bus")
+    w = BusWatcher(server, bus, worker="t-atomic")
+    names = list(net.collect_params())
+    shapes = [tuple(p.shape) for p in params]
+
+    stop = threading.Event()
+    bad, checked = [], [0]
+    x = np.zeros((1, 8), np.float32)
+
+    def load():
+        while not stop.is_set():
+            fut = server.submit("m", x)
+            out = np.asarray(fut.result(10.0))
+            v = fut.model_version
+            # all outputs equal the bias constant of ONE version, and
+            # that version is the one stamped on the response
+            if not np.array_equal(out, np.full_like(out, float(v))):
+                bad.append((v, out.tolist()))
+            checked[0] += 1
+
+    threads = [threading.Thread(target=load, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    for v in range(1, 7):
+        pub = [(n, np.full(s, float(v), np.float32)
+                if len(s) == 1 else np.zeros(s, np.float32))
+               for n, s in zip(names, shapes)]
+        assert bus.publish(pub, step=v) == v
+        assert w.poll_once() == v
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not bad, bad[:3]
+    assert checked[0] > 0 and model.version == 6
+
+
+# --------------------------------------------------------------- rollback ---
+
+def test_rollback_republishes_last_good_version(tmp_path, servers):
+    import jax
+
+    net = make_net(26)
+    server, model = serve(net, servers)
+    bus = ModelBus(tmp_path / "bus")
+    w = BusWatcher(server, bus, worker="t-rollback")
+    before = modelbus.stats()["rollbacks"]
+    good = bus.publish(net_params(net, delta=0.25), step=1)
+    assert w.poll_once() == good
+    good_raws = [np.asarray(jax.device_get(r))
+                 for r in model.pinned()[0]]
+
+    faults.configure("modelbus.publish:nan@1", seed=0)
+    try:
+        poisoned = bus.publish(net_params(net, delta=0.75), step=2)
+    finally:
+        faults.reset()
+    assert w.poll_once() is None and poisoned in bus.quarantined()
+
+    # rollback = re-publication of the newest good version
+    rb = bus.auto_rollback(worker="publisher")
+    assert rb == poisoned + 1
+    m = bus.latest()
+    assert m["version"] == rb and m["step"] == 1
+    assert m["meta"] == {"rollback_of": poisoned,
+                         "source_version": good}
+    assert modelbus.stats()["rollbacks"] == before + 1
+    assert bus.auto_rollback(worker="publisher") is None   # idempotent
+
+    assert w.poll_once() == rb
+    for ra, g in zip(model.pinned()[0], good_raws):
+        assert np.array_equal(np.asarray(jax.device_get(ra)), g)
+    assert w.stats()["applied_version"] == rb
+    assert w.stats()["rejected"] == {poisoned: "nonfinite"}
+
+
+# -------------------------------------------------------------- publisher ---
+
+def test_trainer_publishes_every_k_steps(tmp_path):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    net = make_net(27)
+    trainer = ShardedTrainer(net, gluon.loss.L2Loss(), "adam",
+                             {"learning_rate": 0.01}, mesh=DeviceMesh())
+    bus = trainer.publish_to(tmp_path / "bus", every=2)
+    assert isinstance(bus, ModelBus)
+    rs = np.random.RandomState(5)
+    for _ in range(4):
+        x = mx.nd.array(rs.randn(16, 8).astype(np.float32))
+        y = mx.nd.array(rs.randn(16, 4).astype(np.float32))
+        trainer.step(x, y)
+    assert trainer.published_versions == [1, 2]
+    mans = bus.manifests()
+    assert [m["step"] for m in mans] == [2, 4]
+    assert [e["name"] for e in mans[-1]["params"]] == \
+        list(net.collect_params())
+    # the published weights are the trainer's CURRENT weights
+    manifest, blob = bus.read(mans[-1]["version"])
+    params, _aux = decode_update(manifest, blob)
+    live = [p.data().asnumpy() for p in net.collect_params().values()]
+    for got, want in zip(params, live):
+        assert np.allclose(got, want)
+
+
+# ------------------------------------------------------------- end to end ---
+
+def test_fleet_worker_streams_versions_end_to_end(tmp_path):
+    """A real fleet worker subprocess subscribed via --bus-dir: served
+    outputs change across a mid-load version flip, every in-flight HTTP
+    response sees exactly one consistent (model_version, outputs) pair,
+    and the fleet surfaces the bus in its stats."""
+    import loadgen
+    from mxnet_tpu.serving import fleet as fleet_mod
+    from mxnet_tpu.serving import worker as worker_mod
+
+    model_dir = tmp_path / "models"
+    bus_dir = tmp_path / "bus"
+    worker_mod.write_spec(
+        model_dir, worker_mod.demo_spec(models=1, seed=777,
+                                        buckets=(2, 4)))
+    fl = fleet_mod.ServingFleet(
+        model_dir, workers=1, run_dir=str(tmp_path / "run"),
+        bus_dir=str(bus_dir),
+        config={"min": 1, "max": 1, "beat": 0.2, "grace": 20},
+        name="t-bus")
+    stop = threading.Event()
+    lock = threading.Lock()
+    seen, errors = [], []     # (model_version, outputs tuple)
+    x = np.random.RandomState(9).randn(1, 16).astype(np.float32)
+    body = json.dumps({"data": x.tolist()}).encode()
+
+    def load():
+        cl = loadgen.KeepAliveClient(fl.url)
+        while not stop.is_set():
+            try:
+                status, payload, _ = cl.request(
+                    "POST", "/v1/models/model0:predict", body=body,
+                    headers={"Content-Type": "application/json"})
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                continue
+            if status == 200:
+                data = json.loads(payload)
+                with lock:
+                    seen.append((data["model_version"],
+                                 tuple(data["outputs"][0][0])))
+            elif status not in (429, 503):
+                with lock:
+                    errors.append(f"HTTP {status}")
+            time.sleep(0.005)
+
+    try:
+        fl.start(timeout=90)
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            with lock:
+                if any(v == 0 for v, _o in seen):
+                    break
+            time.sleep(0.05)
+
+        # publish from the "trainer" process: same seeded demo net, new
+        # weights (param names differ across processes — the census
+        # falls back to positional matching)
+        net = worker_mod.build_demo_model(777)
+        bus = ModelBus(bus_dir)
+        v = bus.publish(net_params(net, delta=0.25), step=50,
+                        model="model0")
+        while time.monotonic() < deadline:
+            with lock:
+                if any(vv == v for vv, _o in seen):
+                    break
+            time.sleep(0.05)
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        stats = fl.stats()
+    finally:
+        stop.set()
+        fl.stop()
+
+    assert not errors, errors[:3]
+    versions = {vv for vv, _o in seen}
+    assert {0, v} <= versions, versions
+    by_version = {}
+    for vv, outs in seen:
+        by_version.setdefault(vv, set()).add(outs)
+    # exactly one consistent output per version — no torn flips, and
+    # the flip REALLY changed what the model serves
+    assert all(len(outs) == 1 for outs in by_version.values()), \
+        {vv: len(o) for vv, o in by_version.items()}
+    assert by_version[0] != by_version[v]
+    assert stats["bus_dir"] == str(bus_dir)
+    ann = worker_mod.read_workers(fl.run_dir)[0]
+    mb = ann.get("model_bus")
+    assert mb is not None and mb["bus_dir"] == str(bus_dir)

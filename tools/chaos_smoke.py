@@ -72,6 +72,14 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     ``fleet.rollout()`` (generation 2 health-gated warm from the disk
     compile cache with zero compiles, traffic shifted, generation 1
     drained through exit 75 with zero dropped admitted requests),
+  * the MODEL-BUS drill (phase 14): a training gang streams live weight
+    updates through ``mxnet_tpu.modelbus`` into a server under
+    closed-loop load — versions apply between batches with ZERO
+    recompiles and zero dropped admitted requests, an injected
+    ``modelbus.publish`` NaN (in-transit poison, past the publisher's
+    finite gate) is auto-rejected + quarantined by the subscriber, and
+    the next publish rolls the bus back by re-publishing the last good
+    version (``--skip-modelbus-drill`` skips it),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -557,6 +565,171 @@ def fleet_drill(root=None):
     return 0
 
 
+def modelbus_drill(root=None, seed=0):
+    """Phase 14: live weight streaming under fire — a trainer publishes
+    to a model bus every 2 steps while a server under closed-loop load
+    applies the versions between batches.
+
+    The bar: zero dropped admitted requests and ZERO serving recompiles
+    across every weight flip; an injected ``modelbus.publish`` NaN
+    (in-transit poison — it fires AFTER the publisher's finite gate) is
+    rejected + quarantined by the subscriber while serving stays pinned
+    on the last good version; the next publish auto-rolls the bus back
+    (re-publishes the good version) and newer weights then flow again —
+    all visible in ``mxtpu_modelbus_*_total`` and the flight tail."""
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import compile as _compile
+    from mxnet_tpu import faults, modelbus, serving
+    from mxnet_tpu.telemetry import export as _texport
+    from mxnet_tpu.telemetry import flight as _flight
+
+    root = root or tempfile.mkdtemp(prefix="chaos_bus_")
+    faults.reset()
+    net, trainer = build(seed + 14)
+    container = serving.ModelContainer()
+    container.add_block("chaos_bus", net, example_shape=(8,),
+                        buckets=(2, 4))
+    server = serving.ModelServer(container, max_wait_ms=1.0).start()
+    server.warmup()
+    misses0 = _compile.stats().get("serving", {}).get("misses", 0)
+    bus0 = modelbus.stats()
+
+    bus = trainer.publish_to(os.path.join(root, "bus"), every=2)
+    watcher = server.watch_bus(bus, poll=0.02)
+
+    lock = threading.Lock()
+    stop = threading.Event()
+    completed, busy, errors = [0], [0], []
+    versions_seen = set()
+    pool = [np.random.RandomState(i).randn(1, 8).astype(np.float32)
+            for i in range(4)]
+
+    def load_worker(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                fut = server.submit("chaos_bus", pool[(tid + i) % 4])
+                fut.result(timeout=10.0)
+            except serving.ServerBusyError:
+                with lock:
+                    busy[0] += 1
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            else:
+                with lock:
+                    completed[0] += 1
+                    versions_seen.add(fut.model_version)
+            i += 1
+            time.sleep(0.003)
+
+    threads = [threading.Thread(target=load_worker, args=(t,),
+                                daemon=True) for t in range(2)]
+    for t in threads:
+        t.start()
+
+    def fail(msg):
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        server.drain(timeout=10.0)
+        faults.reset()
+        print(f"FAIL: {msg}")
+        return 1
+
+    def wait_for(cond, what, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.02)
+        return False
+
+    # steady state: 4 steps -> versions 1 (step 2) and 2 (step 4) flow
+    # through the bus and flip the served weights under load
+    for s in range(4):
+        x, y = batch_for(14, s, seed)
+        trainer.step(x, y)
+    if not wait_for(lambda: watcher.applied_version >= 2,
+                    "steady-state versions"):
+        return fail(f"watcher never applied the steady-state versions: "
+                    f"{watcher.stats()}")
+
+    # in-transit poison: nan on the NEXT publish (version 3, step 6) —
+    # it passes the publisher's finite gate (the injection point is
+    # after it) so the SUBSCRIBER must catch and quarantine it
+    faults.configure("modelbus.publish:nan@1", seed=seed)
+    for s in range(4, 6):
+        x, y = batch_for(14, s, seed)
+        trainer.step(x, y)
+    faults.reset()
+    if not wait_for(
+            lambda: modelbus.stats()["rejected"] > bus0["rejected"],
+            "poison reject"):
+        return fail(f"the poisoned version was never rejected: "
+                    f"{watcher.stats()}")
+    poisoned = max(watcher.rejected)
+    if watcher.rejected.get(poisoned) != "nonfinite" \
+            or poisoned not in bus.quarantined():
+        return fail(f"poisoned version not quarantined as nonfinite: "
+                    f"{watcher.rejected} / {sorted(bus.quarantined())}")
+    pinned_at = watcher.applied_version
+    if pinned_at >= poisoned:
+        return fail(f"serving moved onto the poisoned version "
+                    f"{poisoned} (applied {pinned_at})")
+
+    # recovery: the next publish finds the quarantined head, re-publishes
+    # the last good version (rollback = re-publish), then streams the
+    # new weights; the watcher converges onto the newest good version
+    for s in range(6, 8):
+        x, y = batch_for(14, s, seed)
+        trainer.step(x, y)
+    if not wait_for(
+            lambda: (modelbus.stats()["rollbacks"] > bus0["rollbacks"]
+                     and watcher.applied_version > poisoned),
+            "rollback + fresh weights"):
+        return fail(f"no rollback re-publication after the quarantine: "
+                    f"{modelbus.stats()} / {watcher.stats()}")
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    server.drain(timeout=10.0)
+
+    if errors:
+        return fail(f"model-bus drill dropped {len(errors)} admitted "
+                    f"request(s): {errors[:3]}")
+    misses1 = _compile.stats().get("serving", {}).get("misses", 0)
+    if misses1 != misses0:
+        return fail(f"weight flips recompiled the serving ladder "
+                    f"(misses {misses0} -> {misses1})")
+    if len([v for v in versions_seen if v is not None]) < 2:
+        return fail(f"responses never flipped model_version under load: "
+                    f"{sorted(versions_seen)}")
+    kinds = {e["kind"] for e in _flight.tail()}
+    if not {"modelbus.publish", "modelbus.apply", "modelbus.reject",
+            "modelbus.rollback"} <= kinds:
+        return fail(f"flight tail is missing modelbus events: "
+                    f"{sorted(k for k in kinds if 'modelbus' in k)}")
+    rej_line = [l for l in _texport.render_prometheus().splitlines()
+                if l.startswith("mxtpu_modelbus_rejected_total")]
+    if not rej_line or float(rej_line[0].split()[-1]) < 1:
+        return fail(f"/metrics does not carry the reject: {rej_line}")
+    d = modelbus.stats()
+    print(f"  model-bus drill: {d['published'] - bus0['published']} "
+          f"versions published, {d['applied'] - bus0['applied']} applied "
+          f"under load (versions seen in responses: "
+          f"{sorted(v for v in versions_seen if v is not None)}), "
+          f"poisoned v{poisoned} rejected+quarantined (pinned at "
+          f"v{pinned_at}), {d['rollbacks'] - bus0['rollbacks']} "
+          f"rollback, {completed[0]} requests completed / 0 dropped, "
+          f"0 recompiles")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--epochs", type=int, default=2)
@@ -584,6 +757,10 @@ def main(argv=None):
                         help="skip the phase-13 serving-fleet drills "
                              "(worker SIGKILL + mid-load rollout; "
                              "spawns worker subprocesses)")
+    parser.add_argument("--skip-modelbus-drill", action="store_true",
+                        help="skip the phase-14 live-weight-streaming "
+                             "drill (in-process trainer -> bus -> "
+                             "server with poison + rollback)")
     args = parser.parse_args(argv)
 
     if args.serve_drill:
@@ -1201,6 +1378,16 @@ def main(argv=None):
     # request answered
     if not args.skip_fleet_drill:
         rc = fleet_drill(root=os.path.join(ckpt_dir, "fleet"))
+        if rc:
+            return rc
+
+    # phase 14: the model bus — a trainer streams weight versions into a
+    # loaded server (zero recompiles, zero dropped requests); an
+    # injected in-transit NaN is rejected + quarantined by the
+    # subscriber and the next publish rolls the bus back to known-good
+    if not args.skip_modelbus_drill:
+        rc = modelbus_drill(root=os.path.join(ckpt_dir, "bus"),
+                            seed=args.seed)
         if rc:
             return rc
 

@@ -384,6 +384,57 @@ def check_fleet():
     return out
 
 
+def check_modelbus():
+    """Model bus (docs/SERVING.md "Online updates"): the live-weight
+    streaming channel between a training gang and a serving fleet —
+    process totals, live watchers (applied version / staleness), and the
+    bus directory's record census (versions, quarantine, rejects)."""
+    _p("---------Model Bus---------")
+    out = {"MXTPU_MODELBUS_DIR": os.environ.get("MXTPU_MODELBUS_DIR")}
+    _p(f"MXTPU_MODELBUS_DIR={out['MXTPU_MODELBUS_DIR'] or '<unset>'}  "
+       "(fleet workers subscribe when set — docs/SERVING.md "
+       "'Online updates')")
+    try:
+        from mxnet_tpu import modelbus
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("modelbus import failed:", e)
+        return out
+    out["stats"] = modelbus.stats()
+    _p("process totals:", out["stats"])
+    watchers = [w.stats() for w in modelbus.live_watchers()]
+    out["watchers"] = watchers
+    if not watchers:
+        _p("live watchers : none in this process")
+    for w in watchers:
+        _p(f"watcher {w['worker']!r}: applied v{w['applied_version']} "
+           f"(step {w['applied_step']}) of latest "
+           f"v{w['latest_version']} — age {w['age_steps']} steps, "
+           f"{w['applied_total']} applies, rejected {w['rejected']}")
+    bus_dir = out["MXTPU_MODELBUS_DIR"] or \
+        (watchers[0]["bus_dir"] if watchers else None)
+    if not bus_dir:
+        _p("bus dir       : <none> (MXTPU_MODELBUS_DIR unset and no "
+           "live watcher)")
+        return out
+    if not os.path.isdir(bus_dir):
+        out["bus_dir_error"] = f"{bus_dir} does not exist"
+        _p(f"bus dir       : {bus_dir} (does not exist)")
+        return out
+    desc = modelbus.ModelBus(bus_dir).describe()
+    out["bus"] = desc
+    _p(f"bus dir       : {bus_dir}")
+    _p(f"  versions    : {desc['versions']} (latest "
+       f"v{desc['latest']} @ step {desc['latest_step']}, "
+       f"keep {desc['keep']})")
+    _p(f"  quarantined : {desc['quarantined'] or 'none'}")
+    for r in desc["rejects"]:
+        _p(f"  reject      : v{r.get('version')} by "
+           f"{r.get('worker')!r} — {r.get('reason')}"
+           f"{': ' + r['detail'] if r.get('detail') else ''}")
+    return out
+
+
 def check_watchdog():
     """Watchdog knobs + the most recent crash bundle, if one exists
     (docs/ROBUSTNESS.md) — the first thing to read after a wedged run."""
@@ -951,6 +1002,7 @@ SECTIONS = (
     ("compile_cache", check_compile_cache),
     ("serving", check_serving),
     ("serving_fleet", check_fleet),
+    ("model_bus", check_modelbus),
     ("kernels", check_kernels),
     ("quantization", check_quantization),
     ("watchdog", check_watchdog),

@@ -459,24 +459,29 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                 raise serving.ServerBusyError(name, 0, 0)
             if status != 200:
                 raise RuntimeError(f"HTTP {status}: {payload[:120]!r}")
-            return json.loads(payload).get("phases"), connect_ms
+            data = json.loads(payload)
+            return data.get("phases"), connect_ms, \
+                data.get("model_version")
     else:
         def do_request(name, x):
             fut = server.submit(name, x)
             fut.result(10.0)
-            return fut.breakdown(), 0.0
+            return fut.breakdown(), 0.0, fut.model_version
 
     pool = [np.random.RandomState(i).randn(1, dim).astype(np.float32)
             for i in range(64)]
     lock = threading.Lock()
     lats, completed, rejected, errors = [], [0], [0], []
+    versions = set()   # distinct model-bus versions seen in responses
     phases = _PhaseAgg(lock)
     stop_at = time.perf_counter() + duration
 
-    def record(ms):
+    def record(ms, ver=None):
         with lock:
             lats.append(ms)
             completed[0] += 1
+            if ver is not None:
+                versions.add(ver)
 
     def closed_worker(tid):
         i = 0
@@ -485,8 +490,9 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
             x = pool[(tid * 7 + i) % len(pool)]
             t0 = time.perf_counter()
             try:
-                bd, connect_ms = do_request(name, x)
-                record((time.perf_counter() - t0) * 1e3 - connect_ms)
+                bd, connect_ms, ver = do_request(name, x)
+                record((time.perf_counter() - t0) * 1e3 - connect_ms,
+                       ver)
                 phases.record(bd)
             except serving.ServerBusyError:
                 with lock:
@@ -517,7 +523,8 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                 t0, fut = item
                 try:
                     fut.result(10.0)
-                    record((time.perf_counter() - t0) * 1e3)
+                    record((time.perf_counter() - t0) * 1e3,
+                           fut.model_version)
                     phases.record(fut.breakdown())
                 except serving.ServerBusyError:
                     with lock:
@@ -586,6 +593,9 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
         "batch_fill_ratio": round(sum(fills) / len(fills), 4)
         if fills else None,
         "recompiles_during_run": post.get("misses", 0) - pre_misses,
+        # distinct model-bus versions stamped into responses (>1 means
+        # live weight updates flipped mid-run; 0 = load-time weights)
+        "model_versions": sorted(versions) if versions else None,
         "server_stats": stats["models"],
         # per-phase latency split from the serving span tracer
         # (queue_wait/batch_collect/h2d/compute/respond; None when
@@ -622,6 +632,7 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
             for i in range(64)]
     lock = threading.Lock()
     lats, completed, rejected, errors = [], [0], [0], []
+    versions = set()
     clients = []
     phases = _PhaseAgg(lock)
     stop_at = time.perf_counter() + duration
@@ -653,14 +664,17 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
                 with lock:
                     errors.append(f"HTTP {status}")
             else:
+                try:
+                    data = json.loads(payload)
+                except ValueError:
+                    data = {}
                 with lock:
                     lats.append((time.perf_counter() - t0) * 1e3
                                 - connect_ms)
                     completed[0] += 1
-                try:
-                    phases.record(json.loads(payload).get("phases"))
-                except ValueError:
-                    pass
+                    if data.get("model_version") is not None:
+                        versions.add(data["model_version"])
+                phases.record(data.get("phases"))
             i += 1
 
     threads = [threading.Thread(target=worker, args=(t,), daemon=True)
@@ -678,6 +692,7 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
         "rejected": rejected[0], "errors": len(errors),
         "first_errors": errors[:3],
         "rps": round(completed[0] / elapsed, 1) if elapsed else 0.0,
+        "model_versions": sorted(versions) if versions else None,
         "phase_breakdown": phases.report(),
         "traced_requests": phases.traced,
     }
