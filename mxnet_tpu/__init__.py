@@ -59,6 +59,21 @@ __all__ = [
     "ndarray", "NDArray", "initializer", "init", "gluon", "__version__",
 ]
 
+import os as _os
+
+if _os.environ.get("MXNET_TPU_CONCUR_TRACE", "").lower() in ("1", "true",
+                                                             "on"):
+    # arm the lock witness (chaos drills / supervised workers): wraps the
+    # package's module-level locks and cross-checks acquisition order at
+    # exit — analysis/concur.py pass 4. After the eager imports above so
+    # the sweep never imports submodules against a half-initialised
+    # package.
+    from .analysis import concur as _concur
+
+    _concur.trace_locks(register_atexit=True)
+    del _concur
+del _os
+
 
 def __getattr__(name):
     # lazily exposed heavyweight subsystems

@@ -13,19 +13,25 @@ The NNVM-pass analogue for this reproduction, TPU-flavored:
   detector, donation-safety checker, recompile-churn detector). The module
   is callable: ``analysis.distcheck(...)``; auto-run by ``ShardedTrainer``
   before compile unless ``MXNET_TPU_DISTCHECK=0``.
+* :mod:`~mxnet_tpu.analysis.concur` — concurrency analyzer over the
+  threaded control plane (lock-order deadlock detector, shared-state
+  pass, torn-file protocol checker, runtime lock witness). Callable:
+  ``analysis.concur(...)``; ``MXNET_TPU_CONCUR=0`` opts out and
+  ``MXNET_TPU_CONCUR_TRACE=1`` arms the witness at import.
 
-The companion source-level checker lives in ``tools/mxlint.py``.
+The companion source-level checker lives in ``tools/mxlint.py`` (which
+runs concur's static passes as its three concurrency rules).
 
 ``sanitize`` and ``distcheck`` are imported eagerly (NDArray sync points
 and the dispatch/compile caches read their ``ACTIVE``/``DONATED``/
 ``CACHE_TRACK`` flags inline); the verifier — which pulls in the
-symbol/registry layers — loads on first use.
+symbol/registry layers — and the concurrency analyzer load on first use.
 """
 from __future__ import annotations
 
 from . import distcheck, sanitize
 
-__all__ = ["sanitize", "distcheck", "verify", "verify_graph",
+__all__ = ["sanitize", "distcheck", "concur", "verify", "verify_graph",
            "GraphVerifyError", "Issue", "raise_if_errors", "verify_enabled"]
 
 _VERIFY_NAMES = ("verify_graph", "GraphVerifyError", "Issue",
@@ -33,13 +39,23 @@ _VERIFY_NAMES = ("verify_graph", "GraphVerifyError", "Issue",
 
 
 def __getattr__(name):
+    # import_module, NOT `from . import x`: the fromlist form re-enters
+    # this __getattr__ through importlib's hasattr probe before the
+    # submodule attribute is bound — unbounded recursion
     if name == "verify" or name in _VERIFY_NAMES:
-        from . import verify as _verify
+        import importlib
 
+        _verify = importlib.import_module(".verify", __name__)
         globals().setdefault("verify", _verify)
         if name == "verify":
             return _verify
         value = getattr(_verify, name)
         globals()[name] = value
         return value
+    if name == "concur":
+        import importlib
+
+        _concur = importlib.import_module(".concur", __name__)
+        globals()["concur"] = _concur
+        return _concur
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1330 @@
+"""Concurrency analyzer: lock-order, shared-state, torn-file + witness.
+
+The fourth analysis subsystem. The reference's threaded engine made every
+dependency hazard explicit — ``Engine::PushAsync`` declared the vars an
+operation read and mutated, and the engine scheduled around them (SURVEY
+§L2). This reproduction's Python control plane (serving batcher threads,
+watchdog waiters, gang heartbeat daemons, bus watchers, fleet routers)
+has no such declaration layer, and each of the last three PRs found a
+real concurrency bug only en route. This module turns that class of bug
+into a checked contract, in four passes:
+
+* **Pass 1 — lock-order deadlock detector** (:func:`check_lock_order`):
+  AST walk over the package extracting every ``threading.Lock`` /
+  ``RLock`` / ``Condition`` attribute and the ``with``/``acquire``
+  nesting between them per function (following direct intra-package
+  calls one level deep), building a global lock-acquisition graph. A
+  cycle is a potential-deadlock Issue naming both acquisition sites.
+* **Pass 2 — shared-state pass** (:func:`check_shared_state`): flag
+  module-level mutable globals and ``self.*`` containers *written* from
+  code reachable from a ``Thread(target=...)``/``Timer`` entry point
+  while also written from non-thread code, with no common lock held at
+  every write site — the exact class of the ``_atomic_json`` bug (PR
+  16). Known-safe idioms (seq-claimed flight ring slots, warn-once
+  latches, lossy counters) carry a ``# concur: atomic`` suppression.
+* **Pass 3 — torn-file protocol checker** (:func:`check_torn_files`):
+  every ``open(..., "w")`` / ``json.dump`` / ``os.replace`` site must
+  route through ``checkpoint.atomic_write`` (a writer callback, checked
+  by line interval) or a seam registered in :data:`TORN_SEAMS`; seam
+  functions doing their own tmp+replace must embed **pid and thread
+  ident** in the tmp name; ``json.load`` readers of the on-disk JSON
+  protocols must tolerate torn records (skip-on-parse-error visible in
+  the same function). ``# concur: torn-ok`` suppresses a site.
+* **Pass 4 — runtime lock witness** (:func:`trace_locks` /
+  :func:`check_witness`): an opt-in shim wrapping the package's
+  module-level locks to record the *actual* acquisition order per
+  thread in a constant-memory flight-style ring. The witnessed order is
+  cross-checked against the static graph (and against itself) on demand
+  or at process exit — a witnessed inversion raises a site-named
+  :class:`LockOrderError` in tests/chaos instead of a silent future
+  deadlock.
+
+Findings are structured :class:`Issue` objects (same shape as the graph
+verifier's); errors raise :class:`ConcurError` (a ``GraphVerifyError``
+subclass when the package is importable). The module is callable —
+``mxnet_tpu.analysis.concur(...)`` is :func:`run` — and the whole
+subsystem honours ``MXNET_TPU_CONCUR=0``.
+
+This file is deliberately **stdlib-only at import time** so
+``tools/mxlint.py`` can load it standalone (by file path) and run passes
+1–3 as lint rules without importing the jax-heavy package.
+"""
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import sys
+import threading
+import time
+import types
+
+__all__ = [
+    "enabled", "run", "run_static", "scan", "Issue",
+    "ConcurWarning", "LockOrderError",
+    "check_lock_order", "check_shared_state", "check_torn_files",
+    "TORN_SEAMS", "register_seam",
+    "trace_locks", "untrace_locks", "wrap", "check_witness",
+    "witness_state", "witness_tail", "reset_witness",
+]
+
+ENV = "MXNET_TPU_CONCUR"
+ENV_TRACE = "MXNET_TPU_CONCUR_TRACE"
+ENV_RING = "MXNET_TPU_CONCUR_RING"
+
+_LOCK_KINDS = ("Lock", "RLock", "Condition")
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popitem", "remove", "discard",
+    "clear", "sort", "reverse",
+})
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter", "bytearray",
+})
+# exception names broad enough to swallow a torn/partial JSON record
+_TORN_GUARDS = frozenset({
+    "ValueError", "JSONDecodeError", "Exception", "BaseException",
+})
+
+
+def enabled() -> bool:
+    """The ``MXNET_TPU_CONCUR`` gate (on unless explicitly disabled):
+    controls :func:`run`, the mxlint concurrency rules, and the lock
+    witness arming."""
+    return os.environ.get(ENV, "1").lower() not in ("0", "false", "off")
+
+
+def _package_root():
+    # mxnet_tpu/analysis/concur.py -> mxnet_tpu/
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ====================================================================== #
+# Structured findings                                                    #
+# ====================================================================== #
+
+class Issue:
+    """One concurrency finding. Same field shape as the graph verifier's
+    ``Issue`` (severity/code/node/op/message), with ``node`` carrying the
+    ``path:line`` site and ``op`` the enclosing function's qualname —
+    kept local so this module loads without the package."""
+
+    __slots__ = ("severity", "code", "node", "op", "message")
+
+    def __init__(self, severity, code, node, op, message):
+        self.severity = severity  # "error" | "warning"
+        self.code = code
+        self.node = node          # "relpath.py:line" site
+        self.op = op              # enclosing function qualname ("" = module)
+        self.message = message
+
+    @property
+    def is_error(self):
+        return self.severity == "error"
+
+    def __str__(self):
+        where = self.node or "package"
+        if self.op:
+            where += f" ({self.op})"
+        return f"[{self.severity}:{self.code}] {where}: {self.message}"
+
+    def __repr__(self):
+        return f"<Issue {self}>"
+
+
+class ConcurWarning(UserWarning):
+    """Warning-severity concurrency findings surface here."""
+
+
+class LockOrderError(RuntimeError):
+    """A witnessed lock-acquisition order contradicts the established
+    order (static graph or an earlier witnessed pair); the message names
+    both acquisition sites and the offending thread."""
+
+
+def _realise_error_class():
+    """``ConcurError`` subclasses ``GraphVerifyError`` (same structured
+    ``.issues`` payload) when the package is importable; standalone (the
+    mxlint file-path load) it falls back to a plain RuntimeError
+    subclass so passes 1–3 still run without jax on the path."""
+    try:
+        from .verify import GraphVerifyError as _Base  # type: ignore
+    except Exception:
+        class _Base(RuntimeError):  # type: ignore
+            def __init__(self, issues):
+                self.issues = list(issues)
+                errors = [i for i in self.issues if i.is_error]
+                lines = "\n  ".join(str(i) for i in errors)
+                super().__init__(
+                    f"concurrency verification failed ({len(errors)} "
+                    f"error{'s' if len(errors) != 1 else ''}):\n  {lines}")
+
+    class ConcurError(_Base):
+        """Concurrency verification failed; ``.issues`` carries the
+        structured finding list (errors + warnings)."""
+
+    ConcurError.__module__ = __name__
+    return ConcurError
+
+
+def _raise_if_errors(issues, warn=True):
+    import warnings
+
+    if warn:
+        for i in issues:
+            if not i.is_error:
+                warnings.warn(str(i), ConcurWarning, stacklevel=3)
+    if any(i.is_error for i in issues):
+        raise sys.modules[__name__].ConcurError(issues)
+    return issues
+
+
+# ====================================================================== #
+# Torn-file seam registry (pass 3)                                       #
+# ====================================================================== #
+
+# (modkey, qualname) -> reason. A seam is a function allowed to touch
+# the filesystem write path directly; everything else must route through
+# checkpoint.atomic_write (or carry `# concur: torn-ok`). Seams that do
+# their own tmp+os.replace are additionally held to the pid+thread-ident
+# tmp-name rule (the PR 16 `_atomic_json` bug class).
+TORN_SEAMS = {
+    ("checkpoint", "atomic_write"):
+        "the canonical tmp+fsync+replace seam every protocol writer uses",
+    ("elastic", "_atomic_json"):
+        "heartbeat/announce writer kept off atomic_write so beats stay "
+        "recordable while the ckpt.write fault point is armed",
+    ("telemetry.fleet", "_atomic_json"):
+        "telemetry shard writer — same fault-isolation contract as "
+        "elastic's",
+    ("serving.worker", "write_spec"):
+        "serving.json author (test/tooling side, pre-fleet)",
+    ("kernels.table", "save"):
+        "dispatch-table snapshot with its own pid+tid tmp+fsync+replace",
+    ("compile", "_atomic_write_bytes"):
+        "compile-cache writer: atomic_write's local twin without the "
+        "ckpt.write fault point (PR 15 framed entries)",
+    ("watchdog", "_write_bundle"):
+        "crash-bundle writer: bundle dir is uniquely named per "
+        "pid+seq, single-writer by construction",
+    ("watchdog", "_dump_tracebacks"):
+        "crash-bundle helper — writes inside the single-writer bundle "
+        "dir",
+    ("recordio", "MXRecordIO.open"):
+        "recordio data file — single-writer file format by contract",
+    ("recordio", "MXIndexedRecordIO.open"):
+        "recordio index file — single-writer file format by contract",
+    # user-facing save APIs: caller-named destination paths, single
+    # writer by MXNet API contract (parity surface — a torn file on
+    # crash mirrors the reference's semantics)
+    ("symbol.symbol", "Symbol.save"):
+        "user-facing Symbol.save (API parity)",
+    ("ndarray.utils", "save"):
+        "user-facing mx.nd.save (API parity)",
+    ("module.module", "Module.save_optimizer_states"):
+        "user-facing optimizer-state save (API parity)",
+    ("kvstore.kvstore", "KVStore.save_optimizer_states"):
+        "user-facing optimizer-state save (API parity)",
+    ("gluon.trainer", "Trainer.save_states"):
+        "user-facing trainer-state save (API parity)",
+    ("onnx.mx2onnx", "export_model"):
+        "user-facing ONNX export (API parity)",
+    ("profiler", "dump"):
+        "user-facing profiler trace dump (API parity)",
+    ("telemetry.trace", "dump"):
+        "user-facing request-trace dump (tooling output path)",
+    ("io.io", "write_token_shard"):
+        "dataset-prep shard author — offline single-writer tool path",
+}
+
+
+def register_seam(modkey, qualname, reason):
+    """Register an additional torn-file seam at runtime (tests, embedders
+    with their own atomic writers)."""
+    TORN_SEAMS[(str(modkey), str(qualname))] = str(reason)
+
+
+# ====================================================================== #
+# AST scan model                                                         #
+# ====================================================================== #
+
+class _Fn:
+    __slots__ = ("modkey", "path", "qualname", "lineno", "end_lineno",
+                 "acquires", "calls", "writes", "filesites", "json_reads",
+                 "thread_targets", "is_threaded", "src_segment")
+
+    def __init__(self, modkey, path, qualname, lineno, end_lineno):
+        self.modkey = modkey
+        self.path = path
+        self.qualname = qualname
+        self.lineno = lineno
+        self.end_lineno = end_lineno
+        self.acquires = []       # (lockid, line, held tuple of (id, line))
+        self.calls = []          # (ref, line, held tuple)
+        self.writes = []         # (stateid, line, held frozenset, suppressed)
+        self.filesites = []      # (kind, line, suppressed)
+        self.json_reads = []     # (line, guarded, suppressed)
+        self.thread_targets = [] # ref
+        self.is_threaded = False
+        self.src_segment = ""
+
+
+class _FileScan:
+    """One file's collected facts (phase 1 of 2; cross-file resolution
+    happens in :class:`_Model`)."""
+
+    def __init__(self, path, modkey, source):
+        self.path = path
+        self.modkey = modkey
+        self.relpath = None      # set by _Model
+        self.aliases = {}        # local name -> modkey of package module
+        self.locks = {}          # lockid -> (kind, line)
+        self.globals_mutable = {}  # name -> line
+        self.fns = {}            # qualname -> _Fn
+        self.thread_targets = [] # ref ("name", n) | ("self", cls, m) | ("mod", a, f)
+        self.atomic_intervals = []  # (lo, hi) line ranges exempt via atomic_write
+        self.suppress_atomic = set()   # lines with the atomic marker
+        self.suppress_torn = set()     # lines with the torn-ok marker
+        self._lines = source.split("\n")
+        # grammar: the marker terminates the line (reasons go on the
+        # comment line above) — keeps doc/message mentions from counting
+        for i, ln in enumerate(self._lines, 1):
+            stripped = ln.rstrip()
+            if stripped.endswith("# concur: atomic"):
+                self.suppress_atomic.add(i)
+            elif stripped.endswith("# concur: torn-ok"):
+                self.suppress_torn.add(i)
+        self._source = source
+
+    # ------------------------------------------------------------ helpers --
+    def _segment(self, node):
+        lo = max(node.lineno - 1, 0)
+        hi = node.end_lineno or node.lineno
+        return "\n".join(self._lines[lo:hi])
+
+    def _is_lock_ctor(self, node):
+        """`threading.Lock()` / `Lock()` / `_threading.RLock()` -> kind."""
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+        elif isinstance(f, ast.Name):
+            name = f.id
+        return name if name in _LOCK_KINDS else None
+
+    def _is_mutable_ctor(self, node):
+        if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            return name in _MUTABLE_CTORS
+        return False
+
+    def _resolve_lockref(self, expr, cls):
+        """Candidate lock id for a `with` item / `.acquire()` receiver."""
+        if isinstance(expr, ast.Name):
+            return f"{self.modkey}.{expr.id}"
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                         ast.Name):
+            base = expr.value.id
+            if base == "self" and cls:
+                return f"{self.modkey}.{cls}.{expr.attr}"
+            if base in self.aliases:
+                return f"{self.aliases[base]}.{expr.attr}"
+        return None
+
+    def _call_ref(self, func):
+        """Reference for one-level call following / thread targets."""
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            base = func.value.id
+            if base == "self":
+                return ("self", func.attr)
+            if base in self.aliases:
+                return ("mod", self.aliases[base], func.attr)
+        return None
+
+    # ------------------------------------------------------------- driver --
+    def scan(self, tree):
+        self._collect_imports(tree)
+        self._collect_atomic_intervals(tree)
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                self._module_assign(node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name):
+                self._module_binding(node.target.id, node.value, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(node, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._scan_fn(item, cls=node.name)
+        return self
+
+    def _collect_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                self._import_from(node)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    # absolute `import mxnet_tpu.x.y as z`
+                    parts = a.name.split(".")
+                    if parts[0] == "mxnet_tpu" and len(parts) > 1:
+                        local = a.asname or parts[-1]
+                        self.aliases[local] = ".".join(parts[1:])
+
+    def _import_from(self, node):
+        if node.level:
+            parts = self.modkey.split(".") if self.modkey else []
+            base = parts[:-node.level] if node.level <= len(parts) else []
+            prefix = list(base)
+            if node.module:
+                prefix += node.module.split(".")
+        elif node.module and node.module.split(".")[0] == "mxnet_tpu":
+            prefix = node.module.split(".")[1:]
+        else:
+            return
+        for a in node.names:
+            local = a.asname or a.name
+            self.aliases[local] = ".".join(prefix + [a.name]) if prefix \
+                else a.name
+
+    def _collect_atomic_intervals(self, tree):
+        """Line intervals of local defs / lambdas passed to an
+        ``atomic_write(...)`` call — their file writes are the sanctioned
+        writer-callback pattern (mxlint's sync-exemption technique)."""
+        defs = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(
+                    (node.lineno, node.end_lineno or node.lineno))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name != "atomic_write":
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    self.atomic_intervals.append(
+                        (arg.lineno, arg.end_lineno or arg.lineno))
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    self.atomic_intervals.extend(defs[arg.id])
+
+    def _module_assign(self, node):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self._module_binding(tgt.id, node.value, node)
+
+    def _module_binding(self, name, value, node):
+        kind = self._is_lock_ctor(value)
+        if kind:
+            self.locks[f"{self.modkey}.{name}"] = (kind, node.lineno)
+        elif self._is_mutable_ctor(value):
+            self.globals_mutable[name] = node.lineno
+
+    # ----------------------------------------------------- function walk --
+    def _scan_fn(self, node, cls):
+        qual = f"{cls}.{node.name}" if cls else node.name
+        fn = _Fn(self.modkey, self.path, qual, node.lineno,
+                 node.end_lineno or node.lineno)
+        fn.src_segment = self._segment(node)
+        self.fns[qual] = fn
+        self._globals_declared = set()
+        self._walk_block(node.body, fn, cls, held=(), guards=frozenset())
+
+    def _walk_block(self, stmts, fn, cls, held, guards):
+        for st in stmts:
+            self._walk_stmt(st, fn, cls, held, guards)
+
+    def _walk_stmt(self, st, fn, cls, held, guards):
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in st.items:
+                self._scan_expr(item.context_expr, fn, cls, tuple(new_held),
+                                guards)
+                lockid = self._resolve_lockref(item.context_expr, cls)
+                if lockid is not None:
+                    fn.acquires.append((lockid, st.lineno,
+                                        tuple(new_held)))
+                    new_held.append((lockid, st.lineno))
+            self._walk_block(st.body, fn, cls, tuple(new_held), guards)
+        elif isinstance(st, ast.Try):
+            caught = set()
+            for h in st.handlers:
+                if h.type is None:
+                    caught.add("Exception")
+                else:
+                    for n in ast.walk(h.type):
+                        if isinstance(n, ast.Name):
+                            caught.add(n.id)
+                        elif isinstance(n, ast.Attribute):
+                            caught.add(n.attr)
+            self._walk_block(st.body, fn, cls, held,
+                             guards | frozenset(caught))
+            for h in st.handlers:
+                self._walk_block(h.body, fn, cls, held, guards)
+            self._walk_block(st.orelse, fn, cls, held, guards)
+            self._walk_block(st.finalbody, fn, cls, held, guards)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def (writer callback, loop closure): its body runs
+            # later, NOT under the current lock set
+            self._walk_block(st.body, fn, cls, held=(), guards=frozenset())
+        elif isinstance(st, ast.ClassDef):
+            for item in st.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._walk_block(item.body, fn, cls, held=(),
+                                     guards=frozenset())
+        elif isinstance(st, (ast.If, ast.While)):
+            self._scan_expr(st.test, fn, cls, held, guards)
+            self._walk_block(st.body, fn, cls, held, guards)
+            self._walk_block(st.orelse, fn, cls, held, guards)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter, fn, cls, held, guards)
+            self._walk_block(st.body, fn, cls, held, guards)
+            self._walk_block(st.orelse, fn, cls, held, guards)
+        elif isinstance(st, ast.Global):
+            self._globals_declared.update(st.names)
+        else:
+            self._scan_simple(st, fn, cls, held, guards)
+
+    # ----------------------------------------------------- simple stmts --
+    def _scan_simple(self, st, fn, cls, held, guards):
+        if isinstance(st, (ast.Assign, ast.AugAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) else \
+                [st.target]
+            for tgt in targets:
+                self._write_target(tgt, st, fn, cls, held,
+                                   aug=isinstance(st, ast.AugAssign))
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                self._scan_call(node, fn, cls, held, guards)
+
+    def _scan_expr(self, expr, fn, cls, held, guards):
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._scan_call(node, fn, cls, held, guards)
+
+    def _held_ids(self, held):
+        return frozenset(h for h, _ in held)
+
+    def _write_target(self, tgt, st, fn, cls, held, aug):
+        line = st.lineno
+        suppressed = line in self.suppress_atomic
+        # NAME[...] = v  /  NAME[...] += v   on a module-level container
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if isinstance(base, ast.Name) and \
+                    base.id in self.globals_mutable:
+                fn.writes.append((f"{self.modkey}.{base.id}", line,
+                                  self._held_ids(held), suppressed))
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls:
+                fn.writes.append((f"{self.modkey}.{cls}.{base.attr}",
+                                  line, self._held_ids(held), suppressed))
+        # NAME += v with a `global NAME` declaration: read-modify-write
+        elif isinstance(tgt, ast.Name) and aug and \
+                tgt.id in self._globals_declared:
+            fn.writes.append((f"{self.modkey}.{tgt.id}", line,
+                              self._held_ids(held), suppressed))
+        # self.X += v: read-modify-write on shared instance state
+        elif isinstance(tgt, ast.Attribute) and aug and \
+                isinstance(tgt.value, ast.Name) and \
+                tgt.value.id == "self" and cls:
+            fn.writes.append((f"{self.modkey}.{cls}.{tgt.attr}", line,
+                              self._held_ids(held), suppressed))
+
+    def _scan_call(self, node, fn, cls, held, guards):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        line = node.lineno
+
+        # ---- thread entry points -------------------------------------
+        if fname in ("Thread", "Timer"):
+            target = None
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    target = kw.value
+            if target is None and fname == "Timer" and len(node.args) >= 2:
+                target = node.args[1]
+            if target is not None:
+                ref = self._call_ref(target)
+                if ref:
+                    self.thread_targets.append(ref)
+
+        # ---- lock acquire as a point event ---------------------------
+        if fname == "acquire" and isinstance(f, ast.Attribute):
+            lockid = self._resolve_lockref(f.value, cls)
+            if lockid is not None:
+                fn.acquires.append((lockid, line, tuple(held)))
+
+        # ---- container-mutating method on a shared object ------------
+        if fname in _MUTATORS and isinstance(f, ast.Attribute):
+            base = f.value
+            suppressed = line in self.suppress_atomic
+            if isinstance(base, ast.Name) and \
+                    base.id in self.globals_mutable:
+                fn.writes.append((f"{self.modkey}.{base.id}", line,
+                                  self._held_ids(held), suppressed))
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls:
+                fn.writes.append((f"{self.modkey}.{cls}.{base.attr}",
+                                  line, self._held_ids(held), suppressed))
+            # NAME[k].append(...) on a module container
+            elif isinstance(base, ast.Subscript) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id in self.globals_mutable:
+                fn.writes.append((f"{self.modkey}.{base.value.id}", line,
+                                  self._held_ids(held), suppressed))
+
+        # ---- torn-file write sites -----------------------------------
+        torn_ok = line in self.suppress_torn
+        if fname == "open" and isinstance(f, ast.Name):
+            mode = None
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and mode[:1] in ("w", "a", "x"):
+                fn.filesites.append(("open-w", line, torn_ok))
+        elif fname == "replace" and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "os":
+            fn.filesites.append(("os.replace", line, torn_ok))
+        elif fname == "dump" and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "json":
+            fn.filesites.append(("json.dump", line, torn_ok))
+        elif fname == "load" and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "json":
+            guarded = bool(guards & _TORN_GUARDS)
+            fn.json_reads.append((line, guarded, torn_ok))
+
+        # ---- direct calls for one-level following --------------------
+        ref = self._call_ref(f)
+        if ref is not None and fname not in _MUTATORS:
+            fn.calls.append((ref, line, tuple(held)))
+
+
+# ====================================================================== #
+# Cross-file model + passes 1-3                                          #
+# ====================================================================== #
+
+class _Model:
+    """The package-wide scan: lock table, acquisition graph, shared-state
+    write census, torn-file sites."""
+
+    def __init__(self, root, files):
+        self.root = os.path.abspath(root)
+        self.files = {}          # modkey -> _FileScan
+        self.locks = {}          # lockid -> (kind, relpath, line)
+        self.edges = {}          # a -> {b: (site_a, site_b, via)}
+        self.suppressions = {"atomic": 0, "torn": 0}
+        self.errors = []         # unparseable files (path, message)
+        for path in files:
+            self._scan_file(path)
+        self._build_lock_table()
+        self._mark_threaded()
+        self._build_edges()
+
+    # ------------------------------------------------------------ intake --
+    def _relpath(self, path):
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        return rel.replace(os.sep, "/")
+
+    def _scan_file(self, path):
+        rel = self._relpath(path)
+        modkey = rel[:-3] if rel.endswith(".py") else rel
+        modkey = modkey.replace("/", ".")
+        if modkey.endswith(".__init__"):
+            modkey = modkey[: -len(".__init__")]
+        elif modkey == "__init__":
+            modkey = ""
+        # normalise scans rooted at the repo (mxlint) vs the package dir:
+        # seam-registry keys are package-relative
+        if modkey == "mxnet_tpu":
+            modkey = ""
+        elif modkey.startswith("mxnet_tpu."):
+            modkey = modkey[len("mxnet_tpu."):]
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            self.errors.append((rel, str(e)))
+            return
+        fs = _FileScan(path, modkey, source).scan(tree)
+        fs.relpath = rel
+        self.files[modkey] = fs
+        self.suppressions["atomic"] += len(fs.suppress_atomic)
+        self.suppressions["torn"] += len(fs.suppress_torn)
+
+    def _build_lock_table(self):
+        for fs in self.files.values():
+            for lockid, (kind, line) in fs.locks.items():
+                self.locks[lockid] = (kind, fs.relpath, line)
+        # instance locks assigned in __init__ (self.X = Lock()) need a
+        # second sweep: they live in fn walks, not module bindings
+        for fs in self.files.values():
+            for qual, fn in fs.fns.items():
+                cls = qual.split(".")[0] if "." in qual else None
+                if not cls:
+                    continue
+                seg = fn.src_segment
+                for kind in _LOCK_KINDS:
+                    needle = f"{kind}("
+                    idx = 0
+                    while True:
+                        idx = seg.find(needle, idx)
+                        if idx < 0:
+                            break
+                        # `self.NAME = threading.Kind(` on the same line
+                        lstart = seg.rfind("\n", 0, idx) + 1
+                        linetxt = seg[lstart:idx]
+                        if "self." in linetxt and "=" in linetxt:
+                            attr = linetxt.split("self.", 1)[1]
+                            attr = attr.split("=", 1)[0].strip()
+                            if attr.isidentifier():
+                                lockid = f"{fs.modkey}.{cls}.{attr}"
+                                if lockid not in self.locks:
+                                    line = fn.lineno + seg.count(
+                                        "\n", 0, idx)
+                                    self.locks[lockid] = (
+                                        kind, fs.relpath, line)
+                        idx += len(needle)
+
+    # -------------------------------------------------- thread closure --
+    def _resolve_fn(self, fs, ref, caller=None):
+        kind = ref[0]
+        if kind == "name":
+            return fs.fns.get(ref[1])
+        if kind == "self":
+            if len(ref) == 3:           # ("self", cls, meth) thread target
+                return fs.fns.get(f"{ref[1]}.{ref[2]}")
+            if caller and "." in caller.qualname:
+                cls = caller.qualname.split(".")[0]
+                return fs.fns.get(f"{cls}.{ref[1]}")
+            # entry ref without class context: match any class's method
+            for qual, fn in fs.fns.items():
+                if qual.endswith("." + ref[1]):
+                    return fn
+            return None
+        if kind == "mod":
+            other = self.files.get(ref[1])
+            return other.fns.get(ref[2]) if other else None
+        return None
+
+    def _mark_threaded(self):
+        worklist = []
+        for fs in self.files.values():
+            for ref in fs.thread_targets:
+                fn = self._resolve_fn(fs, ref)
+                if fn is not None:
+                    worklist.append(fn)
+        seen = set()
+        while worklist:
+            fn = worklist.pop()
+            key = (fn.modkey, fn.qualname)
+            if key in seen:
+                continue
+            seen.add(key)
+            fn.is_threaded = True
+            fs = self.files.get(fn.modkey)
+            if fs is None:
+                continue
+            for ref, _line, _held in fn.calls:
+                callee = self._resolve_fn(fs, ref, caller=fn)
+                if callee is not None and \
+                        (callee.modkey, callee.qualname) not in seen:
+                    worklist.append(callee)
+
+    # ------------------------------------------------------ lock graph --
+    def _site(self, fs, line):
+        return f"{fs.relpath}:{line}"
+
+    def _add_edge(self, a, b, site_a, site_b, via=""):
+        if a == b:
+            return
+        self.edges.setdefault(a, {})
+        if b not in self.edges[a]:
+            self.edges[a][b] = (site_a, site_b, via)
+
+    def _build_edges(self):
+        for fs in self.files.values():
+            for fn in fs.fns.values():
+                for lockid, line, held in fn.acquires:
+                    if lockid not in self.locks:
+                        continue
+                    for h, hline in held:
+                        if h in self.locks:
+                            self._add_edge(h, lockid,
+                                           self._site(fs, hline),
+                                           self._site(fs, line))
+                for ref, line, held in fn.calls:
+                    if not held:
+                        continue
+                    callee = self._resolve_fn(fs, ref, caller=fn)
+                    if callee is None:
+                        continue
+                    cfs = self.files.get(callee.modkey)
+                    if cfs is None:
+                        continue
+                    for lockid, aline, _h in callee.acquires:
+                        if lockid not in self.locks:
+                            continue
+                        for h, hline in held:
+                            if h in self.locks:
+                                self._add_edge(
+                                    h, lockid, self._site(fs, hline),
+                                    self._site(cfs, aline),
+                                    via=f"via {callee.qualname}() called "
+                                        f"at {self._site(fs, line)}")
+
+
+def _collect_files(root=None, files=None):
+    if files:
+        root = root or os.path.commonpath(
+            [os.path.dirname(os.path.abspath(f)) or "." for f in files])
+        return root, sorted(files)
+    root = root or _package_root()
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for name in filenames:
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return root, sorted(out)
+
+
+_scan_cache = {}
+_scan_cache_lock = threading.Lock()
+
+
+def scan(root=None, files=None):
+    """Build (and for the default package scan, cache) the cross-file
+    concurrency model: lock table, acquisition graph, write census."""
+    root, file_list = _collect_files(root, files)
+    key = (root, tuple(file_list)) if files is None else None
+    if key is not None:
+        with _scan_cache_lock:
+            model = _scan_cache.get(key)
+        if model is not None:
+            return model
+    model = _Model(root, file_list)
+    if key is not None:
+        with _scan_cache_lock:
+            _scan_cache[key] = model
+    return model
+
+
+# ---------------------------------------------------------------- pass 1 --
+
+def _find_cycles(edges, cap=20):
+    """Enumerate simple cycles (deduped by node set), shortest first."""
+    cycles, seen = [], set()
+    for start in sorted(edges):
+        stack = [(start, [start])]
+        while stack and len(cycles) < cap:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, ())):
+                if nxt == start and len(path) >= 2:
+                    key = frozenset(path)
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(list(path))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+    cycles.sort(key=len)
+    return cycles
+
+
+def check_lock_order(model=None, root=None, files=None):
+    """Pass 1: cycles in the static lock-acquisition graph. Each cycle is
+    one error Issue naming every acquisition site on the loop."""
+    model = model or scan(root=root, files=files)
+    issues = []
+    for cycle in _find_cycles(model.edges):
+        hops = []
+        first_site = None
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            site_a, site_b, via = model.edges[a][b]
+            if first_site is None:
+                first_site = site_a
+            hop = (f"{a} (held at {site_a}) -> {b} (acquired at "
+                   f"{site_b}{', ' + via if via else ''})")
+            hops.append(hop)
+        issues.append(Issue(
+            "error", "lock-order-cycle", first_site, "",
+            "potential deadlock — lock-acquisition cycle: "
+            + "; ".join(hops)
+            + ". Impose one global order (acquire the locks in a fixed "
+              "sequence) or collapse to a single lock."))
+    return issues
+
+
+# ---------------------------------------------------------------- pass 2 --
+
+def check_shared_state(model=None, root=None, files=None):
+    """Pass 2: state written from thread-entry-reachable code AND from
+    non-thread code with no common lock across all write sites."""
+    model = model or scan(root=root, files=files)
+    states = {}   # stateid -> [(fs, fn, line, held, suppressed)]
+    for fs in model.files.values():
+        for fn in fs.fns.values():
+            for stateid, line, held, suppressed in fn.writes:
+                states.setdefault(stateid, []).append(
+                    (fs, fn, line, held, suppressed))
+    issues = []
+    for stateid in sorted(states):
+        sites = [s for s in states[stateid] if not s[4]]
+        if not sites:
+            continue
+        threaded = [s for s in sites if s[1].is_threaded]
+        plain = [s for s in sites if not s[1].is_threaded]
+        if not threaded or not plain:
+            continue
+        common = frozenset.intersection(*[s[3] for s in sites])
+        if common:
+            continue
+        t_fs, t_fn, t_line = threaded[0][0], threaded[0][1], threaded[0][2]
+        p_fs, p_fn, p_line = plain[0][0], plain[0][1], plain[0][2]
+        issues.append(Issue(
+            "warning", "unlocked-shared-state",
+            f"{t_fs.relpath}:{t_line}", t_fn.qualname,
+            f"{stateid!r} is written from thread-entry code here and "
+            f"from non-thread code at {p_fs.relpath}:{p_line} "
+            f"({p_fn.qualname}) with no lock held in common across the "
+            f"write sites — the _atomic_json bug class. Guard every "
+            f"write with one shared lock, or mark a provably GIL-atomic "
+            f"single-op idiom with `# concur: atomic`."))
+    return issues
+
+
+# ---------------------------------------------------------------- pass 3 --
+
+def check_torn_files(model=None, root=None, files=None):
+    """Pass 3: raw write sites off the atomic_write/seam path, seams with
+    tmp names missing pid+thread-ident, and unguarded protocol reads."""
+    model = model or scan(root=root, files=files)
+    issues = []
+    for modkey in sorted(model.files):
+        fs = model.files[modkey]
+        for qual in sorted(fs.fns):
+            fn = fs.fns[qual]
+            in_seam = (modkey, qual) in TORN_SEAMS
+            for kind, line, suppressed in fn.filesites:
+                if suppressed or in_seam:
+                    continue
+                if any(lo <= line <= hi for lo, hi in fs.atomic_intervals):
+                    continue
+                issues.append(Issue(
+                    "warning", "torn-file-write",
+                    f"{fs.relpath}:{line}", qual,
+                    f"raw {kind} outside checkpoint.atomic_write and the "
+                    f"seam registry — a reader can observe a torn "
+                    f"record. Route through atomic_write(path, writer), "
+                    f"register a seam with its own tmp+fsync+replace "
+                    f"protocol, or mark `# concur: torn-ok`."))
+            if in_seam and any(k == "os.replace" for k, _l, _s
+                               in fn.filesites):
+                seg = fn.src_segment
+                has_pid = "getpid" in seg
+                has_tid = ("get_ident" in seg or "native_id" in seg
+                           or "current_thread" in seg)
+                if not (has_pid and has_tid):
+                    rline = next(l for k, l, _s in fn.filesites
+                                 if k == "os.replace")
+                    missing = []
+                    if not has_pid:
+                        missing.append("os.getpid()")
+                    if not has_tid:
+                        missing.append("threading.get_ident()")
+                    issues.append(Issue(
+                        "warning", "torn-tmp-name",
+                        f"{fs.relpath}:{rline}", qual,
+                        f"seam does tmp+os.replace but its tmp name does "
+                        f"not embed {' and '.join(missing)} — two "
+                        f"threads writing the same path race on one tmp "
+                        f"file and the loser's os.replace dies with "
+                        f"FileNotFoundError (the PR 16 worker-exit bug)."))
+            for line, guarded, suppressed in fn.json_reads:
+                if guarded or suppressed:
+                    continue
+                issues.append(Issue(
+                    "warning", "torn-read",
+                    f"{fs.relpath}:{line}", qual,
+                    "json.load without a torn-record guard visible in "
+                    "this function — wrap in try/except ValueError (or "
+                    "broader) and skip/retry, or mark `# concur: "
+                    "torn-ok` if the input cannot be mid-replace."))
+    return issues
+
+
+# ====================================================================== #
+# Pass 4 — runtime lock witness                                          #
+# ====================================================================== #
+
+_wit_lock = threading.Lock()      # guards pairs/wrapped bookkeeping
+_wit_pairs = {}                   # (a, b) -> {"sites","thread","t"}
+_wit_local = threading.local()
+_wit_wrapped = []                 # (module, attr, original lock)
+_wit_armed = False
+_wit_ring = None
+_wit_seq = itertools.count(1)
+_wit_last_inversion = None
+
+
+class _Ring:
+    """Constant-memory acquisition ring (flight-recorder style): slots
+    are whole tuples stored with one GIL-atomic list assignment, so a
+    reader never observes a torn record."""
+
+    def __init__(self, capacity):
+        self.capacity = max(int(capacity), 8)
+        self._slots = [None] * self.capacity
+
+    def record(self, rec):
+        seq = next(_wit_seq)                     # GIL-atomic claim
+        self._slots[(seq - 1) % self.capacity] = (seq,) + rec
+
+    def tail(self, n=None):
+        live = [s for s in self._slots if s is not None]
+        live.sort()
+        return live[-n:] if n else live
+
+
+def _ring():
+    global _wit_ring
+    if _wit_ring is None:
+        _wit_ring = _Ring(int(os.environ.get(ENV_RING, "512")))
+    return _wit_ring
+
+
+def _held_stack():
+    st = getattr(_wit_local, "stack", None)
+    if st is None:
+        st = _wit_local.stack = []
+    return st
+
+
+def _call_site(skip=2):
+    f = sys._getframe(skip)
+    here = os.path.abspath(__file__)
+    while f is not None and os.path.abspath(f.f_code.co_filename) == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    path = f.f_code.co_filename
+    try:
+        rel = os.path.relpath(path, _package_root())
+        if not rel.startswith(".."):
+            path = rel.replace(os.sep, "/")
+        else:
+            path = os.path.basename(path)
+    except ValueError:
+        path = os.path.basename(path)
+    return f"{path}:{f.f_lineno}"
+
+
+class _WitnessLock:
+    """Transparent wrapper recording acquisition order per thread. RLock
+    re-entry generates no pairs; unknown attributes (Condition's wait /
+    notify, RLock internals) delegate to the wrapped object."""
+
+    def __init__(self, lock, name):
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, *args, **kwargs):
+        ok = self._lock.acquire(*args, **kwargs)
+        if ok:
+            self._note_acquire()
+        return ok
+
+    def release(self):
+        self._note_release()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._lock, name)
+
+    def _note_acquire(self):
+        stack = _held_stack()
+        reentrant = any(n == self.name for n, _ in stack)
+        site = _call_site(3)
+        if not reentrant:
+            for held_name, held_site in stack:
+                key = (held_name, self.name)
+                if key not in _wit_pairs:
+                    with _wit_lock:
+                        if key not in _wit_pairs:
+                            _wit_pairs[key] = {
+                                "sites": (held_site, site),
+                                "thread": threading.current_thread().name,
+                                "t": time.time(),
+                            }
+        stack.append((self.name, site))
+        _ring().record((time.time(), threading.get_ident(),
+                        threading.current_thread().name, self.name,
+                        "acquire", site))
+
+    def _note_release(self):
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == self.name:
+                del stack[i]
+                break
+        _ring().record((time.time(), threading.get_ident(),
+                        threading.current_thread().name, self.name,
+                        "release", _call_site(3)))
+
+
+def wrap(lock, name):
+    """Wrap one lock explicitly (instance locks the module sweep cannot
+    reach, or test fixtures)."""
+    if isinstance(lock, _WitnessLock):
+        return lock
+    return _WitnessLock(lock, str(name))
+
+
+def trace_locks(register_atexit=False):
+    """Arm the witness: wrap every module-level Lock/RLock/Condition the
+    static scan found (modules imported lazily and best-effort). Returns
+    the number of locks wrapped; no-op (0) when ``MXNET_TPU_CONCUR=0``
+    or already armed. ``register_atexit=True`` additionally cross-checks
+    at interpreter exit, printing (not raising) any inversion."""
+    global _wit_armed
+    if not enabled() or _wit_armed:
+        return 0
+    import importlib
+
+    model = scan()
+    wrapped = 0
+    with _wit_lock:
+        for lockid, (kind, _rel, _line) in sorted(model.locks.items()):
+            if lockid.startswith("analysis.concur."):
+                continue       # never wrap the witness's own bookkeeping
+            parts = lockid.split(".")
+            # module-level locks only: "<modkey>.<ATTR>" where modkey is
+            # a scanned file; class-qualified ids are instance locks
+            attr = parts[-1]
+            modkey = ".".join(parts[:-1])
+            if modkey not in model.files:
+                continue
+            try:
+                mod = importlib.import_module(
+                    f"mxnet_tpu.{modkey}" if modkey else "mxnet_tpu")
+            except Exception:
+                continue
+            obj = getattr(mod, attr, None)
+            if obj is None or isinstance(obj, _WitnessLock) or \
+                    not hasattr(obj, "acquire"):
+                continue
+            setattr(mod, attr, _WitnessLock(obj, lockid))
+            _wit_wrapped.append((mod, attr, obj))
+            wrapped += 1
+        _wit_armed = True
+    if register_atexit:
+        import atexit
+
+        atexit.register(_atexit_check)
+    return wrapped
+
+
+def untrace_locks():
+    """Disarm: restore every wrapped module attribute. Witnessed pairs
+    and the ring survive for inspection until :func:`reset_witness`."""
+    global _wit_armed
+    with _wit_lock:
+        for mod, attr, original in _wit_wrapped:
+            current = getattr(mod, attr, None)
+            if isinstance(current, _WitnessLock):
+                setattr(mod, attr, original)
+        restored = len(_wit_wrapped)
+        del _wit_wrapped[:]
+        _wit_armed = False
+    return restored
+
+
+def reset_witness():
+    """Drop witnessed pairs, the ring, and the last-inversion record
+    (call between chaos phases while threads are quiescent)."""
+    global _wit_ring, _wit_last_inversion
+    with _wit_lock:
+        _wit_pairs.clear()
+        _wit_ring = None
+        _wit_last_inversion = None
+
+
+def _inversions(static_edges=None):
+    found = []
+    with _wit_lock:
+        pairs = dict(_wit_pairs)
+    for (a, b), rec in sorted(pairs.items()):
+        rev = pairs.get((b, a))
+        if rev is not None and a < b:
+            found.append((
+                (a, b), rec, (b, a), rev,
+                "witnessed in both orders at runtime"))
+    if static_edges:
+        for (a, b), rec in sorted(pairs.items()):
+            fwd = static_edges.get(a, {})
+            if b in fwd:
+                continue                      # witnessed order matches
+            back = static_edges.get(b, {})
+            if a in back:
+                sa, sb, _via = back[a]
+                found.append((
+                    (a, b), rec, (b, a),
+                    {"sites": (sa, sb), "thread": "<static>", "t": 0},
+                    "inverts the statically established order"))
+    return found
+
+
+def check_witness(raise_=True, static=True):
+    """Cross-check witnessed acquisition order against itself and (by
+    default) the static graph. Returns the inversion list; with
+    ``raise_`` a non-empty list raises :class:`LockOrderError` naming
+    both acquisition sites and the witnessing thread."""
+    global _wit_last_inversion
+    static_edges = scan().edges if static else None
+    found = _inversions(static_edges)
+    if found:
+        (a, b), rec, (_b2, _a2), other, why = found[0]
+        msg = (f"lock-order inversion: {a} then {b} witnessed at "
+               f"{rec['sites'][0]} -> {rec['sites'][1]} "
+               f"[thread {rec['thread']}], but the opposite order "
+               f"{_b2} -> {_a2} holds at {other['sites'][0]} -> "
+               f"{other['sites'][1]} ({why})")
+        _wit_last_inversion = msg
+        if raise_:
+            raise LockOrderError(msg)
+    return found
+
+
+def _atexit_check():
+    try:
+        found = check_witness(raise_=False)
+        if found:
+            sys.stderr.write(
+                f"[concur] WARNING: {_wit_last_inversion}\n")
+    except Exception:
+        pass
+
+
+def witness_state():
+    """Witness status for diagnose: armed flag, wrapped-lock count,
+    witnessed ordered pairs, ring occupancy, last inversion."""
+    with _wit_lock:
+        return {
+            "armed": _wit_armed,
+            "wrapped": len(_wit_wrapped),
+            "pairs": len(_wit_pairs),
+            "ring": len([s for s in (_wit_ring._slots if _wit_ring
+                                     else ()) if s is not None]),
+            "last_inversion": _wit_last_inversion,
+        }
+
+
+def witness_tail(n=32):
+    """Last-N lock acquisitions/releases across all threads (crash
+    bundles embed this next to the flight tail)."""
+    out = []
+    if _wit_ring is None:
+        return out
+    for seq, t, ident, tname, lockname, op, site in _wit_ring.tail(n):
+        out.append({"seq": seq, "t": t, "thread_id": ident,
+                    "thread": tname, "lock": lockname, "op": op,
+                    "site": site})
+    return out
+
+
+# ====================================================================== #
+# Orchestrator                                                           #
+# ====================================================================== #
+
+def run_static(files=None, root=None, passes=("locks", "shared", "torn")):
+    """Passes 1-3 over an explicit file set (mxlint's entry point; no
+    env gate so the lint rules stay deterministic)."""
+    model = scan(root=root, files=files)
+    issues = []
+    if "locks" in passes:
+        issues += check_lock_order(model)
+    if "shared" in passes:
+        issues += check_shared_state(model)
+    if "torn" in passes:
+        issues += check_torn_files(model)
+    return issues
+
+
+def run(root=None, files=None, passes=None, witness=False,
+        raise_on_error=True):
+    """Run the analyzer; returns the combined Issue list.
+
+    ``analysis.concur(...)`` resolves here (the module is callable).
+    Default: passes 1-3 over the installed package; ``witness=True``
+    additionally cross-checks the armed runtime witness. Honours
+    ``MXNET_TPU_CONCUR=0`` (returns ``[]``)."""
+    if not enabled():
+        return []
+    issues = run_static(files=files, root=root,
+                        passes=passes or ("locks", "shared", "torn"))
+    if witness:
+        for (a, b), rec, rev_key, other, why in check_witness(raise_=False):
+            issues.append(Issue(
+                "error", "lock-order-witnessed", rec["sites"][1], "",
+                f"witnessed inversion: {a} -> {b} at {rec['sites'][0]} "
+                f"-> {rec['sites'][1]} [thread {rec['thread']}] {why}; "
+                f"opposite order at {other['sites'][0]} -> "
+                f"{other['sites'][1]}"))
+    if raise_on_error:
+        return _raise_if_errors(issues)
+    return issues
+
+
+class _CallableModule(types.ModuleType):
+    """``analysis.concur(...)`` — the module is its own entry point.
+    ``ConcurError`` materialises on first access (verify.py stays off
+    the import path; standalone loads fall back to RuntimeError)."""
+
+    def __call__(self, *args, **kwargs):
+        return run(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name == "ConcurError":
+            cls = _realise_error_class()
+            self.ConcurError = cls
+            return cls
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+
+
+_self = sys.modules.get(__name__)
+if _self is not None:
+    _self.__class__ = _CallableModule

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import warnings
 import zlib
@@ -104,7 +105,11 @@ def atomic_write(path, writer):
     """
     _faults.point("ckpt.write")
     path = os.fspath(path)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid alone is not unique enough: the serving batcher / watchdog /
+    # heartbeat threads can atomic-write the same path concurrently with
+    # the main thread, and the loser's os.replace dies with
+    # FileNotFoundError on the shared tmp name
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     try:
         writer(tmp)
         # writer implementations (np.savez, json.dump, symbol.save) don't

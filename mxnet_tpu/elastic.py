@@ -161,7 +161,11 @@ def _atomic_json(path, obj):
     """tmp + os.replace JSON write. Deliberately NOT checkpoint.atomic_write:
     gang state must stay recordable even while the ``ckpt.write`` fault
     point is armed — the supervisor records *other* processes' failures."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid alone is not unique enough: the heartbeat daemon and a
+    # main-thread beat/announce can race on the same tmp name, and the
+    # loser's os.replace dies with FileNotFoundError (worker exit 1) —
+    # the same collision telemetry/fleet._atomic_json fixed in PR 16
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=1, sort_keys=True, default=repr)
         f.flush()
@@ -365,8 +369,10 @@ def maybe_install_from_env():
     gen = _env_int("MXTPU_GANG_GENERATION", 1)
     start_heartbeat(run_dir, rank, gen)
     install_excepthook()
-    GANG_STATS["state"] = "worker"
-    GANG_STATS["generation"] = gen
+    # single-key dict stores, GIL-atomic; the supervisor's monitor-thread
+    # writers live in a *different process* than this worker-side arm
+    GANG_STATS["state"] = "worker"      # concur: atomic
+    GANG_STATS["generation"] = gen      # concur: atomic
     return True
 
 
@@ -749,6 +755,16 @@ class GangSupervisor:
               "crash_bundles": _list_bundles(self.crash_dir),
               "drain_events": drains,
               "supervisor_flight_tail": _flight.tail(64)}
+        try:
+            # the lock witness tail rides next to the flight tail: when
+            # the run died wedged with MXNET_TPU_CONCUR_TRACE armed, the
+            # post-mortem names the locks involved (analysis/concur)
+            from .analysis import concur as _concur
+
+            pm["witness_state"] = _concur.witness_state()
+            pm["witness_tail"] = _concur.witness_tail()
+        except Exception:
+            pass
         stamp = time.strftime("%Y%m%d-%H%M%S")
         path = os.path.join(self.run_dir,
                             f"postmortem-{stamp}-p{os.getpid()}.json")
@@ -832,7 +848,9 @@ class GangSupervisor:
         Installs SIGTERM/SIGINT handlers when on the main thread: the
         first signal drains the gang gracefully, a second skips the
         grace."""
-        GANG_STATS["state"] = self.state
+        # single-key store, GIL-atomic against the monitor thread's
+        # equally-atomic _set_state stores; readers only snapshot
+        GANG_STATS["state"] = self.state    # concur: atomic
 
         def _on_signal(signum, frame):
             self._stop_signals += 1

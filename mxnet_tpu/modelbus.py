@@ -484,7 +484,10 @@ class ModelBus:
         """``(manifest, payload bytes)`` for one version; `verify`
         checks size+CRC against the manifest (ValueError on mismatch)."""
         with open(self.manifest_path(version)) as f:
-            manifest = json.load(f)
+            # manifests are atomic_write-published and immutable per
+            # version; a vanished (rotated) file raises OSError to the
+            # caller by contract, never a torn parse
+            manifest = json.load(f)  # concur: torn-ok
         with open(self.payload_path(version), "rb") as f:
             blob = f.read()
         if verify and (len(blob) != manifest["size"] or

@@ -460,6 +460,8 @@ def _index_copy(old, index, new_tensor):
 
 @register("_contrib_arange_like")
 def _contrib_arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """parity: contrib/arange_like — arange shaped like `data` along
+    `axis` (flat when None), each value emitted `repeat` times."""
     n = data.shape[axis] if axis is not None else data.size
     # each value emitted `repeat` times (parity: arange_like contract)
     return start + step * (jnp.arange(n) // max(int(repeat), 1)) \
@@ -520,6 +522,8 @@ def _split_interleaved(qkv, heads, parts):
 
 @register("_contrib_interleaved_matmul_selfatt_qk")
 def _interleaved_selfatt_qk(queries_keys_values, heads=1):
+    """parity: contrib/transformer.cc — scaled q@k^T attention scores
+    from an interleaved qkv projection, flattened to (b*h, q, k)."""
     q, k, _ = _split_interleaved(queries_keys_values, heads, 3)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     att = jnp.einsum("qbhd,kbhd->bhqk", q * scale, k)
@@ -529,6 +533,8 @@ def _interleaved_selfatt_qk(queries_keys_values, heads=1):
 
 @register("_contrib_interleaved_matmul_selfatt_valatt")
 def _interleaved_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """parity: contrib/transformer.cc — attention-weighted values from
+    the interleaved qkv projection, back to (seq, batch, h*d)."""
     _, _, v = _split_interleaved(queries_keys_values, heads, 3)
     s, b, h, d = v.shape
     att = attention.reshape(b, h, s, s)
@@ -538,6 +544,8 @@ def _interleaved_selfatt_valatt(queries_keys_values, attention, heads=1):
 
 @register("_contrib_interleaved_matmul_encdec_qk")
 def _interleaved_encdec_qk(queries, keys_values, heads=1):
+    """parity: contrib/transformer.cc — encoder-decoder q@k^T scores
+    (separate queries, interleaved kv), flattened to (b*h, q, k)."""
     qs, b, proj = queries.shape
     d = proj // heads
     q = queries.reshape(qs, b, heads, d)
@@ -550,6 +558,8 @@ def _interleaved_encdec_qk(queries, keys_values, heads=1):
 
 @register("_contrib_interleaved_matmul_encdec_valatt")
 def _interleaved_encdec_valatt(keys_values, attention, heads=1):
+    """parity: contrib/transformer.cc — attention-weighted values from
+    the interleaved kv projection, back to (q_seq, batch, h*d)."""
     _, v = _split_interleaved(keys_values, heads, 2)
     ks, b, h, d = v.shape
     qs = attention.shape[1]
@@ -657,6 +667,8 @@ def _quadratic(data, a=0.0, b=0.0, c=0.0):
 
 @register("_contrib_allclose", differentiable=False)
 def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """parity: contrib/allclose_op.cc — 1.0 when `a` and `b` agree
+    elementwise within rtol/atol (the test-suite comparison op)."""
     return jnp.allclose(a, b, rtol=rtol, atol=atol,
                         equal_nan=equal_nan).astype(jnp.float32)
 

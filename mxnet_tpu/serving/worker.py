@@ -47,6 +47,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 from .. import log as _log
@@ -82,9 +83,14 @@ def write_spec(model_dir, models):
     """Write ``serving.json`` under `model_dir`; returns its path."""
     os.makedirs(os.fspath(model_dir), exist_ok=True)
     path = os.path.join(os.fspath(model_dir), SPEC_FILE)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid+thread-ident tmp name + fsync: a rollout test thread and the
+    # main thread may author the same spec concurrently, and a power cut
+    # must never publish a half-written spec under os.replace
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         json.dump({"models": list(models)}, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 

@@ -82,7 +82,10 @@ def rec(kind, point="", label=None):
     slot[4] = point
     slot[5] = label
     slot[0] = i   # publish
-    _counts[kind] = _counts.get(kind, 0) + 1
+    # lossy-tolerable totals: a racing increment may drop one count, the
+    # ring itself is exact (seq-claimed slots) — not worth a lock on the
+    # every-event hot path
+    _counts[kind] = _counts.get(kind, 0) + 1  # concur: atomic
 
 
 def tail(n=None):

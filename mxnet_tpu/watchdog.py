@@ -400,6 +400,18 @@ def _write_bundle(span):
         _flight.rec("watchdog.stall", span.point, span.label)
         with open(os.path.join(path, "flight.json"), "w") as f:
             json.dump(_flight.tail(), f, indent=1, default=repr)
+        # the lock witness (analysis/concur pass 4), when armed: the
+        # last-N lock acquisitions + any order inversion it saw — a
+        # stall that is really a deadlock names both locks right here
+        try:
+            from .analysis import concur as _concur
+
+            with open(os.path.join(path, "witness.json"), "w") as f:
+                json.dump({"state": _concur.witness_state(),
+                           "tail": _concur.witness_tail()},
+                          f, indent=1, default=repr)
+        except Exception:
+            pass
         span.bundle = path
         _logger.error("watchdog: %r (%s) stalled %.1fs >= deadline %gs; "
                       "crash bundle written to %s", span.point,

@@ -1,0 +1,542 @@
+"""Concurrency analyzer (analysis.concur) tests.
+
+One planted bug per pass: an AB/BA lock cycle (pass 1), an unlocked
+cross-thread dict write (pass 2), a raw ``open(..., "w")`` shard writer
+plus a pid-only tmp name plus an unguarded ``json.load`` (pass 3), and a
+runtime acquisition-order inversion caught by the witness (pass 4) —
+each reported with the exact file:line site.  Plus the knob
+(``MXNET_TPU_CONCUR=0``), the suppression grammar, the mxlint rule
+bridge with its ratcheted baseline, the whole-package clean scan, and
+regression tests for the pid+thread tmp-name fixes the analyzer found
+in ``checkpoint.atomic_write`` / ``elastic._atomic_json`` /
+``serving.worker.write_spec``.
+"""
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from mxnet_tpu.analysis import concur
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_witness():
+    """Every test starts and ends with the witness disarmed + empty."""
+    concur.untrace_locks()
+    concur.reset_witness()
+    yield
+    concur.untrace_locks()
+    concur.reset_witness()
+
+
+# ------------------------------------------------- pass 1: lock order --
+
+DEADLOCK_SRC = """\
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def backward():
+    with LOCK_B:
+        with LOCK_A:
+            pass
+"""
+
+
+def test_lock_order_cycle_is_site_named(tmp_path):
+    f = tmp_path / "planted.py"
+    f.write_text(DEADLOCK_SRC)
+    issues = concur.check_lock_order(root=str(tmp_path), files=[str(f)])
+    errs = [i for i in issues if i.code == "lock-order-cycle"]
+    assert errs and all(i.is_error for i in errs)
+    # both acquisition sites are named: AB nests at line 9, BA at 15
+    blob = " ".join(i.message + " " + i.node for i in errs)
+    assert "planted.py:9" in blob and "planted.py:15" in blob
+    assert "LOCK_A" in blob and "LOCK_B" in blob
+
+
+def test_consistent_order_is_clean(tmp_path):
+    f = tmp_path / "ok.py"
+    f.write_text(DEADLOCK_SRC.replace(
+        "    with LOCK_B:\n        with LOCK_A:",
+        "    with LOCK_A:\n        with LOCK_B:"))
+    assert concur.check_lock_order(root=str(tmp_path),
+                                   files=[str(f)]) == []
+
+
+# ---------------------------------------------- pass 2: shared state --
+
+SHARED_SRC = """\
+import threading
+
+STATS = {}
+LOCK = threading.Lock()
+
+
+def worker():
+    STATS["beats"] = STATS.get("beats", 0) + 1
+
+
+def start():
+    t = threading.Thread(target=worker)
+    t.start()
+    STATS["started"] = 1
+"""
+
+
+def test_unlocked_cross_thread_write_is_flagged(tmp_path):
+    f = tmp_path / "shared.py"
+    f.write_text(SHARED_SRC)
+    issues = concur.check_shared_state(root=str(tmp_path),
+                                       files=[str(f)])
+    hits = [i for i in issues if i.code == "unlocked-shared-state"]
+    assert hits, issues
+    sites = {i.node for i in hits}
+    # the thread-reachable write (line 8) and/or the main write (14):
+    # at least one is named, and the message names STATS
+    assert sites & {"shared.py:8", "shared.py:14"}, sites
+    assert any("STATS" in i.message for i in hits)
+
+
+def test_shared_state_lock_and_suppression(tmp_path):
+    # the same write under the common lock is clean
+    locked = SHARED_SRC.replace(
+        '    STATS["beats"] = STATS.get("beats", 0) + 1',
+        '    with LOCK:\n'
+        '        STATS["beats"] = STATS.get("beats", 0) + 1').replace(
+        '    STATS["started"] = 1',
+        '    with LOCK:\n        STATS["started"] = 1')
+    f = tmp_path / "locked.py"
+    f.write_text(locked)
+    assert concur.check_shared_state(root=str(tmp_path),
+                                     files=[str(f)]) == []
+    # ...and the explicit marker suppresses (must terminate the line)
+    suppressed = SHARED_SRC.replace(
+        '    STATS["beats"] = STATS.get("beats", 0) + 1',
+        '    STATS["beats"] = STATS.get("beats", 0) + 1'
+        '  # concur: atomic').replace(
+        '    STATS["started"] = 1',
+        '    STATS["started"] = 1  # concur: atomic')
+    g = tmp_path / "suppressed.py"
+    g.write_text(suppressed)
+    assert concur.check_shared_state(root=str(tmp_path),
+                                     files=[str(g)]) == []
+
+
+# ------------------------------------------------ pass 3: torn files --
+
+TORN_SRC = """\
+import json
+import os
+
+
+def write_shard(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def read_shard(path):
+    with open(path) as f:
+        return json.load(f)
+"""
+
+
+def test_raw_writer_and_unguarded_reader_flagged(tmp_path):
+    f = tmp_path / "torn.py"
+    f.write_text(TORN_SRC)
+    issues = concur.check_torn_files(root=str(tmp_path), files=[str(f)])
+    codes = {i.code for i in issues}
+    assert "torn-file-write" in codes and "torn-read" in codes
+    write = [i for i in issues if i.code == "torn-file-write"][0]
+    read = [i for i in issues if i.code == "torn-read"][0]
+    assert write.node == "torn.py:6"
+    assert read.node == "torn.py:12"
+
+
+def test_torn_tmp_name_must_embed_pid_and_thread(tmp_path):
+    f = tmp_path / "seam.py"
+    f.write_text(
+        "import json\n"
+        "import os\n"
+        "\n"
+        "\n"
+        "def atomic_write(path, obj):\n"
+        "    tmp = f\"{path}.tmp.{os.getpid()}\"\n"
+        "    with open(tmp, 'w') as fh:\n"
+        "        json.dump(obj, fh)\n"
+        "    os.replace(tmp, path)\n")
+    concur.register_seam("seam", "atomic_write", "test seam")
+    try:
+        issues = concur.check_torn_files(root=str(tmp_path),
+                                         files=[str(f)])
+        tmp_issues = [i for i in issues if i.code == "torn-tmp-name"]
+        assert tmp_issues, issues
+        assert "thread" in tmp_issues[0].message
+        # pid+thread-ident tmp name passes
+        f.write_text(f.read_text().replace(
+            "{os.getpid()}", "{os.getpid()}.{threading.get_ident()}")
+            .replace("import os\n", "import os\nimport threading\n"))
+        issues = concur.check_torn_files(root=str(tmp_path),
+                                         files=[str(f)])
+        assert [i for i in issues if i.code == "torn-tmp-name"] == []
+    finally:
+        concur.TORN_SEAMS.pop(("seam", "atomic_write"), None)
+
+
+def test_torn_ok_suppression(tmp_path):
+    f = tmp_path / "torn.py"
+    f.write_text(TORN_SRC.replace(
+        '    with open(path, "w") as f:',
+        '    with open(path, "w") as f:  # concur: torn-ok').replace(
+        "        json.dump(obj, f)",
+        "        json.dump(obj, f)  # concur: torn-ok").replace(
+        "        return json.load(f)",
+        "        return json.load(f)  # concur: torn-ok"))
+    assert concur.check_torn_files(root=str(tmp_path),
+                                   files=[str(f)]) == []
+
+
+def test_guarded_reader_is_clean(tmp_path):
+    f = tmp_path / "guarded.py"
+    f.write_text(
+        "import json\n"
+        "\n"
+        "\n"
+        "def read_shard(path):\n"
+        "    try:\n"
+        "        with open(path) as f:\n"
+        "            return json.load(f)\n"
+        "    except (OSError, ValueError):\n"
+        "        return None\n")
+    assert [i for i in concur.check_torn_files(root=str(tmp_path),
+                                               files=[str(f)])
+            if i.code == "torn-read"] == []
+
+
+# -------------------------------------------------- pass 4: witness --
+
+def test_witness_catches_runtime_inversion():
+    a = concur.wrap(threading.Lock(), "test.A")
+    b = concur.wrap(threading.Lock(), "test.B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    t = threading.Thread(target=ba)
+    t.start()
+    t.join()
+    with pytest.raises(concur.LockOrderError) as ei:
+        concur.check_witness(static=False)
+    msg = str(ei.value)
+    assert "test.A" in msg and "test.B" in msg
+    # both witnessing sites are named (this file)
+    assert msg.count("test_concur.py:") >= 2
+    # non-raising form returns the inversion for tooling
+    assert concur.check_witness(raise_=False, static=False)
+    assert concur.witness_state()["last_inversion"]
+
+
+def test_witness_consistent_order_is_clean():
+    a = concur.wrap(threading.Lock(), "test.A")
+    b = concur.wrap(threading.Lock(), "test.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert concur.check_witness(static=False) == []
+    st = concur.witness_state()
+    assert st["pairs"] == 1 and st["ring"] >= 6
+
+
+def test_witness_delegates_lock_api():
+    lk = concur.wrap(threading.Lock(), "test.delegate")
+    assert lk.acquire(timeout=1.0)
+    assert lk.locked()
+    lk.release()
+    cond = concur.wrap(threading.Condition(), "test.cond")
+    with cond:
+        cond.notify_all()  # Condition API reachable through the wrapper
+
+
+def test_trace_locks_wraps_and_restores_package_locks():
+    n = concur.trace_locks()
+    assert n >= 10  # the package's module-level control-plane locks
+    from mxnet_tpu import faults
+
+    # wrapped attribute is a witness, and survives a real acquire
+    assert isinstance(faults._lock, concur._WitnessLock)
+    with faults._lock:
+        pass
+    assert concur.witness_state()["armed"]
+    assert concur.witness_state()["ring"] >= 1
+    # arming twice is a no-op
+    assert concur.trace_locks() == 0
+    restored = concur.untrace_locks()
+    assert restored == n
+    assert not isinstance(faults._lock, concur._WitnessLock)
+
+
+def test_witness_clean_under_serving_and_modelbus(tmp_path):
+    """The integration bar: threaded serving + live-weight streaming
+    run with every module-level lock witnessed — zero inversions."""
+    import numpy as np
+
+    from mxnet_tpu import gluon, modelbus, serving
+
+    n = concur.trace_locks()
+    assert n
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    container = serving.ModelContainer()
+    container.add_block("wit", net, example_shape=(8,), buckets=(2,))
+    server = serving.ModelServer(container, max_wait_ms=1.0).start()
+    try:
+        bus = modelbus.ModelBus(str(tmp_path / "bus"))
+        bus.publish([(k, p.data().asnumpy())
+                     for k, p in net.collect_params().items()], step=1)
+        watcher = server.watch_bus(bus, poll=0.01)
+        errors = []
+
+        def client(tid):
+            rng = np.random.RandomState(tid)
+            for _ in range(5):
+                try:
+                    server.predict("wit",
+                                   rng.randn(1, 8).astype(np.float32),
+                                   timeout=10.0)
+                except Exception as e:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        deadline = 200
+        while watcher.applied_version < 1 and deadline:
+            deadline -= 1
+            threading.Event().wait(0.01)
+        assert not errors, errors[:3]
+        assert concur.check_witness(raise_=False) == []
+        assert concur.witness_state()["ring"] > 0
+    finally:
+        server.drain(timeout=10.0)
+
+
+# --------------------------------------------------- knob + package --
+
+def test_env_opt_out(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CONCUR", "0")
+    assert not concur.enabled()
+    assert concur.run() == []
+    assert concur.trace_locks() == 0
+    assert concur.witness_state()["armed"] is False
+    monkeypatch.setenv("MXNET_TPU_CONCUR", "1")
+    assert concur.enabled()
+
+
+def test_package_scans_clean():
+    """The ratchet: the installed package carries zero concurrency
+    findings — new lock cycles, unlocked shared writes or raw tmp-file
+    protocols fail here with the site in the message."""
+    issues = concur.run_static()
+    assert issues == [], [f"[{i.code}] {i.node}: {i.message}"
+                          for i in issues]
+
+
+def test_callable_module_and_error_class():
+    from mxnet_tpu import analysis
+
+    assert analysis.concur() == []  # callable, clean package
+    # ConcurError realises once (lazily) and carries .issues
+    cls = concur.ConcurError
+    assert cls is concur.ConcurError and issubclass(cls, Exception)
+    err = cls([concur.Issue("error", "lock-order-cycle", "x.py:1",
+                            "f", "planted")])
+    assert err.issues and err.issues[0].is_error
+
+
+def test_suppression_marker_must_terminate_line(tmp_path):
+    # a marker that does NOT end the line is not a suppression: the
+    # same markers that silence the finding in
+    # test_shared_state_lock_and_suppression stop working with trailing
+    # prose appended
+    f = tmp_path / "mid.py"
+    f.write_text(SHARED_SRC.replace(
+        '    STATS["beats"] = STATS.get("beats", 0) + 1',
+        '    STATS["beats"] = STATS.get("beats", 0) + 1'
+        '  # concur: atomic (prose)').replace(
+        '    STATS["started"] = 1',
+        '    STATS["started"] = 1  # concur: atomic (prose)'))
+    issues = concur.check_shared_state(root=str(tmp_path),
+                                       files=[str(f)])
+    assert any(i.code == "unlocked-shared-state" for i in issues), issues
+
+
+# ----------------------------------------------------- real-fix regressions
+
+def _hammer(write, path, payloads, rounds=25):
+    """Two threads write the same final path concurrently; pre-fix the
+    pid-only tmp name collided and the loser's os.replace raised
+    FileNotFoundError."""
+    errors = []
+
+    def worker(payload):
+        for _ in range(rounds):
+            try:
+                write(path, payload)
+            except FileNotFoundError as e:  # the PR-16-class bug
+                errors.append(e)
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in payloads]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    return errors
+
+
+def test_atomic_write_concurrent_same_path(tmp_path):
+    from mxnet_tpu import checkpoint
+
+    path = str(tmp_path / "spec.json")
+
+    def write(p, payload):
+        def _w(tmp):
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+        checkpoint.atomic_write(p, _w)
+
+    errors = _hammer(write, path, [{"v": 1}, {"v": 2}])
+    assert errors == []
+    with open(path) as f:
+        assert json.load(f)["v"] in (1, 2)
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+def test_elastic_atomic_json_concurrent_same_path(tmp_path):
+    from mxnet_tpu.elastic import _atomic_json
+
+    path = str(tmp_path / "heartbeat.json")
+    errors = _hammer(_atomic_json, path, [{"rank": 0}, {"rank": 1}])
+    assert errors == []
+    with open(path) as f:
+        assert json.load(f)["rank"] in (0, 1)
+
+
+def test_worker_write_spec_concurrent_same_path(tmp_path):
+    from mxnet_tpu.serving import worker
+
+    errors = _hammer(lambda d, models: worker.write_spec(d, models),
+                     str(tmp_path), [[{"name": "a"}], [{"name": "b"}]])
+    assert errors == []
+    with open(tmp_path / worker.SPEC_FILE) as f:
+        assert json.load(f)["models"][0]["name"] in ("a", "b")
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+# ------------------------------------------------------- mxlint bridge --
+
+@pytest.mark.lint
+def test_mxlint_concurrency_rules_fire(tmp_path):
+    import mxlint
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        DEADLOCK_SRC
+        + "\nSTATE = {}\n"
+        "\n"
+        "\n"
+        "def spawn():\n"
+        "    t = threading.Thread(target=poke)\n"
+        "    t.start()\n"
+        "    STATE['x'] = 1\n"
+        "\n"
+        "\n"
+        "def poke():\n"
+        "    STATE['y'] = 2\n"
+        "\n"
+        "\n"
+        "def dump(path, obj):\n"
+        "    import json\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(obj, f)\n")
+    rules = {f.rule for f in mxlint.run([str(bad)], root=str(tmp_path))}
+    assert {"lock-order", "shared-state", "torn-file"} <= rules
+    # per-rule noqa works through the bridge
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import json\n"
+        "\n"
+        "\n"
+        "def dump(path, obj):\n"
+        "    with open(path, 'w') as f:  # noqa: torn-file\n"
+        "        json.dump(obj, f)  # noqa: torn-file\n")
+    assert [f for f in mxlint.run([str(ok)], root=str(tmp_path))
+            if f.rule == "torn-file"] == []
+
+
+@pytest.mark.lint
+def test_mxlint_concurrency_baseline_ratchet(tmp_path):
+    """Baseline semantics for the new rules: tolerated legacy findings
+    pass, one extra torn-file write fails the gate."""
+    import mxlint
+
+    f = tmp_path / "m.py"
+    f.write_text("import json\n"
+                 "\n"
+                 "\n"
+                 "def dump(path, obj):\n"
+                 "    with open(path, 'w') as fh:\n"
+                 "        json.dump(obj, fh)\n")
+    base = tmp_path / "base.txt"
+    findings = [x for x in mxlint.run([str(f)], root=str(tmp_path))
+                if x.rule == "torn-file"]
+    assert findings
+    base.write_text(f"torn-file m.py {len(findings)}  # legacy writer\n")
+    assert mxlint.main([str(f), "--root", str(tmp_path),
+                        "--baseline", str(base),
+                        "--rule", "torn-file"]) == 0
+    f.write_text(f.read_text()
+                 + "\n\ndef dump2(path, obj):\n"
+                 "    with open(path, 'w') as fh:\n"
+                 "        json.dump(obj, fh)\n")
+    assert mxlint.main([str(f), "--root", str(tmp_path),
+                        "--baseline", str(base),
+                        "--rule", "torn-file"]) == 1
+
+
+@pytest.mark.lint
+def test_diagnose_concurrency_section():
+    import diagnose
+
+    out = diagnose.check_concur()
+    assert out["enabled"] is True
+    assert out["graph"]["locks"] >= 10
+    assert out["findings"] == []
+    assert out["witness"]["armed"] is False
+    assert len(out["torn_seams"]) >= 10

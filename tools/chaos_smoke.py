@@ -730,6 +730,112 @@ def modelbus_drill(root=None, seed=0):
     return 0
 
 
+def witness_drill(root=None, seed=0):
+    """Phase 15: the runtime lock witness — re-run a compact composite
+    of the earlier drills (a fit with an injected fault, threaded
+    serving load, live weight streaming over the bus) with every
+    module-level lock in the package wrapped by ``analysis.concur``'s
+    witness, then cross-check the recorded per-thread acquisition
+    orders against themselves and the static lock graph: zero
+    inversions."""
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import faults, serving
+    from mxnet_tpu.analysis import concur
+
+    faults.reset()
+    wrapped = concur.trace_locks()
+    if not wrapped:
+        print("FAIL: witness drill armed zero locks "
+              "(MXNET_TPU_CONCUR=0 or already armed?)")
+        return 1
+    try:
+        net, trainer = build(seed + 15)
+        # phase 1 in miniature: one NaN batch for the guard to absorb
+        # while the engine/telemetry locks are witnessed
+        faults.configure("trainer.step:nan@2", seed=seed)
+        for s in range(4):
+            x, y = batch_for(15, s, seed)
+            trainer.step(x, y)
+        faults.reset()
+
+        # phases 6 + 14 in miniature: threaded serving load while the
+        # trainer streams weight versions through the bus
+        container = serving.ModelContainer()
+        container.add_block("chaos_wit", net, example_shape=(8,),
+                            buckets=(2, 4))
+        server = serving.ModelServer(container, max_wait_ms=1.0).start()
+        server.warmup()
+        root = root or tempfile.mkdtemp(prefix="chaos_wit_")
+        bus = trainer.publish_to(os.path.join(root, "bus"), every=2)
+        watcher = server.watch_bus(bus, poll=0.02)
+
+        stop = threading.Event()
+        errors = []
+
+        def load_worker(tid):
+            rng = np.random.RandomState(tid)
+            while not stop.is_set():
+                try:
+                    server.predict(
+                        "chaos_wit",
+                        rng.randn(1 + tid % 2, 8).astype(np.float32),
+                        timeout=10.0)
+                except serving.ServerBusyError:
+                    pass
+                except Exception as e:
+                    errors.append(f"{type(e).__name__}: {e}")
+                time.sleep(0.003)
+
+        threads = [threading.Thread(target=load_worker, args=(t,),
+                                    daemon=True) for t in range(2)]
+        for t in threads:
+            t.start()
+        for s in range(4):
+            x, y = batch_for(16, s, seed)
+            trainer.step(x, y)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and watcher.applied_version < 2:
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        server.drain(timeout=10.0)
+        if errors:
+            print(f"FAIL: witness drill dropped {len(errors)} "
+                  f"request(s): {errors[:3]}")
+            return 1
+        if watcher.applied_version < 2:
+            print(f"FAIL: witness drill never streamed weights: "
+                  f"{watcher.stats()}")
+            return 1
+
+        inversions = concur.check_witness(raise_=False)
+        state = concur.witness_state()
+        if inversions:
+            print("FAIL: the lock witness saw order inversions:")
+            for _pair, rec, _rev, other, why in inversions[:3]:
+                print(f"  {rec['sites'][0]} -> {rec['sites'][1]} vs "
+                      f"{other['sites'][0]} -> {other['sites'][1]} "
+                      f"({why})")
+            return 1
+        if not state["ring"]:
+            print("FAIL: the armed witness recorded zero acquisitions "
+                  "over the whole composite (dead wrappers?)")
+            return 1
+        print(f"  lock witness clean: {wrapped} locks wrapped, "
+              f"{state['ring']} acquisitions in the ring, "
+              f"{state['pairs']} nested ordered pairs witnessed across "
+              f"the fit/serve/bus composite, 0 inversions")
+        return 0
+    finally:
+        faults.reset()
+        concur.untrace_locks()
+        concur.reset_witness()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--epochs", type=int, default=2)
@@ -761,6 +867,10 @@ def main(argv=None):
                         help="skip the phase-14 live-weight-streaming "
                              "drill (in-process trainer -> bus -> "
                              "server with poison + rollback)")
+    parser.add_argument("--skip-witness-drill", action="store_true",
+                        help="skip the phase-15 lock-witness drill "
+                             "(in-process fit/serve/bus composite with "
+                             "analysis.concur's runtime witness armed)")
     args = parser.parse_args(argv)
 
     if args.serve_drill:
@@ -1388,6 +1498,16 @@ def main(argv=None):
     if not args.skip_modelbus_drill:
         rc = modelbus_drill(root=os.path.join(ckpt_dir, "bus"),
                             seed=args.seed)
+        if rc:
+            return rc
+
+    # phase 15: the lock witness — the fit/serve/bus composite again,
+    # this time with every module-level lock wrapped by the concurrency
+    # analyzer's runtime witness; the recorded acquisition orders must
+    # show zero inversions against each other and the static lock graph
+    if not args.skip_witness_drill:
+        rc = witness_drill(root=os.path.join(ckpt_dir, "witness"),
+                           seed=args.seed)
         if rc:
             return rc
 

@@ -152,6 +152,63 @@ def check_analysis():
     return out
 
 
+def check_concur():
+    """Concurrency analyzer (docs/ANALYSIS.md "Concurrency checks"):
+    the static lock-graph census over the package (locks, ordered
+    edges, current findings), the suppression counts, the torn-file
+    seam registry, and the runtime lock witness state including the
+    last inversion it saw."""
+    _p("---------Concurrency-----------")
+    out = {"MXNET_TPU_CONCUR": os.environ.get("MXNET_TPU_CONCUR"),
+           "MXNET_TPU_CONCUR_TRACE":
+               os.environ.get("MXNET_TPU_CONCUR_TRACE")}
+    _p(f"MXNET_TPU_CONCUR={out['MXNET_TPU_CONCUR'] or '<unset>'}  "
+       "(lock-order / shared-state / torn-file passes; on unless 0)")
+    _p(f"MXNET_TPU_CONCUR_TRACE={out['MXNET_TPU_CONCUR_TRACE'] or '<unset>'}"
+       "  (arm the runtime lock witness at import; off unless 1)")
+    try:
+        from mxnet_tpu.analysis import concur
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("concur import failed:", e)
+        return out
+    out["enabled"] = concur.enabled()
+    if not concur.enabled():
+        _p("analyzer      : disabled (MXNET_TPU_CONCUR=0)")
+        return out
+    model = concur.scan()
+    edges = sum(len(v) for v in model.edges.values())
+    issues = concur.run_static()
+    out["graph"] = {"files": len(model.files),
+                    "locks": len(model.locks), "edges": edges}
+    out["suppressions"] = dict(model.suppressions)
+    out["findings"] = [f"[{i.severity}:{i.code}] {i.node}"
+                       for i in issues]
+    _p(f"lock graph    : {len(model.locks)} locks across "
+       f"{len(model.files)} modules, {edges} ordered edges")
+    _p(f"findings      : {len(issues)} "
+       f"({sum(1 for i in issues if i.is_error)} errors) — "
+       f"{out['findings'][:5] or 'clean'}")
+    _p(f"suppressions  : {model.suppressions['atomic']} "
+       f"'# concur: atomic', {model.suppressions['torn']} "
+       f"'# concur: torn-ok'")
+    out["torn_seams"] = sorted(
+        f"{mk}.{qn}" if mk else qn for mk, qn in concur.TORN_SEAMS)
+    _p(f"torn-file seams: {len(out['torn_seams'])} registered atomic "
+       "writers (concur.TORN_SEAMS)")
+    wit = concur.witness_state()
+    out["witness"] = wit
+    if wit["armed"]:
+        _p(f"lock witness  : ARMED — {wit['wrapped']} locks wrapped, "
+           f"{wit['ring']} acquisitions in the ring, "
+           f"{wit['pairs']} ordered pairs")
+    else:
+        _p("lock witness  : disarmed (concur.trace_locks() or "
+           "MXNET_TPU_CONCUR_TRACE=1 to arm)")
+    _p(f"last inversion: {wit['last_inversion'] or 'none'}")
+    return out
+
+
 def check_compile_cache(gc=False):
     """Compile-cache health: the unified compile service's per-site
     hit/miss/compile-ms stats (mxnet_tpu.compile), the persistent on-disk
@@ -999,6 +1056,7 @@ SECTIONS = (
     ("hardware", check_hardware),
     ("environment", check_environment),
     ("analysis", check_analysis),
+    ("concurrency", check_concur),
     ("compile_cache", check_compile_cache),
     ("serving", check_serving),
     ("serving_fleet", check_fleet),

@@ -108,7 +108,18 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 RULES = ("bare-except", "host-sync", "raw-jax-compat", "raw-jit",
          "unseeded-random", "no-schema-doc", "unused-import",
          "mutable-default", "unbounded-sync", "partition-spec-literal",
-         "serving-blocking-call", "print-call", "raw-pallas-call")
+         "serving-blocking-call", "print-call", "raw-pallas-call",
+         "lock-order", "shared-state", "torn-file")
+
+# the three concurrency rules delegate to the analyzer's static passes
+# (analysis/concur.py, loaded standalone so linting stays jax-free)
+_CONCUR_RULEMAP = {
+    "lock-order-cycle": "lock-order",
+    "unlocked-shared-state": "shared-state",
+    "torn-file-write": "torn-file",
+    "torn-tmp-name": "torn-file",
+    "torn-read": "torn-file",
+}
 
 # serving/ blocking-call vocabulary: device syncs (flagged regardless of
 # arguments) and waits that are unbounded only in their zero-arg form
@@ -511,14 +522,70 @@ def iter_py_files(targets, root):
                     yield os.path.join(dirpath, fn)
 
 
+_concur_mod = None
+
+
+def _load_concur():
+    """The concurrency analyzer, loaded standalone by file path: its
+    static passes are stdlib-only, so linting never imports the jax-heavy
+    package."""
+    global _concur_mod
+    if _concur_mod is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "mxnet_tpu", "analysis", "concur.py")
+        spec = importlib.util.spec_from_file_location("_mxlint_concur",
+                                                      path)
+        _concur_mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_concur_mod)
+    return _concur_mod
+
+
+def _concur_findings(paths, root):
+    """Concurrency passes 1-3 over the lint target set, mapped to the
+    lock-order / shared-state / torn-file rules (honouring `# noqa`)."""
+    try:
+        concur = _load_concur()
+    except (OSError, ImportError):
+        return []
+    findings = []
+    line_cache = {}
+    for issue in concur.run_static(files=list(paths), root=root):
+        rule = _CONCUR_RULEMAP.get(issue.code)
+        if rule is None:
+            continue
+        rel, _, line_s = issue.node.rpartition(":")
+        line = int(line_s) if line_s.isdigit() else 1
+        if rel not in line_cache:
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as f:
+                    line_cache[rel] = f.read().splitlines()
+            except (OSError, UnicodeDecodeError):
+                line_cache[rel] = []
+        lines = line_cache[rel]
+        text = lines[line - 1] if line <= len(lines) else ""
+        if "# noqa" in text:
+            tail = text.split("# noqa", 1)[1]
+            if not tail.startswith(":") or rule in tail:
+                continue
+        where = f" [{issue.op}]" if issue.op else ""
+        findings.append(Finding(rel, line, 0, rule,
+                                f"({issue.code}){where} {issue.message}"))
+    return findings
+
+
 def run(targets, root=None):
     """Lint `targets` (files/dirs); returns findings with root-relative
     paths."""
     root = root or os.getcwd()
     findings = []
-    for path in iter_py_files(targets, root):
+    paths = list(iter_py_files(targets, root))
+    for path in paths:
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         findings.extend(lint_file(path, rel))
+    findings.extend(_concur_findings(paths, root))
     return findings
 
 
