@@ -195,6 +195,9 @@ TORN_SEAMS = {
     ("elastic", "_atomic_json"):
         "heartbeat/announce writer kept off atomic_write so beats stay "
         "recordable while the ckpt.write fault point is armed",
+    ("cluster", "atomic_record"):
+        "world-state/spec writer — the supervisor must stay crash-safe "
+        "while the ckpt.write fault point is armed, so it owns its seam",
     ("telemetry.fleet", "_atomic_json"):
         "telemetry shard writer — same fault-isolation contract as "
         "elastic's",

@@ -143,35 +143,31 @@ GANG_STATS = {"state": IDLE, "generation": 0, "restarts": {},
               "postmortems": 0}
 
 
+# Shared control-plane primitives live in cluster.py since the PR 19
+# consolidation — these names stay as the compat surface every caller
+# (and the concur analyzer's seam registry) already knows.
+
 def _env_float(name, default):
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return float(default)
+    from .cluster import env_float
+
+    return env_float(name, default)
 
 
 def _env_int(name, default):
-    try:
-        return int(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return int(default)
+    from .cluster import env_int
+
+    return env_int(name, default)
 
 
 def _atomic_json(path, obj):
     """tmp + os.replace JSON write. Deliberately NOT checkpoint.atomic_write:
     gang state must stay recordable even while the ``ckpt.write`` fault
-    point is armed — the supervisor records *other* processes' failures."""
-    # pid alone is not unique enough: the heartbeat daemon and a
-    # main-thread beat/announce can race on the same tmp name, and the
-    # loser's os.replace dies with FileNotFoundError (worker exit 1) —
-    # the same collision telemetry/fleet._atomic_json fixed in PR 16
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=1, sort_keys=True, default=repr)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return path
+    point is armed — the supervisor records *other* processes' failures.
+    Delegates to cluster.atomic_record, the one pid+thread-ident-safe
+    seam the whole control plane shares."""
+    from .cluster import atomic_record
+
+    return atomic_record(path, obj)
 
 
 # ------------------------------------------------- worker heartbeat side ---
@@ -197,8 +193,11 @@ class _Heartbeater:
                                         name="mxtpu-gang-beat")
 
     def _payload(self):
+        from . import cluster as _cluster
+
         beats = _watchdog.heartbeats()
         return {"rank": self.rank, "pid": os.getpid(),
+                "start_ticks": _cluster.proc_start_ticks(os.getpid()),
                 "generation": self.generation,
                 "t_wall": time.time(), "t_mono": time.monotonic(),
                 "state": "draining" if _preempt.requested() else "running",
@@ -266,6 +265,19 @@ def stop_heartbeat():
         if _heartbeater is not None:
             _heartbeater.stop()
             _heartbeater = None
+
+
+def final_beat():
+    """Write one heartbeat synchronously, right now (no-op when no
+    daemon is armed). The drain terminal calls this before exiting: a
+    worker that drains faster than the daemon's cadence must still
+    leave ``state: draining`` on disk, because a supervisor restarted
+    after an outage classifies adopted orphans' exits from exactly this
+    evidence (75 on drain evidence, 137 otherwise)."""
+    with _hb_lock:
+        hb = _heartbeater
+    if hb is not None:
+        hb.beat()
 
 
 def read_heartbeats(run_dir):
@@ -828,8 +840,9 @@ class GangSupervisor:
                 self._postmortem(f"no surviving slots after: {reason}")
                 self._set_state(FAILED)
                 return 1
-            delay = min(self.backoff_cap,
-                        self.backoff * (2 ** (self.restarts_used - 1)))
+            from .cluster import next_backoff
+            delay = next_backoff(self.backoff, self.backoff_cap,
+                                 self.restarts_used)
             _flight.rec("gang.restart", f"gen{self.generation + 1}",
                         reason)
             _logger.warning(
@@ -1085,8 +1098,9 @@ class ServingSupervisor:
             _logger.error("fleet: slot %d FAILED — exit %d (%s), restart "
                           "budget exhausted", slot, code, kind)
             return
-        delay = min(self.backoff_cap,
-                    self.backoff * (2 ** rec["restarts"]))
+        from .cluster import next_backoff
+        delay = next_backoff(self.backoff, self.backoff_cap,
+                             rec["restarts"] + 1)
         rec["restarts"] += 1
         rec["state"] = SLOT_BACKOFF
         rec["proc"] = None

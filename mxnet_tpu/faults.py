@@ -33,6 +33,19 @@ through the stack:
                        validation must catch (reject: crc_mismatch),
                        ``delay``/``hang`` stall the watcher, ``raise``
                        rejects as apply_error
+    ``cluster.observe``  the reconcile loop's observation half
+                       (cluster.py ClusterSupervisor), fired inside the
+                       ``cluster.observe`` watchdog span — ``hang``/
+                       ``delay`` wedge the pass so the watchdog ladder
+                       fires like any other stalled sync point
+    ``cluster.act``    every reconcile action before it is performed
+                       (spawn/drain/restart/scale/gc); the action dict
+                       is the payload — ``raise`` aborts one action,
+                       ``hang`` wedges the act half under its span
+    ``supervisor.act`` alias span fired alongside ``cluster.act`` —
+                       the chaos phase 16 crash drill arms it to down
+                       the supervisor mid-action and prove the
+                       restarted one re-adopts from the world record
 
 Faults are configured programmatically (:func:`configure`) or through the
 ``MXNET_TPU_FAULTS`` environment variable — read once, at first use, so

@@ -385,6 +385,14 @@ def drain(save=None, exit=True, code=None, directory=None):
                 ev.get("signal") or ev.get("reason"))
     ev["flight_tail"] = _flight.tail(64)
     ev["recorded"] = _write_event(ev, directory)
+    try:
+        # flush drain evidence to the gang heartbeat NOW: exiting faster
+        # than the daemon's cadence must not cost the on-disk "draining"
+        # state a restarted supervisor classifies orphan exits from
+        from . import elastic as _elastic
+        _elastic.final_beat()
+    except Exception:
+        pass
     _logger.warning("preempt: drained (%s); final checkpoint: %s; "
                     "exiting %d for reschedule",
                     ev.get("signal") or ev.get("reason"),

@@ -270,8 +270,12 @@ def main(argv=None):
                                    worker=f"w{args.slot}")
 
     def announce(state, **extra):
+        from mxnet_tpu.cluster import proc_start_ticks
+
         rec = {"slot": args.slot, "generation": args.generation,
-               "pid": os.getpid(), "host": args.host, "port": front.port,
+               "pid": os.getpid(),
+               "start_ticks": proc_start_ticks(os.getpid()),
+               "host": args.host, "port": front.port,
                "url": front.url, "model_dir": os.fspath(args.model_dir),
                "models": server.models(), "state": state,
                "ready": state == "serving" and pending == 0,

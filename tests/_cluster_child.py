@@ -1,0 +1,83 @@
+"""Long-lived trainer-gang worker for the cluster control-plane drills
+(tools/chaos_smoke.py phase 16 and tests/test_cluster.py).
+
+One rank of a ``cluster.json`` trainer-gang: heartbeats ride the normal
+worker-side arming (``MXTPU_GANG_DIR`` is set by the supervisor, so
+importing :mod:`mxnet_tpu` starts the daemon), SIGTERM drains gracefully
+through :mod:`mxnet_tpu.preempt` (exit 75), and — when the spec wires
+``publish_to`` a model-bus role (``MXTPU_MODELBUS_DIR``) — rank 0 streams
+live "weights" into the bus: the deterministic serving demo model's
+params plus a per-step drift, so a serving-fleet role subscribed to the
+same bus applies real version updates while this gang trains. The drill
+kills the SUPERVISOR, not this child — the child's job is to stay busy
+and observable.
+
+Env knobs (CC_* are this child's; MXTPU_* come from the supervisor):
+
+    CC_TOTAL          steps before a clean exit 0 (default 100000 —
+                      effectively "run until drained")
+    CC_STEP_SLEEP     seconds per step (default 0.05)
+    CC_SEED           demo-model seed — MUST match the serving role's
+                      model dir spec seed (default 777)
+    CC_PUBLISH_EVERY  bus publish cadence in steps (default 5; 0 = never)
+    CC_DELTA          per-step param drift magnitude (default 0.01)
+"""
+import os
+import sys
+import time
+
+# this gang's mesh is process-local — see tests/_gang_child.py
+os.environ.pop("MXTPU_COORDINATOR", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu  # noqa: E402,F401  (arms heartbeat via MXTPU_GANG_DIR)
+from mxnet_tpu import preempt  # noqa: E402
+
+
+def main():
+    total = int(os.environ.get("CC_TOTAL", "100000"))
+    sleep_s = float(os.environ.get("CC_STEP_SLEEP", "0.05") or 0.05)
+    rank = int(os.environ.get("MXTPU_WORKER_ID", "0") or 0)
+    every = int(os.environ.get("CC_PUBLISH_EVERY", "5") or 0)
+    bus_dir = os.environ.get("MXTPU_MODELBUS_DIR")
+    preempt.install()
+    gang_dir = os.environ.get("MXTPU_GANG_DIR")
+    if gang_dir:
+        # the heartbeat daemon arms EARLY in the mxnet_tpu import, well
+        # before install() above — a SIGTERM in that window kills
+        # instead of draining, so drills that want a drainable worker
+        # must wait for this marker, not for the first heartbeat
+        with open(os.path.join(gang_dir, f"armed-{rank}"), "w") as f:
+            f.write(str(os.getpid()))
+
+    params = None
+    bus = None
+    if bus_dir and rank == 0 and every > 0:
+        from mxnet_tpu.modelbus import ModelBus
+        from mxnet_tpu.serving import worker as worker_mod
+
+        seed = int(os.environ.get("CC_SEED", "777"))
+        net = worker_mod.build_demo_model(seed)
+        params = [(name, p.data().asnumpy())
+                  for name, p in net.collect_params().items()]
+        bus = ModelBus(bus_dir)
+
+    delta = float(os.environ.get("CC_DELTA", "0.01"))
+    published = 0
+    for step in range(1, total + 1):
+        if preempt.requested():
+            preempt.drain(save=False)  # SystemExit(75)
+        if bus is not None and step % every == 0:
+            version = bus.publish(
+                [(name, arr + delta * step) for name, arr in params],
+                step=step, model="model0")
+            if version is not None:
+                published += 1
+        time.sleep(sleep_s)
+    print(f"CLUSTER_CHILD_DONE rank={rank} steps={total} "
+          f"published={published}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

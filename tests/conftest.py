@@ -44,6 +44,29 @@ import numpy as _onp
 import pytest as _pytest
 
 
+# The backend-liveness probe (base.ensure_live_backend) latches its result
+# into the process environment ON PURPOSE — MXTPU_PROBE_OK memoises a
+# successful probe for the whole process tree, MXTPU_PLATFORM(+_FALLBACK)
+# pin the CPU fallback. Inside one pytest process that latch is leaked
+# global state: any test that runs an example main() in-process (they call
+# probe_backend_or_fallback) flips MXTPU_PROBE_OK for every LATER test,
+# which made test_ensure_live_backend_fallback_paths order-dependent in
+# the full suite. Restore the probe vars around every test so no test can
+# observe another's probe outcome.
+_PROBE_ENV = ("MXTPU_PROBE_OK", "MXTPU_PLATFORM", "MXTPU_PLATFORM_FALLBACK")
+
+
+@_pytest.fixture(autouse=True)
+def _probe_env_guard():
+    saved = {k: os.environ.get(k) for k in _PROBE_ENV}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
 @_pytest.fixture(autouse=True)
 def _mxnet_test_seed():
     """Deterministic reruns under MXNET_TEST_SEED (parity: the reference

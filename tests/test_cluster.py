@@ -1,0 +1,433 @@
+"""The reconciling cluster control plane (mxnet_tpu.cluster).
+
+Unit tier: spec validation, the shared restart-budget/backoff
+primitives, the crash-safe world record (atomic writes, torn-record
+degradation), and the re-adoption verdict logic — pid reuse detection by
+/proc start-ticks, outage-exit classification from drain evidence.
+
+Integration tier (real subprocess workers via tests/_cluster_child.py):
+a trainer-gang role runs to completion under the supervisor; a
+SIGKILL-equivalent supervisor death is recovered by a second incarnation
+that re-adopts the still-running worker without restarting it; a torn
+world record falls back to heartbeat-evidence scavenging; stale-pid and
+died-during-outage records are classified instead of adopted. The
+full-topology drill (train + bus + serve under launch.py --cluster,
+supervisor SIGKILLed mid-load) lives in tools/chaos_smoke.py phase 16.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mxnet_tpu import cluster, elastic
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_cluster_child.py")
+
+
+@pytest.fixture(autouse=True)
+def _cluster_env_guard():
+    """In-process supervisors export MXTPU_CLUSTER_DIR for diagnose;
+    never let one test's cluster leak into the next."""
+    keys = ("MXTPU_CLUSTER_DIR", "MXTPU_GANG_DIR", "MXTPU_WORKER_ID",
+            "MXTPU_GANG_GENERATION", "MXTPU_COORDINATOR")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _gang_spec(name="train", workers=1, port=9471, **over):
+    cfg = {"kind": "trainer-gang", "command": [sys.executable, CHILD],
+           "workers": workers, "max_restarts": 3, "backoff": 0.05,
+           "grace": 10, "dead_after": 30, "coordinator_port": port}
+    cfg.update(over)
+    return {"cluster": "t-cluster", "roles": {name: cfg}}
+
+
+def _child_env(total, sleep=0.01, **extra):
+    env = {"JAX_PLATFORMS": "cpu", "CC_TOTAL": str(total),
+           "CC_STEP_SLEEP": str(sleep), "CC_PUBLISH_EVERY": "0"}
+    env.update(extra)
+    return env
+
+
+def _wait_armed(sup, role="train", timeout=60):
+    """Tick until the worker is not just spawned but ARMED: the child
+    writes ``armed-<rank>`` (with its pid) only after preempt.install(),
+    and its heartbeat names the slot's pid. Waiting for slot state
+    'running' alone races the child's interpreter startup — a SIGTERM
+    landing before install() kills instead of draining, and the gang
+    heartbeat arms early in the mxnet_tpu import, so it is no proof
+    either."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.tick()
+        s = sup.roles[role].slots.get(0)
+        if s is not None and s.alive():
+            beat = elastic.read_heartbeats(sup.roles[role].dir).get(0)
+            try:
+                with open(os.path.join(sup.roles[role].dir,
+                                       "armed-0")) as f:
+                    armed_pid = int(f.read() or 0)
+            except (OSError, ValueError):
+                armed_pid = None
+            if beat and beat.get("pid") == s.pid \
+                    and armed_pid == s.pid:
+                return s
+        time.sleep(0.05)
+    sup.stop(graceful=False)
+    _reap(sup)
+    pytest.fail(f"worker never armed under {sup.world.cluster}")
+
+
+def _reap(sup):
+    """Reap any Popen children a supervisor still holds (zombies would
+    otherwise linger for the rest of the pytest process)."""
+    for role in sup.roles.values():
+        for s in role.slots.values():
+            if s.proc is not None:
+                try:
+                    s.proc.wait(timeout=10)
+                except Exception:
+                    pass
+
+
+# ------------------------------------------------------------ spec layer --
+
+def test_validate_spec_fills_defaults_and_resolves_paths(tmp_path):
+    spec = cluster.validate_spec(
+        {"cluster": "c", "roles": {
+            "train": {"kind": "trainer-gang", "command": ["x"],
+                      "workers": 2, "publish_to": "bus"},
+            "bus": {"kind": "model-bus"},
+            "serve": {"kind": "serving-fleet", "model_dir": "models",
+                      "min": 1, "max": 3, "subscribe_to": "bus"}}},
+        base_dir=str(tmp_path))
+    train = spec["roles"]["train"]
+    assert train["max_restarts"] == 5 and train["backoff"] == 0.5
+    serve = spec["roles"]["serve"]
+    # relative model_dir resolves against the spec's directory
+    assert serve["model_dir"] == os.path.join(str(tmp_path), "models")
+    # workers defaults to min, clamped into [min, max]
+    assert serve["workers"] == 1
+    assert spec["roles"]["bus"]["keep"] == 0
+
+
+@pytest.mark.parametrize("bad,err", [
+    ({}, "non-empty 'roles'"),
+    ({"roles": {"r": {"kind": "nope"}}}, "unknown kind"),
+    ({"roles": {"r": {"kind": "trainer-gang", "command": ["x"],
+                      "frobnicate": 1}}}, "unknown option"),
+    ({"roles": {"r": {"kind": "trainer-gang"}}}, "non-empty 'command'"),
+    ({"roles": {"r": {"kind": "trainer-gang", "command": ["x"],
+                      "workers": 0}}}, "workers must be >= 1"),
+    ({"roles": {"r": {"kind": "serving-fleet"}}}, "model_dir"),
+    ({"roles": {"r": {"kind": "serving-fleet", "model_dir": "m",
+                      "min": 3, "max": 1}}}, "min <= max"),
+    ({"roles": {"r": {"kind": "trainer-gang", "command": ["x"],
+                      "publish_to": "ghost"}}}, "not a model-bus role"),
+])
+def test_validate_spec_rejects(bad, err):
+    with pytest.raises(cluster.ClusterError, match=err):
+        cluster.validate_spec(bad)
+
+
+def test_load_spec_names_unreadable_and_malformed(tmp_path):
+    with pytest.raises(cluster.ClusterError, match="cannot read"):
+        cluster.load_spec(tmp_path / "missing.json")
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    with pytest.raises(cluster.ClusterError, match="malformed"):
+        cluster.load_spec(p)
+
+
+# ------------------------------------------------- restart primitives --
+
+def test_next_backoff_curve():
+    assert cluster.next_backoff(0.5, 30.0, 0) == 0.0
+    assert cluster.next_backoff(0.5, 30.0, 1) == 0.5
+    assert cluster.next_backoff(0.5, 30.0, 3) == 2.0
+    assert cluster.next_backoff(0.5, 30.0, 50) == 30.0  # capped
+
+
+def test_restart_ledger_role_wide_budget():
+    led = cluster.RestartLedger(2, 0.5, 30.0)
+    ok1, d1 = led.charge(reason="x")
+    ok2, d2 = led.charge(reason="y")
+    assert (ok1, ok2) == (True, True)
+    assert (d1, d2) == (0.5, 1.0)
+    ok3, _ = led.charge()
+    assert not ok3 and led.exhausted
+    assert led.restarts_total == 2
+
+
+def test_restart_ledger_per_slot_round_trip():
+    led = cluster.RestartLedger(1, 0.1, 5.0, per_slot=True)
+    assert led.charge(slot=0)[0]
+    assert led.charge(slot=1)[0]
+    assert not led.charge(slot=0)[0]       # slot 0's budget is spent
+    back = cluster.RestartLedger.from_dict(
+        led.as_dict(), 1, 0.1, 5.0, True)
+    assert back.restarts_total == 2
+    assert back.used(slot=0) == 1 and back.used(slot=1) == 1
+    assert back.exhausted
+
+
+# -------------------------------------------------------- world record --
+
+def test_world_state_round_trip(tmp_path):
+    ws = cluster.WorldState(str(tmp_path))
+    ws.cluster = "c"
+    ws.incarnation = 3
+    ws.generation = {"train": 2}
+    ws.slots = {"train": {"0": {"slot": 0, "pid": 1234,
+                                "state": "running"}}}
+    for i in range(80):                    # the action log is capped
+        ws.record_action("spawn", "train", 0, f"r{i}")
+    ws.save()
+    back = cluster.WorldState.load(str(tmp_path))
+    assert not back.torn
+    assert back.incarnation == 3
+    assert back.generation == {"train": 2}
+    assert back.slots["train"]["0"]["pid"] == 1234
+    assert len(back.actions) == 64
+    assert back.actions[-1]["reason"] == "r79"
+
+
+def test_world_state_torn_record_degrades(tmp_path):
+    (tmp_path / cluster.WORLD_FILE).write_text('{"cluster": "c", "slo')
+    ws = cluster.WorldState.load(str(tmp_path))
+    assert ws.torn and ws.incarnation == 0 and ws.slots == {}
+    # structurally wrong types degrade the same way
+    (tmp_path / cluster.WORLD_FILE).write_text('{"slots": [1, 2]}')
+    assert cluster.WorldState.load(str(tmp_path)).torn
+
+
+def test_atomic_record_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "rec.json")
+    cluster.atomic_record(path, {"a": 1})
+    cluster.atomic_record(path, {"a": 2})
+    with open(path) as f:
+        assert json.load(f) == {"a": 2}
+    assert os.listdir(tmp_path) == ["rec.json"]
+
+
+# ------------------------------------------------- adoption verdicts --
+
+def test_adoption_verdict_live_match_and_stale_ticks():
+    pid = os.getpid()
+    ticks = cluster.proc_start_ticks(pid)
+    assert ticks is not None
+    v, why = cluster.adoption_verdict(
+        {"pid": pid, "start_ticks": ticks, "spawned": time.time()})
+    assert v == "adopt" and "match" in why
+    # same live pid, different recorded start-ticks: the pid was reused
+    v, why = cluster.adoption_verdict(
+        {"pid": pid, "start_ticks": ticks + 7, "spawned": time.time()})
+    assert v == "stale-pid" and "reused" in why
+
+
+def test_adoption_verdict_dead_pid():
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    v, _ = cluster.adoption_verdict(
+        {"pid": p.pid, "start_ticks": 1, "spawned": time.time()})
+    assert v == "dead"
+
+
+def test_adoption_verdict_no_ticks_trust_window():
+    rec = {"pid": os.getpid(), "start_ticks": None,
+           "spawned": time.time()}
+    assert cluster.adoption_verdict(rec)[0] == "adopt"
+    rec["spawned"] = time.time() - 3600
+    assert cluster.adoption_verdict(rec)[0] == "stale-pid"
+
+
+def test_pid_alive_rejects_zombie():
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:   # un-reaped child -> zombie
+        try:
+            with open(f"/proc/{p.pid}/stat") as f:
+                stat = f.read()
+            if stat[stat.rindex(")") + 2:].split(" ", 1)[0] == "Z":
+                break
+        except OSError:
+            break
+        time.sleep(0.02)
+    assert not cluster.pid_alive(p.pid)
+    p.wait()
+
+
+def test_classify_outage_exit_from_evidence():
+    assert cluster.classify_outage_exit({}, {"state": "draining"}) == 75
+    assert cluster.classify_outage_exit({}, {"state": "drained"}) == 75
+    assert cluster.classify_outage_exit({}, {"state": "running"}) == 137
+    assert cluster.classify_outage_exit({}, None) == 137
+
+
+# --------------------------------------------- re-adoption edge cases --
+
+def _seed_world(run_dir, slot_rec, role="train"):
+    """Author a previous incarnation's world record by hand."""
+    os.makedirs(run_dir, exist_ok=True)
+    cluster.atomic_record(
+        os.path.join(run_dir, cluster.WORLD_FILE),
+        {"cluster": "t-cluster", "incarnation": 1,
+         "supervisor": {"pid": 1, "start_ticks": 1,
+                        "started": time.time() - 5,
+                        "state": "reconciling"},
+         "generation": {role: 1}, "next_slot": {role: 1},
+         "slots": {role: {"0": slot_rec}},
+         "ledger": {}, "actions": [], "router": {}})
+
+
+def test_stale_pid_record_is_never_signalled(tmp_path):
+    """A recycled pid (alive, wrong start-ticks) must be classified as
+    an outage loss — never adopted, never killed. The recorded pid here
+    is the TEST PROCESS itself: surviving the supervisor construction
+    IS the assertion that re-adoption left the stranger alone."""
+    run = str(tmp_path / "run")
+    ticks = cluster.proc_start_ticks(os.getpid())
+    _seed_world(run, {"slot": 0, "generation": 1, "pid": os.getpid(),
+                      "start_ticks": ticks + 9,
+                      "spawned": time.time() - 30, "state": "running",
+                      "restarts": 0})
+    sup = cluster.ClusterSupervisor(_gang_spec(port=9472), run_dir=run,
+                                    poll=0.05, env=_child_env(2))
+    try:
+        assert sup.adopted == 0
+        s = sup.roles["train"].slots[0]
+        assert s.state == "exited-during-outage"
+        assert s.pid is None                 # the stranger's pid dropped
+        assert s.last_exit == 137            # no drain evidence
+        outage = [a for a in sup.world.actions
+                  if a["kind"] == "outage-exit"]
+        assert outage and "reused" in outage[0]["reason"]
+        assert not [a for a in sup.world.actions
+                    if a["kind"] == "adopt"]
+    finally:
+        sup.stop(graceful=False)
+        _reap(sup)
+
+
+def test_worker_exit_during_outage_classified_from_drain_evidence(
+        tmp_path):
+    """A worker that drained and exited while the supervisor was down
+    leaves only heartbeat evidence; the restarted incarnation must
+    classify its exit 75 (drain), not 137."""
+    run = str(tmp_path / "run")
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()                                 # dead, reaped: pid gone
+    _seed_world(run, {"slot": 0, "generation": 1, "pid": p.pid,
+                      "start_ticks": 12345, "spawned": time.time() - 30,
+                      "state": "running", "restarts": 0})
+    hb_dir = os.path.join(run, "train")
+    os.makedirs(hb_dir, exist_ok=True)
+    cluster.atomic_record(
+        os.path.join(hb_dir, "rank-0.json"),
+        {"rank": 0, "pid": p.pid, "generation": 1,
+         "t_wall": time.time(), "state": "draining"})
+    sup = cluster.ClusterSupervisor(_gang_spec(port=9473), run_dir=run,
+                                    poll=0.05, env=_child_env(2))
+    try:
+        s = sup.roles["train"].slots[0]
+        assert s.last_exit == 75
+        outage = [a for a in sup.world.actions
+                  if a["kind"] == "outage-exit"]
+        assert outage and outage[0]["exit"] == 75
+    finally:
+        sup.stop(graceful=False)
+        _reap(sup)
+
+
+# ----------------------------------------- live supervisor lifecycle --
+
+def test_supervisor_runs_gang_to_done(tmp_path):
+    run = str(tmp_path / "run")
+    sup = cluster.ClusterSupervisor(_gang_spec(port=9474), run_dir=run,
+                                    poll=0.05, env=_child_env(total=3))
+    try:
+        rc = sup.run()
+    finally:
+        _reap(sup)
+    assert rc == 0
+    assert sup.roles["train"].state == "done"
+    with open(os.path.join(run, cluster.WORLD_FILE)) as f:
+        world = json.load(f)
+    assert world["supervisor"]["state"] == "stopped"
+    kinds = [a["kind"] for a in world["actions"]]
+    assert "spawn" in kinds and "done" in kinds
+    assert world["slots"]["train"]["0"]["last_exit"] == 0
+
+
+def test_supervisor_crash_readopts_running_worker(tmp_path):
+    """The headline robustness path, in-process: supervisor #1 dies
+    without any teardown (its object is simply abandoned, as SIGKILL
+    would leave things); supervisor #2 on the same run dir re-adopts
+    the still-running worker by pid + start-ticks — zero restarts —
+    and a graceful stop then drains it through exit 75 classified
+    purely from heartbeat evidence (an adopted orphan has no waitpid
+    status)."""
+    run = str(tmp_path / "run")
+    sup1 = cluster.ClusterSupervisor(
+        _gang_spec(port=9475), run_dir=run, poll=0.05,
+        env=_child_env(total=100000, sleep=0.05))
+    pid = _wait_armed(sup1).pid
+    # supervisor #1 "crashes": no stop(), no drain — the world record on
+    # disk and the orphaned worker are all that survive
+    sup2 = cluster.ClusterSupervisor(
+        _gang_spec(port=9475), run_dir=run, poll=0.05,
+        env=_child_env(total=100000, sleep=0.05))
+    try:
+        assert sup2.world.incarnation == 2
+        assert sup2.adopted == 1
+        s2 = sup2.roles["train"].slots[0]
+        assert s2.pid == pid and s2.adopted
+        assert s2.restarts == 0              # the healthy worker is free
+        assert [a for a in sup2.world.actions if a["kind"] == "adopt"]
+        sup2.tick()
+        assert sup2.roles["train"].slots[0].pid == pid  # still adopted
+    finally:
+        sup2.stop()                          # graceful: SIGTERM -> drain
+        _reap(sup1)
+        _reap(sup2)
+    s2 = sup2.roles["train"].slots[0]
+    assert s2.last_exit == 75, \
+        f"adopted worker's drain classified {s2.last_exit}"
+    assert s2.state == "retired"
+
+
+def test_torn_world_record_scavenges_from_heartbeats(tmp_path):
+    """SIGKILL mid-write (pre-atomic-seam worlds) leaves a torn
+    world.json: the restarted supervisor must rebuild the census from
+    the workers' own heartbeat shards and still re-adopt, not orphan
+    and double-spawn."""
+    run = str(tmp_path / "run")
+    sup1 = cluster.ClusterSupervisor(
+        _gang_spec(port=9476), run_dir=run, poll=0.05,
+        env=_child_env(total=100000, sleep=0.05))
+    pid = _wait_armed(sup1).pid
+    with open(os.path.join(run, cluster.WORLD_FILE), "w") as f:
+        f.write('{"cluster": "t-cluster", "incarnation": 1, "slo')
+    sup2 = cluster.ClusterSupervisor(
+        _gang_spec(port=9476), run_dir=run, poll=0.05,
+        env=_child_env(total=100000, sleep=0.05))
+    try:
+        assert sup2.adopted == 1
+        assert sup2.roles["train"].slots[0].pid == pid
+        kinds = [a["kind"] for a in sup2.world.actions]
+        assert "scavenge" in kinds and "adopt" in kinds
+    finally:
+        sup2.stop()
+        _reap(sup1)
+        _reap(sup2)
+    assert sup2.roles["train"].slots[0].last_exit == 75

@@ -402,6 +402,10 @@ def test_ensure_live_backend_fallback_paths(monkeypatch):
     from mxnet_tpu import base
 
     monkeypatch.delenv("MXTPU_PLATFORM", raising=False)
+    # an earlier in-process probe success latches MXTPU_PROBE_OK and would
+    # short-circuit the probe entirely (regression guard for the full-suite
+    # order dependency fixed alongside conftest's _probe_env_guard)
+    monkeypatch.delenv("MXTPU_PROBE_OK", raising=False)
 
     def hang(*a, **kw):
         raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])

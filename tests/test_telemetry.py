@@ -182,6 +182,10 @@ def test_trainer_cost_capture_and_step_report():
 
 
 def test_step_abort_on_injected_fault():
+    # earlier tests may have filled the 256-record ring to its cap, where
+    # "len grows by one" can never hold — start from a known-empty ring
+    # (regression guard for the full-suite order dependency)
+    steps.reset()
     trainer, x, y = small_trainer(seed=4)
     trainer.step(x, y)
     before = len(steps.history())
@@ -195,6 +199,33 @@ def test_step_abort_on_injected_fault():
     assert len(steps.history()) == before
     trainer.step(x, y)
     assert len(steps.history()) == before + 1
+
+
+def test_step_history_semantics_at_ring_cap():
+    """The abandoned-record contract must hold even when the history ring
+    is already at its maxlen cap — the exact state the full suite leaves
+    behind (the pre-fix flake: len(history()) can't grow at the cap, so
+    assertions must key on record identity, not length)."""
+    steps.reset()
+    trainer, x, y = small_trainer(seed=4)
+    trainer.step(x, y)
+    template = steps.last()
+    cap = steps._HIST.maxlen
+    while len(steps._HIST) < cap:
+        steps._HIST.append(dict(template, step=len(steps._HIST)))
+    last_before = steps.last()
+    faults.configure("trainer.step:raise@1", seed=0)
+    try:
+        with pytest.raises(faults.InjectedFault):
+            trainer.step(x, y)
+    finally:
+        faults.reset()
+    # aborted step left no record: the newest entry is unchanged
+    assert steps.last() == last_before
+    trainer.step(x, y)
+    assert len(steps.history()) == cap  # ring stays at cap...
+    assert steps.last() != last_before  # ...but the new record landed
+    steps.reset()
 
 
 def test_memory_sample_and_oom_report(tmp_path, monkeypatch):

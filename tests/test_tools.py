@@ -427,7 +427,16 @@ def test_chaos_smoke_recovers(tmp_path):
     for bundle in os.listdir(crash):
         with open(crash / bundle / "flight.json") as f:
             assert json.load(f), f"empty flight tail in {bundle}"
-    # phase 8 left the supervised gang's summary: a 1-restart recovery
-    with open(tmp_path / "gang" / "run" / "gang.json") as f:
-        summary = json.load(f)
-    assert summary["state"] == "done" and summary["generation"] == 2
+    # phase 8 left the cluster supervisor's world record: a 1-restart
+    # generation-2 recovery, stopped cleanly
+    with open(tmp_path / "gang" / "run" / "world.json") as f:
+        world = json.load(f)
+    assert world["supervisor"]["state"] == "stopped"
+    assert world["generation"]["train"] == 2
+    assert world["ledger"]["train"]["restarts_total"] == 1
+    # phase 16 left the SIGKILLed-and-restarted supervisor's record:
+    # incarnation 2 with re-adoptions and zero healthy-worker restarts
+    with open(tmp_path / "cluster" / "run" / "world.json") as f:
+        world = json.load(f)
+    assert world["incarnation"] == 2
+    assert any(a["kind"] == "adopt" for a in world["actions"])

@@ -492,6 +492,100 @@ def check_modelbus():
     return out
 
 
+def check_cluster():
+    """Cluster control plane (docs/ROBUSTNESS.md "Cluster control
+    plane"): the spec, the persisted world record, the desired-vs-actual
+    census diff, per-role restart ledgers and the last reconcile
+    actions — everything a restarted supervisor would re-adopt from."""
+    import json as _json
+
+    _p("---------Cluster---------")
+    out = {"MXTPU_CLUSTER_DIR": os.environ.get("MXTPU_CLUSTER_DIR")}
+    run_dir = out["MXTPU_CLUSTER_DIR"]
+    _p(f"MXTPU_CLUSTER_DIR={run_dir or '<unset>'}  "
+       "(world-state dir — launch.py --cluster)")
+    try:
+        from mxnet_tpu import cluster as _cluster
+    except ImportError as e:
+        out["error"] = str(e)
+        _p("cluster import failed:", e)
+        return out
+    live = [s.describe() for s in _cluster.live_supervisors()]
+    out["live_supervisors"] = live
+    if live:
+        for d in live:
+            _p(f"live supervisor: {d['cluster']!r} incarnation "
+               f"{d['incarnation']} ({d['ticks']} tick(s), "
+               f"{d['adopted']} adopted)")
+    else:
+        _p("live supervisor: none in this process")
+    if not run_dir:
+        return out
+    if not os.path.isdir(run_dir):
+        out["run_dir_error"] = f"{run_dir} does not exist"
+        _p(f"run dir       : {run_dir} (does not exist)")
+        return out
+    spec = None
+    spec_path = os.path.join(run_dir, _cluster.SPEC_FILE)
+    try:
+        with open(spec_path) as f:
+            spec = _json.load(f)
+        out["spec"] = spec
+        _p(f"spec          : {spec_path} (cluster "
+           f"{spec.get('cluster')!r}, {len(spec.get('roles', {}))} "
+           "role(s))")
+    except (OSError, ValueError) as e:
+        out["spec_error"] = str(e)
+        _p(f"spec          : unreadable ({e})")
+    world = _cluster.WorldState.load(run_dir)
+    sup = world.supervisor or {}
+    sup_alive = _cluster.pid_alive(sup.get("pid")) and \
+        _cluster.proc_start_ticks(sup.get("pid")) == sup.get("start_ticks")
+    out["world"] = {"incarnation": world.incarnation,
+                    "torn": world.torn, "supervisor": sup,
+                    "supervisor_alive": sup_alive}
+    _p(f"world         : incarnation {world.incarnation}, supervisor "
+       f"pid {sup.get('pid')} "
+       f"({'alive' if sup_alive else sup.get('state', 'gone')})"
+       f"{' [TORN — rebuilt from observation]' if world.torn else ''}")
+    diff, ledgers = {}, {}
+    roles = (spec or {}).get("roles", {})
+    for name, slots in sorted(world.slots.items()):
+        cfg = roles.get(name, {})
+        desired = int(cfg.get("workers", 0) or 0)
+        alive = sum(1 for rec in slots.values()
+                    if rec.get("state") in ("running", "starting",
+                                            "draining")
+                    and _cluster.pid_alive(rec.get("pid")))
+        states = {}
+        for rec in slots.values():
+            states[rec.get("state")] = states.get(rec.get("state"), 0) + 1
+        diff[name] = {"kind": cfg.get("kind"), "desired": desired,
+                      "alive": alive, "recorded": len(slots),
+                      "generation": world.generation.get(name),
+                      "states": states}
+        ledgers[name] = world.ledger.get(name)
+        drift = "" if alive == desired or cfg.get("kind") == "model-bus" \
+            else f"  << drift {alive - desired:+d}"
+        _p(f"  {name:<14s} {cfg.get('kind', '?'):<13s} "
+           f"desired={desired} alive={alive} "
+           f"gen={world.generation.get(name)} "
+           f"states={states}{drift}")
+    out["diff"] = diff
+    out["ledgers"] = ledgers
+    for name, led in sorted(ledgers.items()):
+        if led and led.get("used"):
+            _p(f"  ledger {name}: used={led['used']} "
+               f"budget={led.get('budget')} "
+               f"exhausted={led.get('exhausted')}")
+    out["actions"] = world.actions[-8:]
+    for a in out["actions"]:
+        _p(f"  action: {a.get('kind'):<12s} {a.get('role')}"
+           f"{'/s' + str(a.get('slot')) if a.get('slot') is not None else ''}"
+           f" — {a.get('reason')}")
+    return out
+
+
 def check_watchdog():
     """Watchdog knobs + the most recent crash bundle, if one exists
     (docs/ROBUSTNESS.md) — the first thing to read after a wedged run."""
@@ -1061,6 +1155,7 @@ SECTIONS = (
     ("serving", check_serving),
     ("serving_fleet", check_fleet),
     ("model_bus", check_modelbus),
+    ("cluster", check_cluster),
     ("kernels", check_kernels),
     ("quantization", check_quantization),
     ("watchdog", check_watchdog),

@@ -34,6 +34,16 @@ launcher therefore
 
     python tools/launch.py --serve-fleet --model-dir ./models -n 4 --http-port 8080
 
+  * cluster mode (``--cluster spec.json``): the whole topology —
+    trainer gangs, serving fleets and the model bus wiring them — as ONE
+    declarative ``cluster.json`` under the reconciling
+    ``mxnet_tpu.cluster`` supervisor (the dmlc-tracker scheduler role,
+    redesigned: observe -> diff -> act, crash-safe world state,
+    restart-with-re-adoption). See docs/ROBUSTNESS.md "Cluster control
+    plane" and docs/MIGRATION.md for the scheduler mapping::
+
+    python tools/launch.py --cluster cluster.json --run-dir /tmp/run
+
 Signal handling (all modes): the first SIGINT/SIGTERM forwards SIGTERM to
 every child — a graceful drain, their ``mxnet_tpu.preempt`` handlers
 finish the step and checkpoint — then escalates to SIGKILL after a grace
@@ -221,6 +231,26 @@ def serve_fleet(args):
     return 0
 
 
+def run_cluster(args):
+    """``--cluster <spec>``: hand the whole topology to the reconciling
+    cluster supervisor (``mxnet_tpu.cluster``) — training gangs, serving
+    fleets and the model bus from ONE ``cluster.json``. The supervisor
+    installs its own drain-then-kill signal handlers; its exit code is
+    the most severe failed-role code (docs/ROBUSTNESS.md 'Cluster
+    control plane')."""
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from mxnet_tpu.cluster import ClusterSupervisor
+
+    sup = ClusterSupervisor(args.cluster, run_dir=args.run_dir,
+                            poll=args.poll)
+    print(f"cluster: {sup.spec['cluster']} incarnation "
+          f"{sup.world.incarnation} (run dir {sup.run_dir}, "
+          f"{len(sup.roles)} role(s), {sup.adopted} re-adopted)",
+          flush=True)
+    return sup.run()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Launch a distributed job (jax.distributed rendezvous)")
@@ -268,6 +298,14 @@ def main(argv=None):
                         "(mxtpu_fleet_* rank-shard sums, "
                         "mxtpu_gang_straggler_* skew verdict) — one "
                         "scrape for the whole gang")
+    p.add_argument("--cluster", default=None, metavar="SPEC",
+                   help="run a cluster.json topology (trainer-gang + "
+                        "model-bus + serving-fleet roles) under the "
+                        "reconciling cluster supervisor; --run-dir is "
+                        "the crash-safe world-state dir — restarting "
+                        "the launcher against the same dir re-adopts "
+                        "running workers (docs/ROBUSTNESS.md 'Cluster "
+                        "control plane')")
     p.add_argument("--serve-fleet", action="store_true",
                    help="serve a model dir with an N-worker ServingFleet "
                         "behind the router front door (-n workers, "
@@ -285,6 +323,9 @@ def main(argv=None):
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command to launch")
     args = p.parse_args(argv)
+
+    if args.cluster:
+        return run_cluster(args)
 
     if args.serve_fleet:
         if not args.model_dir:
