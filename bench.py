@@ -41,7 +41,12 @@ A ``serving_fleet_rps_*`` line follows (``loadgen --workers`` through
 the ServingFleet router at workers=1 and workers=4;
 BENCH_FLEET_WORKERS/_SECONDS): the N-worker rps with ``rps_1worker``
 and ``scaling_efficiency`` = rpsN/(N·rps1) — the multi-process scaling
-trajectory. BENCH_SKIP_SERVE=1 skips all three.
+trajectory. A ``serving_fleet_hedged_*`` line follows: a 2-host fleet
+with one injected straggler host measured hedging-off vs hedging-on
+(value = the p99 cut ratio), plus the prediction-cache hit-path vs
+compute-path p50 split (``cache_speedup``);
+BENCH_FLEET_HEDGE_SECONDS/_DELAY_S size the drill.
+BENCH_SKIP_SERVE=1 skips all four.
 
 Env knobs: BENCH_BATCH (default 128), BENCH_DTYPE (bfloat16|float32),
 BENCH_ITERS, BENCH_MODEL, BENCH_SKIP_TRAIN, BENCH_PEAK_TFLOPS (default:
@@ -152,6 +157,7 @@ def main(argv=None):
         bench_serve()
         bench_serve_int8()
         bench_serve_fleet()
+        bench_serve_fleet_hedged()
         return
     if args.dataplane_only:
         bench_dataplane()
@@ -252,6 +258,9 @@ def main(argv=None):
         # (serving_fleet_rps_*, scaling_efficiency) — the PR 15
         # near-linear-scaling trajectory
         bench_serve_fleet()
+        # the tail-tolerance line: hedging-on vs hedging-off p99 under
+        # an injected straggler + the prediction-cache latency split
+        bench_serve_fleet_hedged()
     # the host data-plane line tracks the streaming input pipeline
     # (native fused decode+augment img/s + trainer data_wait);
     # BENCH_SKIP_DATAPLANE=1 opts out
@@ -460,6 +469,77 @@ def bench_serve_fleet():
         "reconnects": repn.get("reconnects"),
         "connect_ms_mean": repn.get("connect_ms_mean"),
         "cores": os.cpu_count(),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(_compile_fields(line)), flush=True)
+
+
+def bench_serve_fleet_hedged():
+    """Tail-tolerance line: a 2-host fleet (two localhost pseudo-hosts)
+    with an injected straggler — one host's workers stall every batch
+    via the ``serving.batch`` fault point — driven closed-loop twice,
+    hedging OFF then ON (same topology, fresh fleet each). The metric
+    value is the p99 cut (p99_unhedged / p99_hedged): the router's
+    straggler flags + canary probes + hedged requests should cut the
+    injected tail by >=3x. The line also carries the prediction-cache
+    split — hit-path vs compute-path p50 from the same loadgen harness
+    (hot_key_frac 1.0 vs 0.0) — the "cache in front of the batcher"
+    latency ratio. Env knobs: BENCH_FLEET_HEDGE_SECONDS (default 6 per
+    side), BENCH_FLEET_HEDGE_DELAY_S (0.25), BENCH_SERVE_CONCURRENCY
+    (16). BENCH_SKIP_SERVE=1 opts out with the other serving lines."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import loadgen
+
+    import jax
+
+    duration = float(os.environ.get("BENCH_FLEET_HEDGE_SECONDS", 6))
+    concurrency = int(os.environ.get("BENCH_SERVE_CONCURRENCY", 16))
+    delay_s = float(os.environ.get("BENCH_FLEET_HEDGE_DELAY_S", 0.25))
+    hosts = ["local",
+             {"name": "slow", "locality": "local",
+              "env": {"MXNET_TPU_FAULTS":
+                      f"serving.batch:delay@*:{delay_s}"}}]
+    cfg = {"interval": 0.3, "hedge_min_ms": 20.0}
+    rep_off = loadgen.run_fleet(workers=2, duration=duration,
+                                concurrency=concurrency,
+                                hosts=list(hosts),
+                                config=dict(cfg, hedge=0))
+    rep_on = loadgen.run_fleet(workers=2, duration=duration,
+                               concurrency=concurrency,
+                               hosts=list(hosts),
+                               config=dict(cfg, hedge=1))
+    # the cache split: hit-path p50 (every request re-sends ONE hot
+    # key) vs compute-path p50 (cache off), same in-process harness
+    cache_s = max(2.0, duration / 3)
+    rep_cold = loadgen.run_inproc(duration=cache_s, concurrency=4,
+                                  models=1)
+    rep_hot = loadgen.run_inproc(duration=cache_s, concurrency=4,
+                                 models=1, hot_key_frac=1.0)
+    p99_on, p99_off = rep_on.get("p99_ms"), rep_off.get("p99_ms")
+    hit_p50 = rep_hot.get("p50_ms")
+    compute_p50 = rep_cold.get("p50_ms")
+    line = {
+        "metric":
+            f"serving_fleet_hedged_2worker_closed{concurrency}",
+        "value": round(p99_off / p99_on, 3)
+        if p99_on and p99_off else None,
+        "unit": "x_p99_cut",
+        "p99_hedged_ms": p99_on,
+        "p99_unhedged_ms": p99_off,
+        "p50_hedged_ms": rep_on.get("p50_ms"),
+        "hedges": rep_on.get("hedges"),
+        "stragglers": rep_on.get("stragglers"),
+        "errors": (rep_on.get("errors") or 0)
+        + (rep_off.get("errors") or 0),
+        "straggler_delay_s": delay_s,
+        "cache_hit_p50_ms": hit_p50,
+        "compute_p50_ms": compute_p50,
+        "cache_speedup": round(compute_p50 / hit_p50, 2)
+        if hit_p50 and compute_p50 else None,
+        "cache_hit_ratio": rep_hot.get("cache_hit_ratio"),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(_compile_fields(line)), flush=True)

@@ -355,7 +355,7 @@ _SERVE_DEFAULTS = {"workers": None, "min": 1, "max": 4,
                    "policy": "least_loaded", "restarts": 5,
                    "backoff": 0.5, "backoff_cap": 30.0, "grace": 10.0,
                    "dead_after": 0.0, "http_port": 0, "warmup": True,
-                   "subscribe_to": None, "lineage": None}
+                   "subscribe_to": None, "lineage": None, "hosts": None}
 _BUS_DEFAULTS = {"dir": None, "keep": 0, "model": None}
 
 _ROLE_DEFAULTS = {"trainer-gang": _GANG_DEFAULTS,
@@ -411,6 +411,15 @@ def validate_spec(obj, base_dir=None):
                 cfg["workers"] = int(cfg["min"])
             cfg["workers"] = min(max(int(cfg["workers"]),
                                      int(cfg["min"])), int(cfg["max"]))
+            if cfg.get("hosts"):
+                from .serving import fleet as _fleet_mod
+
+                try:
+                    cfg["hosts"] = _fleet_mod.normalize_hosts(
+                        cfg["hosts"])
+                except ValueError as e:
+                    raise ClusterError(
+                        f"role {name!r}: bad hosts: {e}") from e
         for key in ("publish_to", "subscribe_to"):
             target = cfg.get(key)
             if target is not None and target not in buses:
@@ -997,6 +1006,21 @@ class _ServeRole(_Role):
         self._counters = {"requests": 0, "completed": 0, "retries": 0,
                           "rejects": 0, "errors": 0}
         self._count_lock = threading.Lock()
+        # multi-host placement: slot -> host is pure arithmetic
+        # (slot % len(hosts)), so it survives a supervisor crash with
+        # no extra world state
+        hosts = cfg.get("hosts")
+        if hosts and not isinstance(hosts[0], dict):
+            hosts = _fleet_mod.normalize_hosts(hosts)
+        self.hosts = hosts or None
+        if self.hosts:
+            for h in self.hosts:
+                h["run_dir"] = os.path.join(self.dir,
+                                            f"host-{h['name']}")
+                os.makedirs(h["run_dir"], exist_ok=True)
+        # hedged requests + straggler flags: same governor the
+        # standalone ServingFleet router uses (duck-typed surface)
+        self._hedge = _fleet_mod.HedgeGovernor(scfg, self._slot_locality)
         self._last_completed = None
         self._last_sample = {}
         self._router = None
@@ -1017,9 +1041,16 @@ class _ServeRole(_Role):
         self._rr += 1
         depths = {s: m.get("queue_depth") for s, m in
                   self._last_sample.get("per_worker", {}).items()}
-        return self._fleet_mod.order_candidates(
+        localities = None
+        if self.hosts:
+            localities = {s: self._slot_locality(s)
+                          for s in self._routable}
+        order = self._fleet_mod.order_candidates(
             self.cfg_fleet["policy"], model, self._routable,
-            depths=depths, rr=self._rr, ring=self._ring)
+            depths=depths, rr=self._rr, ring=self._ring,
+            localities=localities,
+            remote_penalty=self._hedge.remote_penalty())
+        return self._hedge.reorder(order, self._rr)
 
     def endpoint(self, slot):
         return self._endpoints.get(slot)
@@ -1033,10 +1064,29 @@ class _ServeRole(_Role):
         with self._count_lock:
             self._counters[key] = self._counters.get(key, 0) + n
 
+    def note_latency(self, slot, ms):
+        self._hedge.note(slot, ms)
+
+    def hedge_plan(self, slot, candidates):
+        return self._hedge.plan(slot, candidates, self.endpoint)
+
+    def _count_hedge(self, outcome):
+        self._hedge.count(outcome)
+
     def stats(self, light=False):
-        return {"name": self.name, "state": self.state,
-                "generation": self.generation, "desired": self.desired,
-                "ready": len(self._routable)}
+        out = {"name": self.name, "state": self.state,
+               "generation": self.generation, "desired": self.desired,
+               "ready": len(self._routable)}
+        if not light:
+            out.update(self._hedge.describe())
+            if self.hosts:
+                out["hosts"] = [
+                    {"name": h["name"], "ssh": h["ssh"],
+                     "locality": h["locality"],
+                     "slots": sorted(s for s in self.slots
+                                     if self._host_of(s) is h)}
+                    for h in self.hosts]
+        return out
 
     def models(self):
         from .serving import worker as _worker_mod
@@ -1077,12 +1127,33 @@ class _ServeRole(_Role):
                 pass
             self._router = None
 
+    def _host_of(self, slot):
+        if not self.hosts:
+            return None
+        return self.hosts[int(slot) % len(self.hosts)]
+
+    def _slot_locality(self, slot):
+        h = self._host_of(slot)
+        return h["locality"] if h else "local"
+
     def command_for(self, slot, generation):
+        host = self._host_of(slot)
         cmd = [sys.executable, "-m", "mxnet_tpu.serving.worker",
                "--model-dir", self.cfg["model_dir"],
                "--slot", str(slot), "--generation", str(generation)]
+        if host:
+            cmd += ["--run-dir", host["run_dir"],
+                    "--host", host["advertise"]]
         if not self.cfg.get("warmup", True):
             cmd.append("--no-warmup")
+        if host and host["ssh"]:
+            from . import elastic as _elastic_mod
+
+            renv = self.env_for(slot, generation)
+            renv["MXTPU_GANG_DIR"] = host["run_dir"]
+            renv.update(host["env"])
+            return _elastic_mod._ssh_argv(host["ssh"], renv, cmd,
+                                          cwd=host["cwd"])
         return cmd
 
     def env_for(self, slot, generation):
@@ -1095,6 +1166,13 @@ class _ServeRole(_Role):
         bus = self.cfg.get("subscribe_to")
         if bus:
             env["MXTPU_MODELBUS_DIR"] = self.sup.bus_dir(bus)
+        host = self._host_of(slot)
+        if host and not host["ssh"]:
+            # local pseudo-host: announces, heartbeats and telemetry
+            # shards land in the per-host subdir (merged at scrape)
+            env["MXTPU_GANG_DIR"] = host["run_dir"]
+            env["MXTPU_FLEET_DIR"] = host["run_dir"]
+            env.update(host["env"])
         return env
 
     def evidence_for(self, slot):
@@ -1110,13 +1188,18 @@ class _ServeRole(_Role):
                 if ann.get("state") != "drained"}
 
     def _gate(self, anns):
-        """Routable slots: alive + announce-gated + pid-matching."""
+        """Routable slots: alive + announce-gated + pid-matching.
+        pid equality is relaxed for ssh-placed slots: the announce pid
+        is the remote worker's, our census pid is the ssh client's."""
         out = []
         for slot, s in self.slots.items():
             ann = anns.get(slot)
+            host = self._host_of(slot)
+            pid_ok = (ann or {}).get("pid") == s.pid \
+                or bool(host and host["ssh"])
             if s.state in ("running", "starting") and s.alive() \
                     and self._fleet_mod.gate_ready(ann) \
-                    and ann.get("pid") == s.pid \
+                    and pid_ok \
                     and ann.get("generation") == s.generation:
                 out.append(slot)
                 self._endpoints[slot] = (ann.get("host", "127.0.0.1"),
@@ -1138,6 +1221,7 @@ class _ServeRole(_Role):
                          if t > now}
         self._routable = [s for s in ready if s not in self._suspect] \
             or ready
+        self._hedge.update_stragglers(self._routable)
         if self.cfg_fleet["policy"] == "hash":
             self._ring.rebuild(self._routable)
         metrics = self._fleet_mod.worker_metrics(

@@ -22,6 +22,11 @@ through the stack:
                        watchdog deadline converts into a crash bundle +
                        failed batch (server keeps serving), ``preempt``
                        the SIGTERM-mid-load drain drill
+    ``serving.route``  every fleet-router dispatch, BEFORE a candidate
+                       worker is picked (serving/fleet.py) — ``delay``
+                       slows a route (straggler/hedge-threshold drills),
+                       ``raise`` surfaces as a router 500 to the client
+                       without touching any worker
     ``modelbus.publish``  every bus record publish (modelbus.py), fired
                        AFTER the finite gate — ``nan`` poisons the
                        record's first parameter (in-transit corruption

@@ -57,19 +57,22 @@ Knobs: the ``MXNET_TPU_SERVING`` env grammar / :func:`configure` (see
     server.drain()                        # answer admitted, stop
 """
 from .config import configure, configure_from_env, describe, effective
-from .errors import (ModelNotFound, RequestError, RequestTimeout,
-                     ServerBusyError, ServerDrainingError, ServingError)
+from .errors import (DeadlineExceeded, ModelNotFound, RequestError,
+                     RequestTimeout, ServerBusyError, ServerDrainingError,
+                     ServingError)
 from .metrics import ModelMetrics
 from .model import ModelContainer, ServedModel
-from .batcher import BucketBatcher, ServingFuture
+from .cache import PredictionCache, content_key
+from .batcher import BucketBatcher, ServingFuture, PRIORITIES
 from .server import ModelServer, live_servers, live_stats
 
 __all__ = [
     "configure", "configure_from_env", "describe", "effective",
     "ServingError", "ModelNotFound", "ServerBusyError",
     "ServerDrainingError", "RequestError", "RequestTimeout",
-    "ModelMetrics", "ModelContainer", "ServedModel", "BucketBatcher",
-    "ServingFuture", "ModelServer", "live_servers", "live_stats",
+    "DeadlineExceeded", "ModelMetrics", "ModelContainer", "ServedModel",
+    "PredictionCache", "content_key", "BucketBatcher", "ServingFuture",
+    "PRIORITIES", "ModelServer", "live_servers", "live_stats",
     "HttpFrontEnd", "ServingFleet", "FleetError",
 ]
 

@@ -24,6 +24,30 @@ Admission control: ``submit`` fast-rejects with
 queue-depth bound is hit (429 semantics — shed load, don't queue
 unboundedly) and with :class:`ServerDrainingError` once a drain started.
 
+QoS + deadlines: requests carry a **priority class** (``interactive`` /
+``batch``) and an optional **deadline**. The collector always drains
+interactive requests first and lets batch traffic fill the leftover
+bucket capacity, so under overload batch starves before interactive p99
+degrades; the admission bound is likewise partitioned (batch rows count
+against the whole queue bound, interactive admission ignores the batch
+backlog). Deadline-carrying requests that *provably* cannot meet their
+deadline are dropped with :class:`DeadlineExceeded` BEFORE consuming a
+batch slot — at submit time when the measured batch-execution estimate
+already overshoots, and again at collect time when the deadline expired
+(or the estimate overshoots) while the request waited.
+
+Prediction cache: with ``serving.config`` ``cache:1`` a
+content-addressed :class:`~mxnet_tpu.serving.cache.PredictionCache`
+(key = model name x served version x input bytes) sits in front of
+admission — a hit fulfils the future on the submit thread without
+touching the queue or the device, and content-identical requests whose
+leader is already queued/in flight attach as **followers** fulfilled by
+the leader's batch (so a duplicated request — a hedge landing on the
+same worker, a retry — never double-runs a donating batch). Entries are
+only inserted when the executing version matches the version the key
+was built under, so a model-bus version flip can never serve stale
+predictions: the old generation's keys simply stop being generated.
+
 Tracing: when :mod:`mxnet_tpu.telemetry.trace` is on, every request
 carries a :class:`~mxnet_tpu.telemetry.trace.RequestTrace` on its
 future — the collector/runner stamp pipeline marks (popped, padded,
@@ -48,12 +72,15 @@ from collections import deque
 import numpy as _np
 
 from . import config as _config
+from . import cache as _pcache
 from ..telemetry import trace as _trace
-from .errors import (RequestError, RequestTimeout, ServerBusyError,
-                     ServerDrainingError)
+from .errors import (DeadlineExceeded, RequestError, RequestTimeout,
+                     ServerBusyError, ServerDrainingError)
 from .metrics import ModelMetrics
 
-__all__ = ["ServingFuture", "BucketBatcher"]
+__all__ = ["ServingFuture", "BucketBatcher", "PRIORITIES"]
+
+PRIORITIES = ("interactive", "batch")
 
 
 class ServingFuture:
@@ -62,9 +89,10 @@ class ServingFuture:
     ``timeout_ms`` default applies."""
 
     __slots__ = ("model", "t_submit", "t_done", "_event", "_result",
-                 "_error", "_trace", "model_version")
+                 "_error", "_trace", "model_version", "priority",
+                 "deadline_ms", "cache_hit")
 
-    def __init__(self, model):
+    def __init__(self, model, priority="interactive", deadline_ms=None):
         self.model = model
         self.t_submit = time.monotonic()
         self.t_done = None
@@ -75,6 +103,9 @@ class ServingFuture:
         # the model-bus version the answering batch executed under
         # (stamped at fulfilment; None until then / on failure)
         self.model_version = None
+        self.priority = priority
+        self.deadline_ms = deadline_ms
+        self.cache_hit = False   # answered from the prediction cache
 
     def done(self):
         return self._event.is_set()
@@ -122,19 +153,26 @@ class ServingFuture:
 
 
 class _Request:
-    __slots__ = ("arr", "n", "fut")
+    __slots__ = ("arr", "n", "fut", "deadline", "key", "key_version",
+                 "followers")
 
-    def __init__(self, arr, n, fut):
+    def __init__(self, arr, n, fut, deadline=None, key=None,
+                 key_version=None):
         self.arr = arr
         self.n = n
         self.fut = fut
+        self.deadline = deadline       # absolute monotonic, or None
+        self.key = key                 # prediction-cache content key
+        self.key_version = key_version  # served version the key names
+        self.followers = []            # deduped futures riding this one
 
 
 class BucketBatcher:
     """The per-model queue + continuous-batching worker pair."""
 
     def __init__(self, model, metrics=None, max_queue=None,
-                 max_wait_ms=None, stage=None):
+                 max_wait_ms=None, stage=None, cache=None,
+                 cache_entries=None):
         cfg = _config.effective()
         self.model = model
         self.metrics = metrics or ModelMetrics(model.name)
@@ -142,10 +180,18 @@ class BucketBatcher:
                               else max_queue)
         self._max_wait = (cfg["max_wait_ms"] if max_wait_ms is None
                           else float(max_wait_ms)) / 1e3
-        self._queue = deque()
-        self._rows = 0           # rows waiting (the admission bound)
+        self._qi = deque()       # interactive: always drained first
+        self._qb = deque()       # batch: fills leftover bucket capacity
+        self._rows = 0           # total rows waiting (the batch bound)
+        self._rows_i = 0         # interactive rows waiting (its own bound)
         self._inflight = 0       # batches popped but not yet finished
         self._cond = threading.Condition()
+        self._leaders = {}       # content key -> queued/in-flight _Request
+        self._est_ms = None      # EWMA batch-execution estimate
+        use_cache = cfg["cache"] if cache is None else bool(cache)
+        self.cache = _pcache.PredictionCache(
+            cfg["cache_entries"] if cache_entries is None
+            else cache_entries) if use_cache else None
         self._staged = _qmod.Queue(maxsize=1)
         self._draining = False
         self._stopping = False
@@ -206,7 +252,7 @@ class BucketBatcher:
         end = time.monotonic() + timeout
         while time.monotonic() < end:
             with self._cond:
-                if not self._queue and self._inflight == 0:
+                if not self._qi and not self._qb and self._inflight == 0:
                     return True
             time.sleep(0.005)
         return False
@@ -223,64 +269,164 @@ class BucketBatcher:
             t.join(timeout=timeout)
         self._threads = ()
         with self._cond:
-            leftovers = list(self._queue)
-            self._queue.clear()
+            leftovers = list(self._qi) + list(self._qb)
+            self._qi.clear()
+            self._qb.clear()
             self._rows = 0
+            self._rows_i = 0
+            self._leaders.clear()
         for r in leftovers:
-            r.fut._fail(ServerDrainingError(self.model.name, "stopped"))
-            self.metrics.record_fail()
+            err = ServerDrainingError(self.model.name, "stopped")
+            for fut in (r.fut, *r.followers):
+                fut._fail(err)
+                self.metrics.record_fail()
 
     # ------------------------------------------------------------ submit --
-    def submit(self, arr):
-        """Admit one request (fast-reject on a full queue or a draining
-        server) and return its :class:`ServingFuture`."""
+    def submit(self, arr, priority="interactive", deadline_ms=None):
+        """Admit one request (fast-reject on a full queue, a draining
+        server, or a provably unmeetable deadline) and return its
+        :class:`ServingFuture`. ``priority`` picks the QoS class
+        (interactive is drained first; batch fills leftover capacity and
+        is the first to starve under overload); ``deadline_ms`` bounds
+        how stale an answer is still useful — a request that cannot meet
+        it is dropped before consuming a batch slot."""
         arr = self.model.validate(arr)
+        if priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r}: expected "
+                             f"one of {PRIORITIES}")
         n = arr.shape[0]
-        fut = ServingFuture(self.model.name)
+        deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        fut = ServingFuture(self.model.name, priority=priority,
+                            deadline_ms=deadline_ms)
         if _trace.enabled():
             # propagated context: the HTTP front end binds X-Request-Id
             # on this thread; in-process callers get a fresh id
             fut._trace = _trace.request_begin(self.model.name, rows=n)
+        deadline = (fut.t_submit + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        key = key_version = None
+        if self.cache is not None:
+            key_version = self.model.version
+            self.cache.observe_version(key_version)
+            key = _pcache.content_key(self.model.name, key_version, arr)
+            hit = self.cache.get(key)
+            self.metrics.record_cache(hit is not None)
+            if hit is not None:
+                # hit path: fulfilled on the submit thread, no queue, no
+                # device — this is the >=10x-faster-than-compute path
+                self.metrics.record_submit()
+                fut.cache_hit = True
+                fut.model_version = key_version
+                fut._fulfill(hit)
+                if fut._trace is not None:
+                    fut._trace.finish()
+                self.metrics.record_complete(fut.latency_ms(), priority)
+                if deadline_ms is not None:
+                    self.metrics.record_deadline_outcome(True)
+                return fut
+        if deadline_ms is not None and self._est_ms is not None \
+                and deadline_ms < self._est_ms:
+            # provably doomed: even dispatched immediately, the measured
+            # batch execution alone overshoots the deadline
+            self.metrics.record_deadline_drop("submit")
+            raise DeadlineExceeded(self.model.name, deadline_ms,
+                                   self._est_ms, where="submit")
         with self._cond:
             if self._draining or self._stopping:
                 self.metrics.record_reject()
                 raise ServerDrainingError(self.model.name)
-            if self._rows + n > self._max_queue:
+            if key is not None:
+                leader = self._leaders.get(key)
+                if leader is not None:
+                    # content-identical request already queued/in flight:
+                    # ride the donating batch instead of re-running it
+                    leader.followers.append(fut)
+                    self.metrics.record_coalesced()
+                    self.metrics.record_submit()
+                    return fut
+            bound_rows = self._rows_i if priority == "interactive" \
+                else self._rows
+            if bound_rows + n > self._max_queue:
                 self.metrics.record_reject()
-                raise ServerBusyError(self.model.name, self._rows,
+                raise ServerBusyError(self.model.name, bound_rows,
                                       self._max_queue)
-            self._queue.append(_Request(arr, n, fut))
+            req = _Request(arr, n, fut, deadline=deadline, key=key,
+                           key_version=key_version)
+            if priority == "interactive":
+                self._qi.append(req)
+                self._rows_i += n
+            else:
+                self._qb.append(req)
             self._rows += n
+            if key is not None:
+                self._leaders[key] = req
             self._cond.notify_all()
         self.metrics.record_submit()
         return fut
 
     # --------------------------------------------------------- collector --
+    def _doomed(self, r, now):
+        """True when `r` provably cannot meet its deadline: it already
+        expired, or the measured batch-execution estimate overshoots the
+        time it has left. Checked at pop time, BEFORE a batch slot."""
+        if r.deadline is None:
+            return False
+        if now >= r.deadline:
+            return True
+        return (self._est_ms is not None
+                and now + self._est_ms / 1e3 > r.deadline)
+
+    def _drop_doomed_locked(self, r):
+        """Fail one popped-but-doomed request (and its followers) with
+        DeadlineExceeded — its rows were already uncounted by the pop,
+        so no batch slot is consumed. _cond held."""
+        if r.key is not None and self._leaders.get(r.key) is r:
+            del self._leaders[r.key]
+        err = DeadlineExceeded(self.model.name, r.fut.deadline_ms,
+                               self._est_ms, where="queue")
+        for fut in (r.fut, *r.followers):
+            fut._fail(err)
+            if fut._trace is not None:
+                fut._trace.finish(error="DeadlineExceeded")
+            self.metrics.record_deadline_drop("queue")
+
     def _collect(self):
         """Pop one coalesced batch (requests, rows) under the admission
-        deadline, or None when stopping."""
+        deadline, or None when stopping. Interactive requests pop first;
+        batch traffic fills whatever bucket capacity is left — the
+        starvation order the QoS contract promises."""
         with self._cond:
             while True:
-                while not self._queue:
+                while not self._qi and not self._qb:
                     if self._stopping:
                         return None
                     self._cond.wait(timeout=0.1)
                 cap = self.model.max_bucket
-                deadline = self._queue[0].fut.t_submit + self._max_wait
-                while (self._queue and self._rows < cap
+                head = self._qi[0] if self._qi else self._qb[0]
+                deadline = head.fut.t_submit + self._max_wait
+                while ((self._qi or self._qb) and self._rows < cap
                        and not self._stopping and not self._draining):
                     now = time.monotonic()
                     if now >= deadline:
                         break
                     self._cond.wait(timeout=min(deadline - now, 0.05))
-                if self._queue:
-                    break  # else: raced with stop()'s clear; re-wait
-            reqs, rows = [], 0
-            while self._queue and rows + self._queue[0].n <= cap:
-                r = self._queue.popleft()
-                reqs.append(r)
-                rows += r.n
-            self._rows -= rows
+                reqs, rows = [], 0
+                now = time.monotonic()
+                for q, interactive in ((self._qi, True), (self._qb, False)):
+                    while q and rows + q[0].n <= cap:
+                        r = q.popleft()
+                        self._rows -= r.n
+                        if interactive:
+                            self._rows_i -= r.n
+                        if self._doomed(r, now):
+                            self._drop_doomed_locked(r)
+                            continue
+                        reqs.append(r)
+                        rows += r.n
+                if reqs:
+                    break  # else: every pop was doomed (or stop() raced)
+                if self._stopping and not self._qi and not self._qb:
+                    return None
             self._inflight += 1
             t_pop = time.monotonic()
             for r in reqs:   # queue_wait ends here for the whole batch
@@ -328,12 +474,25 @@ class BucketBatcher:
                         return
 
     # ------------------------------------------------------------ runner --
+    def _retire_leaders(self, reqs):
+        """Unregister each request's content key BEFORE fulfilment so no
+        new follower can attach to a request whose followers list is
+        being drained (attach happens under the same lock)."""
+        with self._cond:
+            for r in reqs:
+                if r.key is not None and self._leaders.get(r.key) is r:
+                    del self._leaders[r.key]
+
     def _fail_batch(self, reqs, err):
+        self._retire_leaders(reqs)
+        n = 0
         for r in reqs:
-            r.fut._fail(err)
-            if r.fut._trace is not None:
-                r.fut._trace.finish(error=type(err).__name__)
-        self.metrics.record_fail(len(reqs))
+            for fut in (r.fut, *r.followers):
+                fut._fail(err)
+                if fut._trace is not None:
+                    fut._trace.finish(error=type(err).__name__)
+                n += 1
+        self.metrics.record_fail(n)
         with self._cond:
             self._inflight -= 1
             self._cond.notify_all()
@@ -376,18 +535,35 @@ class BucketBatcher:
                 continue
             t_run_end = time.monotonic()
             dur_ms = (t_run_end - t0) * 1e3
+            # EWMA execution estimate feeding deadline admission (the
+            # "provably cannot meet" proof needs a measured floor)
+            self._est_ms = dur_ms if self._est_ms is None \
+                else 0.8 * self._est_ms + 0.2 * dur_ms
+            self._retire_leaders(reqs)
             off = 0
             now = t_run_end
             for r in reqs:
                 sliced = [o[off:off + r.n] for o in outs]
+                value = sliced[0] if len(sliced) == 1 else sliced
                 if r.fut._trace is not None:
                     r.fut._trace.mark("run_end", t_run_end)
-                r.fut.model_version = model_version
-                r.fut._fulfill(sliced[0] if len(sliced) == 1 else sliced)
-                if r.fut._trace is not None:
-                    r.fut._trace.finish(bucket=bucket)
+                if self.cache is not None and r.key is not None \
+                        and model_version == r.key_version:
+                    # insert only when the executing version matches the
+                    # version the key names — a flip mid-flight must
+                    # never populate the new generation with old math
+                    self.cache.put(r.key, value, model_version)
+                for fut in (r.fut, *r.followers):
+                    fut.model_version = model_version
+                    fut._fulfill(value)
+                    if fut._trace is not None:
+                        fut._trace.finish(bucket=bucket)
+                    self.metrics.record_complete(
+                        (now - fut.t_submit) * 1e3, fut.priority)
+                    if fut.deadline_ms is not None:
+                        self.metrics.record_deadline_outcome(
+                            (now - fut.t_submit) * 1e3 <= fut.deadline_ms)
                 off += r.n
-                self.metrics.record_complete((now - r.fut.t_submit) * 1e3)
             self.metrics.record_batch(bucket, rows, dur_ms,
                                       self.queue_depth())
             with self._cond:

@@ -21,6 +21,12 @@ Entries are separated by ``,`` or ``;``::
                           client wait is bounded (default 30000)
     stage:<0|1>           device-put staging thread (h2d overlaps the
                           in-flight compiled batch; default 1)
+    cache:<0|1>           content-addressed prediction cache in front of
+                          the batcher (key = model-version x input bytes,
+                          invalidated when the served version flips;
+                          default 0 — enable for hot-key traffic)
+    cache_entries:<N>     bounded LRU capacity of the prediction cache
+                          per model (default 4096)
 
 Examples::
 
@@ -44,6 +50,8 @@ DEFAULTS = {
     "max_wait_ms": 2.0,
     "timeout_ms": 30000.0,
     "stage": True,
+    "cache": False,
+    "cache_entries": 4096,
 }
 
 _lock = threading.Lock()
@@ -71,7 +79,7 @@ def _coerce(key, val):
         if not buckets or any(b < 1 for b in buckets):
             raise ValueError(f"bad serving buckets {val!r}")
         return buckets
-    if key in ("max_queue",):
+    if key in ("max_queue", "cache_entries"):
         n = int(val)
         if n < 1:
             raise ValueError(f"serving {key} must be >= 1, got {n}")
@@ -81,7 +89,7 @@ def _coerce(key, val):
         if f < 0:
             raise ValueError(f"serving {key} must be >= 0, got {f}")
         return f
-    if key == "stage":
+    if key in ("stage", "cache"):
         if isinstance(val, str):
             return val.strip().lower() not in ("0", "false", "off", "no")
         return bool(val)

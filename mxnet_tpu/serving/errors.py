@@ -9,7 +9,8 @@ instead of a hung caller).
 from __future__ import annotations
 
 __all__ = ["ServingError", "ModelNotFound", "ServerBusyError",
-           "ServerDrainingError", "RequestError", "RequestTimeout"]
+           "ServerDrainingError", "RequestError", "RequestTimeout",
+           "DeadlineExceeded"]
 
 
 class ServingError(RuntimeError):
@@ -66,3 +67,26 @@ class RequestTimeout(ServingError):
     """ServingFuture.result() deadline expired before the response
     arrived. The request may still complete server-side; the client-side
     wait is bounded by construction."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's *own* deadline cannot be met, so it was dropped
+    BEFORE consuming a batch slot (HTTP 504 analogue, but cheap: no
+    compute was wasted on an answer nobody is waiting for). Raised at
+    submit time when the estimated batch latency already overshoots the
+    deadline, or by the collector when the deadline expired while the
+    request sat in the queue. Attributes: ``model``, ``deadline_ms``,
+    ``estimate_ms`` (what the batcher thought it would take, when
+    known), ``where`` (``"submit"`` | ``"queue"``)."""
+
+    def __init__(self, model, deadline_ms, estimate_ms=None, where="queue"):
+        self.model = model
+        self.deadline_ms = deadline_ms
+        self.estimate_ms = estimate_ms
+        self.where = where
+        est = (f"; estimated completion {estimate_ms:.1f}ms"
+               if estimate_ms is not None else "")
+        super().__init__(
+            f"model {model!r} request dropped at {where}: cannot meet "
+            f"{deadline_ms:.1f}ms deadline{est} (HTTP 504 analogue, "
+            "no batch slot was consumed)")

@@ -60,6 +60,38 @@ pieces the stack already has:
     dead_after:<F>     heartbeat-silence kill threshold (30; 0 off)
     restarts:<N>       per-slot restart budget (5)
     timeout_ms:<F>     router upstream request deadline (30000)
+    hedge:<0|1>        hedged requests: re-issue a straggling in-flight
+                       request to a second worker after the hedge
+                       threshold, first answer wins (default 1)
+    hedge_factor:<F>   hedge threshold = router p99 x this factor (2.0)
+    hedge_min_ms:<F>   hedge threshold floor — also the threshold used
+                       against a flagged persistent-straggler worker (20)
+    slo_ms:<F>         target p99 SLO: when set (> 0) the autoscaler
+                       scales on p99-vs-SLO headroom (pressure at p99 >=
+                       80% of the SLO) instead of raw queue depth /
+                       fill; 0 keeps the queue-depth policy (default 0)
+
+Multi-host: pass ``hosts=[...]`` to place workers across machines — each
+entry is a name (``"local"``), an ssh destination (``"user@h2"``), or a
+dict ``{name, ssh, cwd, env, advertise, locality}``. Remote workers are
+launched through the same ssh path the gang supervisor uses
+(:func:`mxnet_tpu.elastic._ssh_argv`); every host gets its own run
+(sub)dir — heartbeats and telemetry shards are merged at scrape — and
+the router becomes locality-aware: local workers are preferred, remote
+ones take the spill with a measured latency penalty. The 2-host chaos
+drill runs two "hosts" on localhost with distinct run dirs; a genuinely
+remote host needs this repo importable at the same path (shared
+filesystem or an rsynced checkout) and the run dir on shared storage.
+
+Hedging semantics (docs/SERVING.md "Planet scale"): only the FIRST
+attempt hedges, and only when the primary is merely *slow* — a primary
+that fails fast takes the ordinary failover path, and a primary that
+hits the upstream timeout without a hedge already in flight is NEVER
+hedged after the fact (the batch may be running; "zero dropped admitted
+requests" forbids re-issuing). First answer wins; the loser's connection
+is closed (the worker still answers its donating batch — content-keyed
+in-flight dedupe on the worker makes the duplicate free when both copies
+land on one worker).
 
 Quick start::
 
@@ -80,6 +112,7 @@ per-rank re-exports from :mod:`mxnet_tpu.telemetry.fleet`), and
 """
 from __future__ import annotations
 
+import collections
 import hashlib
 import http.client
 import json
@@ -98,8 +131,9 @@ from .errors import ServingError
 
 __all__ = ["ServingFleet", "FleetError", "Autoscaler", "HashRing",
            "order_candidates", "gate_ready", "worker_metrics",
-           "configure", "effective", "describe", "live_fleets",
-           "DEFAULTS", "ENV", "POLICIES"]
+           "hedged_call", "normalize_hosts", "HedgeGovernor",
+           "configure", "effective",
+           "describe", "live_fleets", "DEFAULTS", "ENV", "POLICIES"]
 
 _logger = _log.get_logger("mxnet_tpu.serving.fleet")
 
@@ -126,12 +160,17 @@ DEFAULTS = {
     "dead_after": 30.0,
     "restarts": 5,
     "timeout_ms": 30000.0,
+    "hedge": 1,
+    "hedge_factor": 2.0,
+    "hedge_min_ms": 20.0,
+    "slo_ms": 0.0,
 }
 
-_INT_KEYS = ("min", "max", "up_queue", "k", "idle_k", "restarts")
+_INT_KEYS = ("min", "max", "up_queue", "k", "idle_k", "restarts", "hedge")
 _FLOAT_KEYS = ("up_p99_ms", "up_fill", "idle_rps", "cooldown", "interval",
                "beat", "ready_timeout", "drain_timeout", "grace",
-               "dead_after", "timeout_ms")
+               "dead_after", "timeout_ms", "hedge_factor", "hedge_min_ms",
+               "slo_ms")
 
 _cfg_lock = threading.Lock()
 _CFG: dict | None = None
@@ -235,6 +274,13 @@ def describe() -> dict:
     return out
 
 
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
 # ------------------------------------------------------- routing policies --
 
 def _hash32(s):
@@ -279,7 +325,8 @@ class HashRing:
         return None
 
 
-def order_candidates(policy, model, slots, depths=None, rr=0, ring=None):
+def order_candidates(policy, model, slots, depths=None, rr=0, ring=None,
+                     localities=None, remote_penalty=0.0):
     """Order the routable `slots` for one request: the head is the
     placement choice, the tail is the failover order.
 
@@ -290,20 +337,41 @@ def order_candidates(policy, model, slots, depths=None, rr=0, ring=None):
     * ``hash`` — the consistent-hash owner of `model` first, the rest
       rotated.
     * ``round_robin`` — rotation by the request counter.
+
+    Locality: with ``localities`` (``{slot: "local"|"remote"}``) the
+    router prefers local/ICI workers and spills to remote/DCN ones with
+    a MEASURED penalty — ``remote_penalty`` is the observed extra cost
+    of a remote hop expressed in queue-rows equivalents (extra latency /
+    local service time), so a remote worker only wins the placement when
+    it is more than that many rows *less* loaded. Non-depth policies
+    stable-partition local candidates first (the hash owner still wins
+    its key: determinism beats locality for affinity routing).
     """
     slots = list(slots)
     if not slots:
         return []
+
+    def _remote(s):
+        return localities is not None and localities.get(s) == "remote"
+
     k = rr % len(slots)
     rotated = slots[k:] + slots[:k]
     if policy == "hash" and ring is not None:
         primary = ring.lookup(model, allowed=set(slots))
+        rest = [s for s in rotated if s != primary]
+        if localities:
+            rest = [s for s in rest if not _remote(s)] + \
+                [s for s in rest if _remote(s)]
         if primary is None:
-            return rotated
-        return [primary] + [s for s in rotated if s != primary]
+            return rest
+        return [primary] + rest
     if policy == "least_loaded" and depths \
             and any(depths.get(s) is not None for s in slots):
-        return sorted(rotated, key=lambda s: depths.get(s) or 0)
+        return sorted(rotated, key=lambda s: (depths.get(s) or 0)
+                      + (remote_penalty if _remote(s) else 0.0))
+    if localities:
+        return [s for s in rotated if not _remote(s)] + \
+            [s for s in rotated if _remote(s)]
     return rotated
 
 
@@ -316,6 +384,339 @@ def gate_ready(announce):
             and announce.get("state") == "serving"
             and bool(announce.get("ready"))
             and int(announce.get("pending_compiles") or 0) == 0)
+
+
+# ------------------------------------------------------------- hedging ----
+
+def hedged_call(primary, hedge, hedge_after, timeout=None):
+    """The hedged-request core, pure threading so it table-tests:
+    run ``primary()`` on a worker thread; when it has not answered
+    within ``hedge_after`` seconds, issue ``hedge()`` too — the first
+    SUCCESSFUL answer wins and the loser is abandoned (the caller closes
+    the loser's connection; its thread drains into the result record).
+
+    The retry/timeout contract is preserved by construction:
+
+    * a primary that FINISHES (success or error) before the threshold is
+      returned as-is, un-hedged — fast failures take the ordinary
+      failover path, hedging only covers the slow-but-alive case;
+    * once the hedge is in flight, a primary error (including a timeout)
+      legally waits for the already-issued hedge — nothing NEW is ever
+      issued after a failure;
+    * both failing reports the primary's error (so an upstream timeout
+      still surfaces as the 504 the no-replay rule demands).
+
+    Returns a record — never raises::
+
+        {"winner": "primary"|"hedge"|None, "value": ..., "hedged": bool,
+         "primary_error": exc|None, "hedge_error": exc|None}
+    """
+    cond = threading.Condition()
+    state = {}
+
+    def run(which, fn):
+        try:
+            out = (True, fn())
+        except BaseException as e:     # noqa: BLE001 — recorded, not lost
+            out = (False, e)
+        with cond:
+            state[which] = out
+            cond.notify_all()
+
+    def rec(winner=None, value=None, hedged=False):
+        prim, hed = state.get("primary"), state.get("hedge")
+        return {"winner": winner, "value": value, "hedged": hedged,
+                "primary_error": prim[1] if prim and not prim[0] else None,
+                "hedge_error": hed[1] if hed and not hed[0] else None}
+
+    threading.Thread(target=run, args=("primary", primary),
+                     daemon=True, name="mxtpu-hedge-primary").start()
+    with cond:
+        cond.wait_for(lambda: "primary" in state, timeout=hedge_after)
+        prim = state.get("primary")
+    if prim is not None:
+        # answered (or failed) before the threshold: no hedge issued
+        if prim[0]:
+            return rec(winner="primary", value=prim[1])
+        return rec()
+    threading.Thread(target=run, args=("hedge", hedge),
+                     daemon=True, name="mxtpu-hedge-secondary").start()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with cond:
+        while True:
+            prim, hed = state.get("primary"), state.get("hedge")
+            if prim is not None and prim[0]:
+                return rec(winner="primary", value=prim[1], hedged=True)
+            if hed is not None and hed[0]:
+                return rec(winner="hedge", value=hed[1], hedged=True)
+            if prim is not None and hed is not None:
+                return rec(hedged=True)    # both failed: primary's error
+            left = None if deadline is None else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                return rec(hedged=True)    # caller's backstop expired
+            cond.wait(timeout=0.25 if left is None else min(left, 0.25))
+
+
+# ------------------------------------------------------------ multi-host --
+
+def normalize_hosts(hosts):
+    """Canonicalise the ``hosts=`` argument into placement records::
+
+        {name, ssh (None = spawn locally), cwd, env, advertise,
+         locality ("local" | "remote")}
+
+    Accepted entries: a plain name (``"local"`` / ``"localhost"`` spawn
+    locally; anything else is an ssh destination), or a dict with any of
+    the keys above. ``advertise`` is the address the worker binds (and
+    announces) its HTTP port on — remote hosts default to their ssh host
+    part so the router can reach them; local ones stay on loopback."""
+    out = []
+    seen = set()
+    for i, spec in enumerate(hosts or ()):
+        if isinstance(spec, str):
+            if spec.strip().lower() in ("local", "localhost", "127.0.0.1"):
+                spec = {"name": spec.strip().lower()}
+            else:
+                spec = {"ssh": spec.strip()}
+        elif not isinstance(spec, dict):
+            raise ValueError(f"bad fleet host spec {spec!r}: expected a "
+                             "name/ssh string or a dict")
+        else:
+            spec = dict(spec)
+        bad = set(spec) - {"name", "ssh", "cwd", "env", "advertise",
+                           "locality"}
+        if bad:
+            raise ValueError(f"bad fleet host spec keys {sorted(bad)}; "
+                             "expected name/ssh/cwd/env/advertise/locality")
+        ssh = spec.get("ssh")
+        name = spec.get("name") or \
+            (re.sub(r"[^A-Za-z0-9_.-]", "_", ssh) if ssh else f"host{i}")
+        if name in seen:
+            raise ValueError(f"duplicate fleet host name {name!r}")
+        seen.add(name)
+        locality = spec.get("locality") or ("remote" if ssh else "local")
+        if locality not in ("local", "remote"):
+            raise ValueError(f"bad fleet host locality {locality!r}: "
+                             "expected 'local' or 'remote'")
+        advertise = spec.get("advertise") or \
+            ((ssh.rsplit("@", 1)[-1] if ssh else "127.0.0.1"))
+        out.append({"name": str(name), "ssh": ssh,
+                    "cwd": spec.get("cwd"),
+                    "env": dict(spec.get("env") or {}),
+                    "advertise": advertise, "locality": locality})
+    return out
+
+
+class _HostPlane:
+    """N per-host :class:`~mxnet_tpu.elastic.ServingSupervisor`\\ s
+    behind the single-supervisor surface the fleet drives: every call
+    routes by the fleet's slot->host assignment, census/slots/events
+    merge (slot ids are globally unique, so a union is exact)."""
+
+    def __init__(self, sups, slot_host):
+        self._sups = sups          # {host name: ServingSupervisor}
+        self._slot_host = slot_host  # the fleet's live slot->host map
+
+    def _for(self, slot):
+        return self._sups[self._slot_host[slot]]
+
+    def spawn(self, slot, generation):
+        return self._for(slot).spawn(slot, generation)
+
+    def drain_slot(self, slot, reason=""):
+        return self._for(slot).drain_slot(slot, reason=reason)
+
+    def kill_slot(self, slot):
+        return self._for(slot).kill_slot(slot)
+
+    def poll(self):
+        out = {}
+        for sup in self._sups.values():
+            out.update(sup.poll())
+        return out
+
+    def census(self):
+        out = {}
+        for sup in self._sups.values():
+            out.update(sup.census())
+        return out
+
+    def stop_all(self, graceful=True, timeout=None):
+        for sup in self._sups.values():
+            sup.stop_all(graceful=graceful, timeout=timeout)
+
+    @property
+    def slots(self):
+        out = {}
+        for sup in self._sups.values():
+            out.update(sup.slots)
+        return out
+
+    @property
+    def events(self):
+        out = []
+        for sup in self._sups.values():
+            out.extend(sup.events)
+        return sorted(out, key=lambda ev: ev.get("t_wall", 0.0))
+
+    @property
+    def restarts_total(self):
+        return sum(s.restarts_total for s in self._sups.values())
+
+    @property
+    def drained_total(self):
+        return sum(s.drained_total for s in self._sups.values())
+
+
+class HedgeGovernor:
+    """Router-side latency book-keeping + hedge planning, shared by
+    :class:`ServingFleet` and the cluster reconciler's serving-fleet
+    role (both drive the same ``_RouterFront``): the p99 ring feeding
+    the hedge threshold, per-slot EWMAs feeding persistent-straggler
+    flags (same env knobs as the gang detector —
+    ``MXNET_TPU_STRAGGLER_FACTOR`` / ``_PERSIST``), per-locality EWMAs
+    feeding the remote spill penalty, and the fired/won/lost/failed
+    counters. Pure state + arithmetic, so it table-tests."""
+
+    def __init__(self, cfg, locality_of=None):
+        self.cfg = cfg
+        self._locality_of = locality_of or (lambda slot: "local")
+        self._lock = threading.Lock()
+        self.ring = collections.deque(maxlen=512)
+        self._slot_ewma = {}       # slot -> (ewma_ms, samples)
+        self._loc_ewma = {}        # locality -> ewma_ms
+        self._streak = {}
+        self.stragglers = frozenset()
+        self.counters = {"fired": 0, "won": 0, "lost": 0, "failed": 0}
+
+    def note(self, slot, ms):
+        """One completed router request against `slot` took `ms`
+        end-to-end."""
+        ms = float(ms)
+        loc = self._locality_of(slot)
+        with self._lock:
+            self.ring.append(ms)
+            e, n = self._slot_ewma.get(slot, (None, 0))
+            self._slot_ewma[slot] = (
+                ms if e is None else 0.8 * e + 0.2 * ms, n + 1)
+            le = self._loc_ewma.get(loc)
+            self._loc_ewma[loc] = ms if le is None \
+                else 0.8 * le + 0.2 * ms
+
+    def count(self, outcome):
+        with self._lock:
+            self.counters[outcome] = self.counters.get(outcome, 0) + 1
+
+    def remote_penalty(self):
+        """The measured extra cost of a remote hop, in queue-rows
+        equivalents: (remote EWMA - local EWMA) / local EWMA. Zero until
+        both localities have answered requests."""
+        with self._lock:
+            local = self._loc_ewma.get("local")
+            remote = self._loc_ewma.get("remote")
+        if not local or not remote:
+            return 0.0
+        return max(0.0, (remote - local) / max(local, 1e-3))
+
+    def threshold(self, slot):
+        """Milliseconds to wait before hedging a first attempt against
+        `slot`, or None (not enough signal yet). A flagged persistent
+        straggler gets the ``hedge_min_ms`` floor immediately; otherwise
+        the router's own p99 x ``hedge_factor``, floored at
+        ``hedge_min_ms`` and capped at half the upstream timeout (a
+        hedge that can't finish inside the remaining budget is
+        pointless)."""
+        if slot in self.stragglers:
+            return self.cfg["hedge_min_ms"]
+        with self._lock:
+            ring = sorted(self.ring)
+        if len(ring) < 16:
+            return None
+        p99 = ring[int(0.99 * (len(ring) - 1))]
+        thr = max(self.cfg["hedge_min_ms"],
+                  p99 * self.cfg["hedge_factor"])
+        return min(thr, self.cfg["timeout_ms"] / 2.0)
+
+    # one request in PROBE_EVERY keeps its natural placement even when
+    # that placement is a flagged straggler: the probe is hedged at the
+    # hedge_min_ms floor (cheap rescue), and a RECOVERED slot wins its
+    # own probe races, decaying its EWMA until the flag clears —
+    # without probes a flagged slot could never prove itself healthy
+    PROBE_EVERY = 16
+
+    def reorder(self, order, rr):
+        """Stable-move flagged persistent stragglers to the tail of the
+        candidate `order` — they stay reachable (failover, hedges) but
+        stop being anyone's first choice. Every ``PROBE_EVERY``-th
+        request passes through unmoved as a canary probe."""
+        flagged = self.stragglers
+        if not flagged or rr % self.PROBE_EVERY == 0:
+            return order
+        return [s for s in order if s not in flagged] + \
+            [s for s in order if s in flagged]
+
+    def plan(self, slot, candidates, endpoint):
+        """(hedge slot, threshold ms) for a first attempt against
+        `slot`, or (None, None) when hedging is off / there is no second
+        candidate with a live `endpoint` / the latency signal is too
+        thin."""
+        if not self.cfg.get("hedge") or len(candidates) < 2:
+            return None, None
+        thr = self.threshold(slot)
+        if thr is None:
+            return None, None
+        for cand in candidates:
+            if cand != slot and endpoint(cand) is not None:
+                return cand, thr
+        return None, None
+
+    def update_stragglers(self, active):
+        """Advance the per-slot flag streaks (call once per control
+        interval): a slot whose latency EWMA stayed >= factor x the
+        fleet median for `persist` consecutive calls is flagged."""
+        factor = _env_float("MXNET_TPU_STRAGGLER_FACTOR", 1.5)
+        persist = int(_env_float("MXNET_TPU_STRAGGLER_PERSIST", 3))
+        active = set(active) | set(self.stragglers)
+        with self._lock:
+            ew = {s: e for s, (e, n) in self._slot_ewma.items()
+                  if n >= 5 and s in active}
+        if len(ew) < 2:
+            self._streak = {}
+            self.stragglers = frozenset()
+            return self.stragglers
+        # lower-middle median: with an even count (the 2-host fleet!)
+        # the upper-middle would BE the straggler's own EWMA and the
+        # flag could never fire
+        vals = sorted(ew.values())
+        median = vals[(len(vals) - 1) // 2]
+        flagged_now = {s for s, e in ew.items()
+                       if e >= factor * max(median, 1e-9)}
+        self._streak = {s: self._streak.get(s, 0) + 1
+                        for s in flagged_now}
+        new = frozenset(s for s, n in self._streak.items()
+                        if n >= persist)
+        for s in sorted(new - self.stragglers):
+            _flight.rec("fleet.straggler", f"slot{s}",
+                        f"ewma {ew[s]:.1f}ms >= {factor:g}x median "
+                        f"{median:.1f}ms")
+        self.stragglers = new
+        return self.stragglers
+
+    def describe(self):
+        """{hedges, stragglers, router_latency} for stats()/diagnose."""
+        with self._lock:
+            counters = dict(self.counters)
+            ring = sorted(self.ring)
+            by_loc = {k: round(v, 3) for k, v in self._loc_ewma.items()}
+        lat = None
+        if ring:
+            lat = {"samples": len(ring),
+                   "p50_ms": round(ring[len(ring) // 2], 3),
+                   "p99_ms": round(ring[int(0.99 * (len(ring) - 1))], 3),
+                   "by_locality_ewma_ms": by_loc}
+        return {"hedges": counters,
+                "stragglers": sorted(self.stragglers),
+                "router_latency": lat}
 
 
 # ---------------------------------------------------------- shard reading --
@@ -378,7 +779,14 @@ class Autoscaler:
     ``idle_rps`` AND empty queues) sustained for ``idle_k`` samples
     scales down; every action starts a ``cooldown`` window during which
     streaks keep accumulating but nothing fires; ``min``/``max`` bound
-    the census."""
+    the census.
+
+    SLO mode (``slo_ms`` > 0): pressure becomes p99-vs-SLO **headroom**
+    instead of the raw queue/fill thresholds — the fleet scales up when
+    p99 eats 80% of the SLO budget, i.e. *before* the SLO is breached,
+    not after the queue is already deep (a deep queue means the p99 the
+    clients saw was already lost). Idleness is unchanged: completion
+    rate is the only trustworthy scale-down signal either way."""
 
     def __init__(self, cfg=None):
         self.cfg = dict(effective() if cfg is None else cfg)
@@ -397,14 +805,22 @@ class Autoscaler:
         now = time.monotonic() if now is None else now
         pressure = []
         q = sample.get("queue_depth")
-        if q is not None and q >= cfg["up_queue"]:
-            pressure.append(f"queue {q:g} >= {cfg['up_queue']}")
         p99 = sample.get("p99_ms")
-        if p99 is not None and p99 >= cfg["up_p99_ms"]:
-            pressure.append(f"p99 {p99:g}ms >= {cfg['up_p99_ms']:g}")
-        fill = sample.get("fill")
-        if fill is not None and fill >= cfg["up_fill"]:
-            pressure.append(f"fill {fill:g} >= {cfg['up_fill']:g}")
+        slo = cfg.get("slo_ms") or 0.0
+        if slo > 0:
+            # SLO mode: the only up-pressure is exhausted p99 headroom
+            budget = 0.8 * slo
+            if p99 is not None and p99 >= budget:
+                pressure.append(
+                    f"p99 {p99:g}ms >= 80% of {slo:g}ms SLO")
+        else:
+            if q is not None and q >= cfg["up_queue"]:
+                pressure.append(f"queue {q:g} >= {cfg['up_queue']}")
+            if p99 is not None and p99 >= cfg["up_p99_ms"]:
+                pressure.append(f"p99 {p99:g}ms >= {cfg['up_p99_ms']:g}")
+            fill = sample.get("fill")
+            if fill is not None and fill >= cfg["up_fill"]:
+                pressure.append(f"fill {fill:g} >= {cfg['up_fill']:g}")
         rps = sample.get("rps")
         # idleness takes PRECEDENCE over pressure: p99/fill are
         # recent-window gauges that stay high after traffic stops — an
@@ -604,15 +1020,55 @@ class _RouterFront:
                 except OSError:
                     pass
 
+    def _fresh_conn(self, endpoint):
+        """A one-shot upstream connection (hedges ride these so a
+        cancelled loser never poisons the per-thread keep-alive pool)."""
+        conn = http.client.HTTPConnection(
+            endpoint[0], endpoint[1],
+            timeout=self._fleet.cfg["timeout_ms"] / 1e3)
+        conn.connect()
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    @staticmethod
+    def _forward_on(conn, path, body, ctype, rid):
+        """One upstream POST on an explicit connection. Returns
+        ``(status, payload, content_type, retry_after)``; raises the
+        connection-level failures the dispatch ladder classifies."""
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": ctype,
+                              "X-Request-Id": rid})
+        resp = conn.getresponse()
+        payload = resp.read()
+        return (resp.status, payload,
+                resp.getheader("Content-Type", "application/json"),
+                resp.getheader("Retry-After", "0.1"))
+
     def _dispatch(self, model, path, body, ctype, rid):
         """Route one admitted-at-the-front-door request: walk the
         policy-ordered candidates; connection-level failures and 503s
         fail over to the next worker; the LAST candidate's verdict (or a
-        fleet 503) goes back to the client."""
+        fleet 503) goes back to the client. The first attempt may be
+        HEDGED: when the primary is slower than the hedge threshold
+        (router p99 x hedge_factor, floored at hedge_min_ms, immediate
+        floor for flagged stragglers) the same request is issued to the
+        next candidate and the first answer wins."""
         fleet = self._fleet
         fleet._count("requests")
-        candidates = fleet.pick(model)
         rid_hdr = [("X-Request-Id", rid)]
+        from .. import faults as _faults
+
+        try:
+            # 'serving.route' injection: delay = a slow route (drills
+            # the hedge threshold), raise = a broken router hop
+            _faults.point("serving.route")
+        except Exception as e:
+            fleet._count("errors")
+            return 500, json.dumps(
+                {"error": f"router fault: {type(e).__name__}: {e}",
+                 "request_id": rid}).encode(), \
+                rid_hdr + [("Content-Type", "application/json")]
+        candidates = fleet.pick(model)
         if not candidates:
             fleet._count("rejects")
             return 503, json.dumps(
@@ -621,44 +1077,121 @@ class _RouterFront:
                 rid_hdr + [("Content-Type", "application/json"),
                            ("Retry-After", "1")]
         last_err = None
+        t_req = time.monotonic()
         for attempt, slot in enumerate(candidates):
             endpoint = fleet.endpoint(slot)
             if endpoint is None:
                 continue
             if attempt:
                 fleet._count("retries")
+            hedge_slot = hedge_ep = None
+            if attempt == 0:
+                hedge_slot, hedge_after_ms = fleet.hedge_plan(
+                    slot, candidates)
+                hedge_ep = fleet.endpoint(hedge_slot) \
+                    if hedge_slot is not None else None
+            used = slot
             try:
                 conn = self._conn_to(slot, endpoint)
-                conn.request("POST", path, body=body,
-                             headers={"Content-Type": ctype,
-                                      "X-Request-Id": rid})
-                resp = conn.getresponse()
-                payload = resp.read()
-            except socket.timeout:
-                # maybe admitted: do NOT replay on another worker
-                self._drop_conn(slot)
-                fleet._count("errors")
-                return 504, json.dumps(
-                    {"error": f"worker {slot} timed out",
-                     "request_id": rid}).encode(), \
-                    rid_hdr + [("Content-Type", "application/json")]
             except _RETRYABLE + (OSError,) as e:
                 self._drop_conn(slot)
                 fleet.mark_suspect(slot, repr(e))
                 last_err = f"{type(e).__name__}: {e}"
                 continue
-            if resp.status == 503 and attempt + 1 < len(candidates):
+            if hedge_ep is None:
+                try:
+                    status, payload, up_ctype, retry_after = \
+                        self._forward_on(conn, path, body, ctype, rid)
+                except socket.timeout:
+                    # maybe admitted: do NOT replay on another worker
+                    self._drop_conn(slot)
+                    fleet._count("errors")
+                    return 504, json.dumps(
+                        {"error": f"worker {slot} timed out",
+                         "request_id": rid}).encode(), \
+                        rid_hdr + [("Content-Type", "application/json")]
+                except _RETRYABLE + (OSError,) as e:
+                    self._drop_conn(slot)
+                    fleet.mark_suspect(slot, repr(e))
+                    last_err = f"{type(e).__name__}: {e}"
+                    continue
+            else:
+                hedge_holder = {}
+
+                def run_primary(c=conn):
+                    return self._forward_on(c, path, body, ctype, rid)
+
+                def run_hedge(ep=hedge_ep):
+                    hc = self._fresh_conn(ep)
+                    hedge_holder["conn"] = hc
+                    return self._forward_on(hc, path, body, ctype, rid)
+
+                res = hedged_call(
+                    run_primary, run_hedge,
+                    hedge_after=hedge_after_ms / 1e3,
+                    timeout=fleet.cfg["timeout_ms"] / 1e3 * 1.5 + 1.0)
+                if res["hedged"]:
+                    fleet._count_hedge("fired")
+                    _flight.rec("fleet.hedge", f"slot{slot}",
+                                f"-> slot{hedge_slot} after "
+                                f"{hedge_after_ms:.0f}ms")
+                if res["hedge_error"] is not None:
+                    fleet._count_hedge("failed")
+                winner = res["winner"]
+                if winner is None:
+                    pe = res["primary_error"]
+                    self._drop_conn(slot)
+                    hc = hedge_holder.get("conn")
+                    if hc is not None:
+                        try:
+                            hc.close()
+                        except OSError:
+                            pass
+                    if isinstance(pe, socket.timeout):
+                        # primary timed out and the (already-issued)
+                        # hedge could not answer either — 504, nothing
+                        # is replayed after a timeout
+                        fleet._count("errors")
+                        return 504, json.dumps(
+                            {"error": f"worker {slot} timed out "
+                             "(hedge failed too)",
+                             "request_id": rid}).encode(), \
+                            rid_hdr + [("Content-Type",
+                                        "application/json")]
+                    fleet.mark_suspect(slot, repr(pe))
+                    if hedge_slot is not None \
+                            and res["hedge_error"] is not None:
+                        fleet.mark_suspect(hedge_slot,
+                                           repr(res["hedge_error"]))
+                    last_err = f"{type(pe).__name__}: {pe}" \
+                        if pe is not None else "hedged call timed out"
+                    continue
+                if winner == "hedge":
+                    fleet._count_hedge("won")
+                    used = hedge_slot
+                    # the loser primary still holds the pooled conn: it
+                    # may answer later — close it so the pool can't
+                    # serve a stale response to the next request
+                    self._drop_conn(slot)
+                elif res["hedged"]:
+                    fleet._count_hedge("lost")
+                    hc = hedge_holder.get("conn")
+                    if hc is not None:
+                        try:
+                            hc.close()
+                        except OSError:
+                            pass
+                status, payload, up_ctype, retry_after = res["value"]
+            if status == 503 and attempt + 1 < len(candidates):
                 # draining worker: the request was NOT admitted there
                 continue
-            if 200 <= resp.status < 300:
+            if 200 <= status < 300:
                 fleet._count("completed")
-            hdrs = rid_hdr + [("Content-Type",
-                               resp.getheader("Content-Type",
-                                              "application/json"))]
-            if resp.status in (429, 503):
-                hdrs.append(("Retry-After",
-                             resp.getheader("Retry-After", "0.1")))
-            return resp.status, payload, hdrs
+                fleet.note_latency(used, (time.monotonic() - t_req) * 1e3)
+            hdrs = rid_hdr + [("Content-Type", up_ctype)]
+            if status in (429, 503):
+                hdrs.append(("Retry-After", retry_after))
+            return status, payload, hdrs
         fleet._count("rejects")
         return 503, json.dumps(
             {"error": "every fleet worker refused the request",
@@ -713,7 +1246,7 @@ class ServingFleet:
     def __init__(self, model_dir, workers=None, *, run_dir=None,
                  policy=None, host="127.0.0.1", port=0, config=None,
                  warmup=True, env=None, cwd=None, name="fleet",
-                 bus_dir=None, popen=None):
+                 bus_dir=None, hosts=None, popen=None):
         import tempfile
 
         cfg = dict(effective())
@@ -748,6 +1281,7 @@ class ServingFleet:
         self._counters = {"requests": 0, "completed": 0, "retries": 0,
                           "rejects": 0, "errors": 0}
         self._count_lock = threading.Lock()
+        self._hedge = HedgeGovernor(cfg, self._slot_locality)
         self._scaler = Autoscaler(cfg)
         self._last_completed = None   # (t_mono, fleet completed total)
         self._last_sample = {}
@@ -780,10 +1314,31 @@ class ServingFleet:
 
         from .. import elastic as _elastic
 
-        self._sup = _elastic.ServingSupervisor(
-            self._command_for, self.run_dir, grace=cfg["grace"],
-            dead_after=cfg["dead_after"], max_restarts=cfg["restarts"],
-            env=worker_env, cwd=cwd, popen=popen)
+        self._worker_env = worker_env
+        self.hosts = normalize_hosts(hosts) if hosts else None
+        self._slot_host = {}       # slot -> host name (multi-host only)
+        if self.hosts is None:
+            self._sup = _elastic.ServingSupervisor(
+                self._command_for, self.run_dir, grace=cfg["grace"],
+                dead_after=cfg["dead_after"],
+                max_restarts=cfg["restarts"],
+                env=worker_env, cwd=cwd, popen=popen)
+        else:
+            self._by_host = {h["name"]: h for h in self.hosts}
+            sups = {}
+            for h in self.hosts:
+                h["run_dir"] = os.path.join(self.run_dir,
+                                            f"host-{h['name']}")
+                os.makedirs(h["run_dir"], exist_ok=True)
+                henv = dict(worker_env)
+                henv.update(h["env"])
+                sups[h["name"]] = _elastic.ServingSupervisor(
+                    self._host_command_for(h), h["run_dir"],
+                    grace=cfg["grace"], dead_after=cfg["dead_after"],
+                    max_restarts=cfg["restarts"], env=henv,
+                    cwd=(h["cwd"] if not h["ssh"] else None) or cwd,
+                    popen=popen)
+            self._sup = _HostPlane(sups, self._slot_host)
 
         from ..telemetry import fleet as _tfleet
 
@@ -801,13 +1356,69 @@ class ServingFleet:
             cmd.append("--no-warmup")
         return cmd
 
+    def _host_command_for(self, host):
+        """The per-host supervisor's command factory: the worker argv
+        carries run-dir/slot/generation/bind-address EXPLICITLY (an ssh
+        child does not inherit the local supervisor env), and an ssh
+        host wraps it in the same ``ssh -tt ... exec env ...`` launch
+        the gang supervisor uses — so a remote worker still heartbeats
+        and announces into its (shared-filesystem) host dir."""
+
+        def command_for(slot, generation):
+            argv = [sys.executable, "-m", "mxnet_tpu.serving.worker",
+                    "--model-dir", self._gen_dirs[generation],
+                    "--run-dir", host["run_dir"],
+                    "--slot", str(slot),
+                    "--generation", str(generation),
+                    "--host", host["advertise"]]
+            if not self._warmup:
+                argv.append("--no-warmup")
+            if not host["ssh"]:
+                return argv
+            from .. import elastic as _elastic
+
+            env = dict(self._worker_env)
+            env.update(host["env"])
+            env.update({"MXTPU_GANG_DIR": host["run_dir"],
+                        "MXTPU_WORKER_ID": str(slot),
+                        "MXTPU_GANG_GENERATION": str(generation),
+                        "MXNET_TPU_PREEMPT": "1"})
+            return _elastic._ssh_argv(host["ssh"], env, argv,
+                                      cwd=host["cwd"])
+
+        return command_for
+
+    def _pick_host(self):
+        """Least-populated host wins the next slot (definition order
+        breaks ties) — the fleet stays balanced through scale-up,
+        rollout and per-slot restarts alike. Caller holds ``_lock``."""
+        counts = {h["name"]: 0 for h in self.hosts}
+        for s, hn in self._slot_host.items():
+            if s in self._desired and hn in counts:
+                counts[hn] += 1
+        return min(self.hosts, key=lambda h: counts[h["name"]])["name"]
+
     def _spawn(self, generation):
         with self._lock:
             slot = self._next_slot
             self._next_slot += 1
             self._desired[slot] = int(generation)
+            if self.hosts is not None:
+                self._slot_host[slot] = self._pick_host()
         self._sup.spawn(slot, generation)
         return slot
+
+    def _slot_locality(self, slot):
+        if self.hosts is None:
+            return "local"
+        h = self._by_host.get(self._slot_host.get(slot))
+        return h["locality"] if h else "local"
+
+    def _slot_ssh(self, slot):
+        if self.hosts is None:
+            return None
+        h = self._by_host.get(self._slot_host.get(slot))
+        return h["ssh"] if h else None
 
     # ---------------------------------------------------------- lifecycle --
     def start(self, wait_ready=True, timeout=None):
@@ -890,15 +1501,20 @@ class ServingFleet:
     # ------------------------------------------------------------ routing --
     def _gated_ready(self, slots):
         """Slots (of the given census) passing the announce health gate
-        with a live, pid-matching process."""
+        with a live, pid-matching process. An ssh-placed slot relaxes
+        the pid equality (the announce carries the REMOTE worker pid,
+        the census the local ssh client's) — generation match + a live
+        supervised process still gate it."""
         anns = _worker.read_workers(self.run_dir)
         census = self._sup.census()
         out = []
         for slot in slots:
             rec = census.get(slot)
             ann = anns.get(slot)
-            if (rec and rec.get("alive") and gate_ready(ann)
-                    and ann.get("pid") == rec.get("pid")
+            pid_ok = ann is not None and rec is not None and (
+                ann.get("pid") == rec.get("pid")
+                or self._slot_ssh(slot) is not None)
+            if (rec and rec.get("alive") and gate_ready(ann) and pid_ok
                     and ann.get("generation") == rec.get("generation")):
                 out.append(slot)
                 self._endpoints[slot] = (ann.get("host", "127.0.0.1"),
@@ -917,19 +1533,49 @@ class ServingFleet:
             self._ring.rebuild(self._routable)
 
     def pick(self, model):
-        """Policy-ordered candidate slots for one request."""
+        """Policy-ordered candidate slots for one request: the routing
+        policy (locality-aware when multi-host) orders them, then
+        flagged persistent stragglers are stable-moved to the tail —
+        they stay reachable (failover, hedges) but stop being anyone's
+        first choice."""
         self._rr += 1
         depths = None
         if self.cfg["policy"] == "least_loaded":
             depths = {s: m.get("queue_depth")
                       for s, m in self._last_sample.get(
                           "per_worker", {}).items()}
-        return order_candidates(self.cfg["policy"], model,
-                                self._routable, depths=depths,
-                                rr=self._rr, ring=self._ring)
+        localities, penalty = None, 0.0
+        if self.hosts is not None:
+            localities = {s: self._slot_locality(s)
+                          for s in self._routable}
+            if any(v == "remote" for v in localities.values()):
+                penalty = self._hedge.remote_penalty()
+            else:
+                localities = None
+        order = order_candidates(self.cfg["policy"], model,
+                                 self._routable, depths=depths,
+                                 rr=self._rr, ring=self._ring,
+                                 localities=localities,
+                                 remote_penalty=penalty)
+        return self._hedge.reorder(order, self._rr)
 
     def endpoint(self, slot):
         return self._endpoints.get(slot)
+
+    # ------------------------------------------------- latency + hedging --
+    def note_latency(self, slot, ms):
+        """One completed router request against `slot` took `ms`
+        end-to-end: feeds the hedge-threshold p99 ring, the per-slot
+        straggler EWMAs and the per-locality spill penalty."""
+        self._hedge.note(slot, ms)
+
+    def hedge_plan(self, slot, candidates):
+        """(hedge slot, threshold ms) for a first attempt against
+        `slot`, or (None, None) — see :meth:`HedgeGovernor.plan`."""
+        return self._hedge.plan(slot, candidates, self.endpoint)
+
+    def _count_hedge(self, outcome):
+        self._hedge.count(outcome)
 
     def mark_suspect(self, slot, why=""):
         """A connection-level failure against `slot`: deprioritize it
@@ -1012,6 +1658,10 @@ class ServingFleet:
 
     def _autoscale_tick(self, now):
         sample = self._sample(now)
+        # straggler flags ride the same cadence (one streak advance per
+        # interval), autoscaling enabled or not — the router's hedge
+        # threshold and candidate ordering depend on them either way
+        self._hedge.update_stragglers(self._routable)
         if self.cfg["max"] <= self.cfg["min"]:
             return  # fixed-size fleet: sampling still feeds the router
         if self.state != "serving":
@@ -1200,13 +1850,30 @@ class ServingFleet:
                 "queue_depth": m.get("queue_depth"),
                 "p99_ms": m.get("p99_ms"), "rps": m.get("rps"),
                 "shard_age_s": m.get("age_s"),
-                "model_bus": ann.get("model_bus")}
+                "model_bus": ann.get("model_bus"),
+                "host": self._slot_host.get(slot),
+                "locality": self._slot_locality(slot),
+                "straggler": slot in self._hedge.stragglers}
+        with self._count_lock:
+            counters = dict(self._counters)
+        hedge_state = self._hedge.describe()
         base.update({
             "url": self.url, "run_dir": self.run_dir,
             "bus_dir": self.bus_dir,
             "uptime_s": round(time.monotonic() - self._t_start, 1),
             "workers": workers,
-            "router": dict(self._counters),
+            "hosts": None if self.hosts is None else [
+                {"name": h["name"], "ssh": h["ssh"],
+                 "locality": h["locality"],
+                 "advertise": h["advertise"],
+                 "slots": sorted(
+                     s for s, hn in self._slot_host.items()
+                     if hn == h["name"] and s in desired)}
+                for h in self.hosts],
+            "router": counters,
+            "hedges": hedge_state["hedges"],
+            "stragglers": hedge_state["stragglers"],
+            "router_latency": hedge_state["router_latency"],
             "autoscaler": self._scaler.describe(),
             "sample": {k: self._last_sample.get(k) for k in
                        ("queue_depth", "p99_ms", "fill", "rps")},
@@ -1274,6 +1941,18 @@ def _collect_serving_fleet():
         counters = dict(fl._counters)
     for outcome, n in counters.items():
         router.set_total(n, outcome)
+    hedge = _registry.counter(
+        "mxtpu_fleet_hedges_total",
+        "Hedged router requests by outcome (fired/won/lost/failed)",
+        labels=("outcome",))
+    with fl._hedge._lock:
+        hedges = dict(fl._hedge.counters)
+    for outcome, n in hedges.items():
+        hedge.set_total(n, outcome)
+    _registry.gauge("mxtpu_fleet_stragglers",
+                    "Worker slots currently flagged as persistent "
+                    "router-latency stragglers").set(
+                        len(fl._hedge.stragglers))
     scale = _registry.counter("mxtpu_fleet_autoscale_total",
                               "Autoscaler actions", labels=("direction",))
     for direction, n in fl._scaler.decisions.items():

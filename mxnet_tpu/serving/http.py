@@ -2,7 +2,14 @@
 
 Endpoints (TF-Serving-flavoured paths, JSON bodies)::
 
-    POST /v1/models/<name>:predict   {"data": [[...], ...]}
+    POST /v1/models/<name>:predict   {"data": [[...], ...],
+                                      "priority": "interactive"|"batch",
+                                      "deadline_ms": <F>}
+                                     (priority and deadline_ms optional:
+                                     the QoS class and per-request
+                                     deadline ride INSIDE the body so
+                                     they survive the fleet router's
+                                     opaque forward + hedge unchanged)
                                      -> {"model":..., "outputs": [[...]],
                                      "model_version":...,
                                      "request_id":..., "phases": {...}}
@@ -30,8 +37,9 @@ Endpoints (TF-Serving-flavoured paths, JSON bodies)::
 
 Error mapping — the typed serving errors become the status codes a
 load balancer expects: unknown model 404, admission fast-reject 429
-(with Retry-After), draining 503, request deadline 504, failed batch
-500.
+(with Retry-After), draining 503, request deadline 504 (both the
+client-wait RequestTimeout and a DeadlineExceeded drop — the latter
+with ``"dropped": true`` since no compute ran), failed batch 500.
 
 This front end exists so external clients (and ``tools/loadgen.py``'s
 socket mode) can drive the server; the throughput path is the
@@ -48,8 +56,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from ..telemetry import trace as _trace
-from .errors import (ModelNotFound, RequestError, RequestTimeout,
-                     ServerBusyError, ServerDrainingError)
+from .errors import (DeadlineExceeded, ModelNotFound, RequestError,
+                     RequestTimeout, ServerBusyError, ServerDrainingError)
 
 __all__ = ["HttpFrontEnd"]
 
@@ -130,6 +138,10 @@ class HttpFrontEnd:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     arr = _np.asarray(payload["data"])
+                    priority = payload.get("priority", "interactive")
+                    deadline_ms = payload.get("deadline_ms")
+                    if deadline_ms is not None:
+                        deadline_ms = float(deadline_ms)
                 except (ValueError, KeyError, TypeError) as e:
                     self._json(400, {"error": f"bad request body: {e}"})
                     return
@@ -142,7 +154,8 @@ class HttpFrontEnd:
                 rid_hdr = [("X-Request-Id", rid)]
                 try:
                     with _trace.context(rid):
-                        fut = srv.submit(name, arr)
+                        fut = srv.submit(name, arr, priority=priority,
+                                         deadline_ms=deadline_ms)
                     out = fut.result(front._timeout)
                 except ModelNotFound as e:
                     self._json(404, {"error": str(e)},
@@ -155,6 +168,12 @@ class HttpFrontEnd:
                     self._json(429, {"error": str(e)},
                                extra_headers=rid_hdr
                                + [("Retry-After", "0.1")])
+                except DeadlineExceeded as e:
+                    # the cheap 504: the request was DROPPED before any
+                    # compute, so a hedging/retrying client knows no
+                    # batch slot was burned on it
+                    self._json(504, {"error": str(e), "dropped": True},
+                               extra_headers=rid_hdr)
                 except RequestTimeout as e:
                     self._json(504, {"error": str(e)},
                                extra_headers=rid_hdr)
@@ -168,6 +187,8 @@ class HttpFrontEnd:
                             "outputs": [o.tolist() for o in outs],
                             "model_version": fut.model_version,
                             "request_id": fut.request_id or rid}
+                    if fut.cache_hit:
+                        body["cache_hit"] = True
                     bd = fut.breakdown()
                     if bd is not None:
                         body["phases"] = {

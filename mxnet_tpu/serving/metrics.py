@@ -47,7 +47,14 @@ class ModelMetrics:
         self.rows = 0            # real rows through compiled batches
         self.padded_rows = 0     # padding rows (bucket - rows per batch)
         self.bucket_census = Counter()
+        self.deadline_dropped = Counter()   # {"submit": n, "queue": n}
+        self.deadline_met = 0    # deadline-carrying requests answered in time
+        self.deadline_missed = 0  # answered, but past their deadline
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.coalesced = 0       # in-flight dupes folded onto a leader
         self._lat_ms = deque(maxlen=_RING)
+        self._lat_by_class = {}  # priority -> deque ring
         self._t_first = None     # first completion (rps window start)
         self._t_last = None
 
@@ -66,14 +73,47 @@ class ModelMetrics:
             _profiler.record_instant(f"serving.{self.model}.reject",
                                      cat="serving")
 
-    def record_complete(self, lat_ms):
+    def record_complete(self, lat_ms, priority=None):
         now = time.monotonic()
         with self._lock:
             self.completed += 1
             self._lat_ms.append(lat_ms)
+            if priority is not None:
+                ring = self._lat_by_class.get(priority)
+                if ring is None:
+                    ring = self._lat_by_class[priority] = \
+                        deque(maxlen=_RING // 4)
+                ring.append(lat_ms)
             if self._t_first is None:
                 self._t_first = now
             self._t_last = now
+
+    def record_deadline_drop(self, where="queue"):
+        """A deadline-doomed request dropped BEFORE a batch slot."""
+        with self._lock:
+            self.deadline_dropped[where] += 1
+        _flight.rec("serving.deadline_drop", self.model, where)
+
+    def record_deadline_outcome(self, met):
+        with self._lock:
+            if met:
+                self.deadline_met += 1
+            else:
+                self.deadline_missed += 1
+
+    def record_cache(self, hit):
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+
+    def record_coalesced(self):
+        """A content-identical request attached to one already in flight
+        (the idempotency half of hedging: a duplicate never double-runs
+        a donating batch)."""
+        with self._lock:
+            self.coalesced += 1
 
     def record_fail(self, n=1):
         with self._lock:
@@ -105,6 +145,7 @@ class ModelMetrics:
         (live queue depth etc.) is merged in by the caller."""
         with self._lock:
             lat = list(self._lat_ms)
+            by_class = {p: list(r) for p, r in self._lat_by_class.items()}
             padded = self.rows + self.padded_rows
             window = (self._t_last - self._t_first) \
                 if (self._t_first is not None
@@ -123,9 +164,24 @@ class ModelMetrics:
                 if padded else None,
                 "bucket_census": dict(sorted(self.bucket_census.items())),
                 "rps": round(self.completed / window, 2) if window else None,
+                "deadline_dropped": dict(self.deadline_dropped),
+                "deadline_met": self.deadline_met,
+                "deadline_missed": self.deadline_missed,
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "coalesced": self.coalesced,
             }
+            cache_total = self.cache_hits + self.cache_misses
+            out["cache_hit_ratio"] = (round(self.cache_hits / cache_total, 4)
+                                      if cache_total else None)
         for q, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
             v = percentile(lat, q)
             out[key] = round(v, 3) if v is not None else None
+        if by_class:
+            out["by_class"] = {
+                p: {"count": len(r),
+                    "p50_ms": round(percentile(r, 50), 3) if r else None,
+                    "p99_ms": round(percentile(r, 99), 3) if r else None}
+                for p, r in sorted(by_class.items())}
         out.update(extra)
         return out

@@ -46,11 +46,13 @@ class ModelServer:
     batching, admission control and bounded tail latency."""
 
     def __init__(self, container, max_queue=None, max_wait_ms=None,
-                 stage=None, name="mxtpu-server"):
+                 stage=None, cache=None, cache_entries=None,
+                 name="mxtpu-server"):
         self.name = name
         self._container = container
         self._overrides = {"max_queue": max_queue,
-                           "max_wait_ms": max_wait_ms, "stage": stage}
+                           "max_wait_ms": max_wait_ms, "stage": stage,
+                           "cache": cache, "cache_entries": cache_entries}
         self._batchers = {}
         self._started = False
         self._draining = False
@@ -136,16 +138,21 @@ class ModelServer:
                 f"{sorted(self._batchers)}")
         return b
 
-    def submit(self, model, arr):
+    def submit(self, model, arr, priority="interactive", deadline_ms=None):
         """Admit one request; returns a
         :class:`~mxnet_tpu.serving.batcher.ServingFuture`. Fast-rejects
-        with ServerBusyError / ServerDrainingError — never queues beyond
-        the per-model bound."""
-        return self._batcher(model).submit(arr)
+        with ServerBusyError / ServerDrainingError / DeadlineExceeded —
+        never queues beyond the per-model bound. ``priority`` is the QoS
+        class (interactive | batch); ``deadline_ms`` drops the request
+        before it wastes a batch slot when it provably can't be met."""
+        return self._batcher(model).submit(arr, priority=priority,
+                                           deadline_ms=deadline_ms)
 
-    def predict(self, model, arr, timeout=None):
+    def predict(self, model, arr, timeout=None, priority="interactive",
+                deadline_ms=None):
         """Synchronous submit + bounded wait."""
-        return self.submit(model, arr).result(timeout)
+        return self.submit(model, arr, priority=priority,
+                           deadline_ms=deadline_ms).result(timeout)
 
     # -------------------------------------------------------------- drain --
     def drain(self, timeout=30.0):
@@ -216,7 +223,8 @@ class ModelServer:
                 weight_dtype=b.model.weight_dtype,
                 model_version=b.model.version,
                 weight_swaps=b.model.swaps,
-                draining=b.draining)
+                draining=b.draining,
+                cache=b.cache.stats() if b.cache is not None else None)
         return {
             "name": self.name,
             "started": self._started,

@@ -181,21 +181,34 @@ def _write_announce(run_dir, slot, payload):
 def read_workers(run_dir):
     """Parse every ``worker-<slot>.json`` under `run_dir` into
     ``{slot: record}`` (torn/unreadable files skipped — the writer is
-    mid-replace)."""
+    mid-replace). A multi-host fleet announces into per-host
+    ``host-<name>/`` subdirectories; those merge in too (slot ids are
+    globally unique across hosts)."""
     out = {}
+    run_dir = os.fspath(run_dir)
     try:
-        names = os.listdir(os.fspath(run_dir))
+        names = os.listdir(run_dir)
     except OSError:
         return out
-    for name in names:
-        if not (name.startswith("worker-") and name.endswith(".json")):
-            continue
+    dirs = [run_dir] + sorted(
+        os.path.join(run_dir, n) for n in names
+        if n.startswith("host-")
+        and os.path.isdir(os.path.join(run_dir, n)))
+    for d in dirs:
         try:
-            with open(os.path.join(os.fspath(run_dir), name)) as f:
-                rec = json.load(f)
-            out[int(rec["slot"])] = rec
-        except (OSError, ValueError, KeyError, TypeError):
+            entries = names if d == run_dir else os.listdir(d)
+        except OSError:
             continue
+        for name in entries:
+            if not (name.startswith("worker-")
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    rec = json.load(f)
+                out[int(rec["slot"])] = rec
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
     return out
 
 

@@ -154,6 +154,29 @@ def _collect_serving():
     stalls = _registry.counter("mxtpu_serving_stalled_batches_total",
                                "Batches killed by a watchdog stall",
                                labels=("model",))
+    dl_drop = _registry.counter(
+        "mxtpu_serving_deadline_dropped_total",
+        "Requests dropped before a batch slot: provably unable to meet "
+        "their deadline (where: submit|queue)", labels=("model", "where"))
+    dl_out = _registry.counter(
+        "mxtpu_serving_deadline_outcomes_total",
+        "Deadline-carrying requests answered, by outcome",
+        labels=("model", "outcome"))
+    cache_req = _registry.counter(
+        "mxtpu_serving_cache_requests_total",
+        "Prediction-cache lookups by outcome",
+        labels=("model", "outcome"))
+    cache_ratio = _registry.gauge(
+        "mxtpu_serving_cache_hit_ratio",
+        "Prediction-cache hits / lookups (lifetime)", labels=("model",))
+    coalesced = _registry.counter(
+        "mxtpu_serving_coalesced_total",
+        "Content-identical requests folded onto an in-flight leader",
+        labels=("model",))
+    class_lat = _registry.gauge(
+        "mxtpu_serving_class_latency_ms",
+        "Recent-window latency percentiles by QoS class",
+        labels=("model", "class", "quantile"))
     for srv in mod.live_stats():
         for model, m in srv.get("models", {}).items():
             for outcome in ("submitted", "completed", "rejected",
@@ -170,6 +193,21 @@ def _collect_serving():
                 fill.set(m["batch_fill_ratio"], model)
             batches.set_total(m.get("batches", 0), model)
             stalls.set_total(m.get("stalled_batches", 0), model)
+            for where, n in (m.get("deadline_dropped") or {}).items():
+                dl_drop.set_total(n, model, where)
+            dl_out.set_total(m.get("deadline_met", 0), model, "met")
+            dl_out.set_total(m.get("deadline_missed", 0), model,
+                             "missed")
+            cache_req.set_total(m.get("cache_hits", 0), model, "hit")
+            cache_req.set_total(m.get("cache_misses", 0), model, "miss")
+            if m.get("cache_hit_ratio") is not None:
+                cache_ratio.set(m["cache_hit_ratio"], model)
+            coalesced.set_total(m.get("coalesced", 0), model)
+            for klass, cm in (m.get("by_class") or {}).items():
+                for q in ("p50", "p99"):
+                    v = cm.get(f"{q}_ms")
+                    if v is not None:
+                        class_lat.set(v, model, klass, q)
 
 
 def _collect_watchdog():

@@ -124,28 +124,44 @@ def read_shards(run_dir, generation=None):
     ``{rank: shard}``. Torn, truncated or malformed shards are SKIPPED
     (the writer is mid-replace, or a rank died mid-write) — a merge must
     only ever see complete shards. ``generation`` filters to one gang
-    incarnation."""
+    incarnation.
+
+    A multi-host serving fleet gives every host its own ``host-<name>/``
+    subdirectory under the fleet run dir; those are scanned too (slot /
+    rank ids are globally unique across hosts, so the merge is a plain
+    union)."""
     out = {}
+    run_dir = os.fspath(run_dir)
     try:
-        names = os.listdir(os.fspath(run_dir))
+        names = os.listdir(run_dir)
     except OSError:
         return out
-    for name in names:
-        if not (name.startswith(SHARD_PREFIX) and name.endswith(".json")):
-            continue
+    dirs = [run_dir] + sorted(
+        os.path.join(run_dir, n) for n in names
+        if n.startswith("host-")
+        and os.path.isdir(os.path.join(run_dir, n)))
+    for d in dirs:
         try:
-            with open(os.path.join(run_dir, name)) as f:
-                shard = json.load(f)
-            rank = int(shard["rank"])
-            float(shard["t_wall"]), float(shard["t_mono"])
-        except (OSError, ValueError, TypeError, KeyError):
+            entries = names if d == run_dir else os.listdir(d)
+        except OSError:
             continue
-        if not isinstance(shard.get("metrics", {}), dict):
-            continue
-        if generation is not None \
-                and shard.get("generation") != generation:
-            continue
-        out[rank] = shard
+        for name in entries:
+            if not (name.startswith(SHARD_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    shard = json.load(f)
+                rank = int(shard["rank"])
+                float(shard["t_wall"]), float(shard["t_mono"])
+            except (OSError, ValueError, TypeError, KeyError):
+                continue
+            if not isinstance(shard.get("metrics", {}), dict):
+                continue
+            if generation is not None \
+                    and shard.get("generation") != generation:
+                continue
+            out[rank] = shard
     return out
 
 

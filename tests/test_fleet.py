@@ -19,6 +19,17 @@ Headline guarantees under test:
   compiles) leaving the old generation serving; the acceptance drill
   rolls a live fleet mid-load with ZERO dropped admitted requests and
   ZERO recompiles in the new generation (warm from the disk cache);
+* hedging — hedged_call fires only past the threshold, first answer
+  wins, a fast failure takes ordinary failover (never re-issued), and
+  the HedgeGovernor's threshold/plan/straggler-flag/canary-probe and
+  remote-penalty arithmetic table-test;
+* multi-host — the hosts= grammar normalizes (and rejects) placement
+  specs, locality-aware ordering spills to remote only past the
+  measured penalty, and a live 2-pseudo-host fleet places slots
+  round-robin with per-host run dirs merged at scrape;
+* QoS — a provably-unmeetable deadline drops BEFORE consuming a batch
+  slot; the prediction cache serves copies, stays bounded, and a live
+  weight swap (model-bus version flip) can never serve stale data;
 * loadgen — the keep-alive HTTP client reuses one connection per worker
   thread (connect time reported separately from request time).
 """
@@ -341,6 +352,294 @@ def test_serving_supervisor_restart_budget_parks_slot(tmp_path):
     sup.stop_all(graceful=False)
 
 
+# --------------------------------------------------------- hedging -------
+
+def _gov(**over):
+    cfg = dict(fleet_mod.DEFAULTS)
+    cfg.update(over)
+    return fleet_mod.HedgeGovernor(cfg)
+
+
+def test_hedged_call_fires_only_past_threshold():
+    """A primary that answers inside the threshold is returned as-is —
+    the hedge closure is never invoked."""
+    hedged = []
+    rec = fleet_mod.hedged_call(lambda: "fast",
+                                lambda: hedged.append(1) or "h",
+                                hedge_after=0.5)
+    assert rec["winner"] == "primary" and rec["value"] == "fast"
+    assert rec["hedged"] is False and not hedged
+
+
+def test_hedged_call_first_answer_wins():
+    """Past the threshold the hedge is issued and the FIRST successful
+    answer wins; the slow loser is abandoned, not awaited."""
+    t0 = time.monotonic()
+    rec = fleet_mod.hedged_call(lambda: time.sleep(2.0) or "slow",
+                                lambda: "rescue",
+                                hedge_after=0.02)
+    assert rec["winner"] == "hedge" and rec["value"] == "rescue"
+    assert rec["hedged"] is True
+    assert time.monotonic() - t0 < 1.5  # did not wait for the loser
+
+
+def test_hedged_call_fast_failure_is_not_hedged():
+    """A primary that FAILS before the threshold takes the ordinary
+    failover path — hedging never re-issues after a failure."""
+    hedged = []
+
+    def boom():
+        raise ConnectionRefusedError("dead worker")
+
+    rec = fleet_mod.hedged_call(boom, lambda: hedged.append(1) or "h",
+                                hedge_after=0.5)
+    assert rec["winner"] is None and rec["hedged"] is False
+    assert isinstance(rec["primary_error"], ConnectionRefusedError)
+    assert not hedged
+
+
+def test_hedged_call_late_primary_error_waits_for_inflight_hedge():
+    """Once the hedge is in flight, a primary failure (e.g. a timeout)
+    legally waits for the ALREADY-ISSUED hedge — nothing new is issued
+    after a failure, and both failing surfaces the primary's error."""
+    def slow_fail():
+        time.sleep(0.05)
+        raise TimeoutError("upstream timeout")
+
+    rec = fleet_mod.hedged_call(slow_fail,
+                                lambda: time.sleep(0.1) or 42,
+                                hedge_after=0.01)
+    assert rec["winner"] == "hedge" and rec["value"] == 42
+
+    def fail_too():
+        time.sleep(0.05)
+        raise ConnectionResetError("hedge died too")
+
+    rec = fleet_mod.hedged_call(slow_fail, fail_too, hedge_after=0.01)
+    assert rec["winner"] is None and rec["hedged"] is True
+    assert isinstance(rec["primary_error"], TimeoutError)
+    assert isinstance(rec["hedge_error"], ConnectionResetError)
+
+
+def test_hedge_governor_threshold_table():
+    g = _gov(hedge_min_ms=20.0, hedge_factor=2.0, timeout_ms=30000.0)
+    assert g.threshold(0) is None          # <16 samples: signal too thin
+    for _ in range(32):
+        g.note(0, 10.0)
+    assert g.threshold(0) == 20.0          # p99*factor floored at min_ms
+    for _ in range(32):
+        g.note(0, 100.0)
+    assert g.threshold(0) == 200.0         # p99 100 x factor 2
+    # capped at half the upstream timeout
+    assert _gov(timeout_ms=300.0).threshold(0) is None
+    g2 = _gov(hedge_min_ms=20.0, hedge_factor=2.0, timeout_ms=300.0)
+    for _ in range(32):
+        g2.note(0, 100.0)
+    assert g2.threshold(0) == 150.0
+    # a flagged straggler gets the floor immediately, no ring needed
+    g3 = _gov(hedge_min_ms=25.0)
+    g3.stragglers = frozenset({3})
+    assert g3.threshold(3) == 25.0
+
+
+def test_hedge_governor_plan_table():
+    ep = {0: "http://a", 1: "http://b"}.get
+    g = _gov(hedge=0)
+    for _ in range(32):
+        g.note(0, 10.0)
+    assert g.plan(0, [0, 1], ep) == (None, None)      # hedging off
+    g = _gov(hedge=1, hedge_min_ms=20.0)
+    assert g.plan(0, [0, 1], ep) == (None, None)      # thin signal
+    for _ in range(32):
+        g.note(0, 10.0)
+    assert g.plan(0, [0], ep) == (None, None)         # no second cand
+    assert g.plan(0, [0, 2], ep) == (None, None)      # no live endpoint
+    cand, thr = g.plan(0, [0, 1], ep)
+    assert cand == 1 and thr == 20.0
+
+
+def test_hedge_governor_straggler_flag_reorder_and_probe():
+    g = _gov()
+    for _ in range(8):
+        g.note(0, 10.0)
+        g.note(1, 150.0)
+    # the flag needs `persist` consecutive verdicts, not one
+    assert g.update_stragglers([0, 1]) == frozenset()
+    assert g.update_stragglers([0, 1]) == frozenset()
+    assert g.update_stragglers([0, 1]) == frozenset({1})
+    # flagged slots stable-move to the tail of every candidate order...
+    assert g.reorder([1, 0], rr=1) == [0, 1]
+    assert g.reorder([1, 0, 2], rr=7) == [0, 2, 1]
+    # ...EXCEPT the canary probe, which keeps its natural placement
+    assert g.reorder([1, 0], rr=0) == [1, 0]
+    assert g.reorder([1, 0], rr=g.PROBE_EVERY) == [1, 0]
+    # recovery: the probes' fast answers decay the EWMA and the flag
+    # clears on the next interval
+    for _ in range(40):
+        g.note(1, 10.0)
+    assert g.update_stragglers([0, 1]) == frozenset()
+    assert g.reorder([1, 0], rr=1) == [1, 0]
+
+
+def test_hedge_governor_remote_penalty():
+    g = _gov(hedge=1)
+    g._locality_of = lambda slot: "remote" if slot >= 2 else "local"
+    assert g.remote_penalty() == 0.0       # no signal yet
+    g.note(0, 10.0)
+    assert g.remote_penalty() == 0.0       # one locality only
+    g.note(2, 30.0)
+    assert g.remote_penalty() == pytest.approx(2.0)  # (30-10)/10
+
+
+# ------------------------------------------------------- multi-host -------
+
+def test_normalize_hosts_grammar():
+    hosts = fleet_mod.normalize_hosts(
+        ["local", "gpu@farm-3", {"name": "b", "locality": "local"},
+         {"ssh": "edge-1", "advertise": "10.0.0.7",
+          "env": {"X": "1"}, "cwd": "/srv/repo"}])
+    local, farm, b, edge = hosts
+    assert local == {"name": "local", "ssh": None, "cwd": None,
+                     "env": {}, "advertise": "127.0.0.1",
+                     "locality": "local"}
+    assert farm["ssh"] == "gpu@farm-3" and farm["name"] == "gpu_farm-3"
+    assert farm["locality"] == "remote" and farm["advertise"] == "farm-3"
+    assert b["ssh"] is None and b["locality"] == "local"
+    assert edge["advertise"] == "10.0.0.7" and edge["env"] == {"X": "1"}
+    assert edge["cwd"] == "/srv/repo" and edge["locality"] == "remote"
+
+
+def test_normalize_hosts_rejects_bad_specs():
+    with pytest.raises(ValueError, match="expected a name/ssh string"):
+        fleet_mod.normalize_hosts([42])
+    with pytest.raises(ValueError, match="bad fleet host spec keys"):
+        fleet_mod.normalize_hosts([{"hostname": "a"}])
+    with pytest.raises(ValueError, match="duplicate fleet host name"):
+        fleet_mod.normalize_hosts(["local", {"name": "local"}])
+    with pytest.raises(ValueError, match="bad fleet host locality"):
+        fleet_mod.normalize_hosts([{"name": "a", "locality": "ici"}])
+
+
+def test_order_candidates_locality_and_penalty():
+    loc = {0: "local", 1: "remote"}
+    # an idle remote worker beats a queued local one while the measured
+    # penalty is small...
+    order = order_candidates("least_loaded", "m", [0, 1],
+                             depths={0: 2.0, 1: 0.0}, rr=0,
+                             localities=loc, remote_penalty=0.0)
+    assert order[0] == 1
+    # ...and loses once the remote hop costs more than the queue saves
+    order = order_candidates("least_loaded", "m", [0, 1],
+                             depths={0: 2.0, 1: 0.0}, rr=0,
+                             localities=loc, remote_penalty=3.0)
+    assert order[0] == 0
+    # round_robin / hash stable-partition local-first
+    order = order_candidates("round_robin", "m", [0, 1, 2], rr=0,
+                             localities={0: "remote", 1: "local",
+                                         2: "local"})
+    assert order == [1, 2, 0]
+
+
+# ------------------------------------------------- deadline / cache -------
+
+def _tiny_server(**kw):
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    container = serving.ModelContainer()
+    container.add_block("m", net, example_shape=(8,), buckets=(2, 4))
+    return serving.ModelServer(container, max_wait_ms=1.0, **kw).start()
+
+
+def test_deadline_drop_before_batch_slot():
+    """A provably-unmeetable deadline is dropped with DeadlineExceeded
+    BEFORE consuming a batch slot: the batches counter does not move
+    for the doomed request and the drop is counted by `where`."""
+    from mxnet_tpu import serving
+
+    server = _tiny_server()
+    try:
+        server.warmup()
+        x = np.random.RandomState(0).randn(1, 8).astype(np.float32)
+        # seed the batch-execution estimate with real measured batches
+        for _ in range(4):
+            server.submit("m", x).result(timeout=30.0)
+        before = server.stats()["models"]["m"]
+        assert before.get("deadline_dropped", {}) == {}
+        with pytest.raises(serving.DeadlineExceeded) as ei:
+            server.submit("m", x, deadline_ms=1e-4)
+        assert ei.value.where == "submit"
+        after = server.stats()["models"]["m"]
+        assert after["deadline_dropped"] == {"submit": 1}
+        assert after["batches"] == before["batches"]  # no slot consumed
+        # a meetable deadline sails through and is counted as met
+        server.submit("m", x, deadline_ms=30000.0).result(timeout=30.0)
+        final = server.stats()["models"]["m"]
+        assert final["deadline_met"] == 1
+    finally:
+        server.drain(timeout=10.0)
+
+
+def test_prediction_cache_correct_across_version_flip():
+    """Cache hits serve the pinned version's answer; a live weight swap
+    (the model-bus path) flips the content keys so the next request
+    recomputes against the NEW weights — never stale data."""
+    server = _tiny_server(cache=True)
+    try:
+        server.warmup()
+        x = np.random.RandomState(1).randn(1, 8).astype(np.float32)
+        f1 = server.submit("m", x)
+        r1 = np.asarray(f1.result(timeout=30.0)[0])
+        assert f1.cache_hit is False
+        f2 = server.submit("m", x)
+        r2 = np.asarray(f2.result(timeout=30.0)[0])
+        assert f2.cache_hit is True and np.allclose(r1, r2)
+        # the model-bus version flip: same shapes, new weights
+        model = server.container.get("m")
+        praws, araws, _v = model.pinned()
+        model.swap_params([np.asarray(p) * 1.5 for p in praws],
+                          version=7, aux_raws=araws)
+        f3 = server.submit("m", x)
+        r3 = np.asarray(f3.result(timeout=30.0)[0])
+        assert f3.cache_hit is False           # old keys died with v0
+        assert not np.allclose(r1, r3)         # computed on new weights
+        f4 = server.submit("m", x)
+        assert f4.cache_hit is True
+        assert np.allclose(r3, np.asarray(f4.result(timeout=30.0)[0]))
+    finally:
+        server.drain(timeout=10.0)
+
+
+def test_prediction_cache_unit_lru_and_invalidation():
+    from mxnet_tpu.serving import cache as cache_mod
+
+    pc = cache_mod.PredictionCache(capacity=2)
+    a = np.zeros((1, 4), np.float32)
+    k1 = cache_mod.content_key("m", 1, a)
+    assert cache_mod.content_key("m", 2, a) != k1  # version in the key
+    assert pc.get(k1) is None
+    pc.put(k1, a, version=1)
+    hit = pc.get(k1)
+    assert hit is not None
+    hit[:] = 99.0                                  # copies never alias
+    assert float(pc.get(k1)[0, 0]) == 0.0
+    # bounded: eldest falls off past capacity
+    pc.put("k2", a, version=1)
+    pc.put("k3", a, version=1)
+    assert len(pc) == 2 and pc.get(k1) is None
+    # observe_version on a flip drops the dead generation
+    pc.observe_version(1)
+    assert len(pc) == 2
+    pc.observe_version(2)
+    assert len(pc) == 0 and pc.stats()["invalidations"] == 2
+
+
 # ------------------------------------------------------- live fleet -------
 
 def _predict(client, model, x):
@@ -560,6 +859,52 @@ def test_fleet_autoscaler_scales_up_under_load_and_down_on_idle(
     assert out["summary"]["autoscaler"]["decisions"]["down"] >= 1
     assert out["summary"]["generation"] == 1
     assert out["summary"]["workers"]
+
+
+def test_fleet_two_host_placement_and_merged_scrape(
+        tmp_path, fleet_cleanup):
+    """Multi-host live: two localhost pseudo-hosts under one fleet —
+    slots place round-robin across them, each host gets its own run dir
+    (host-<name>/) for announces + telemetry shards, and read_workers /
+    worker_metrics merge the per-host shards into one fleet view the
+    router serves traffic from."""
+    import loadgen
+
+    v1 = tmp_path / "v1"
+    worker_mod.write_spec(v1, worker_mod.demo_spec(models=1, seed=920,
+                                                   buckets=(2, 4)))
+    fl = ServingFleet(
+        str(v1), workers=2, run_dir=str(tmp_path / "run"),
+        hosts=["local", {"name": "b", "locality": "local"}],
+        config={"min": 2, "max": 2, "beat": 0.2, "grace": 20},
+        name="twohost")
+    fleet_cleanup.append(fl)
+    fl.start(timeout=120)
+    # placement: hosts[slot % 2] — slot 0 on "local", slot 1 on "b"
+    st = fl.stats()
+    assert {s: w["host"] for s, w in st["workers"].items()} == \
+        {"0": "local", "1": "b"}
+    assert all(w["locality"] == "local" for w in st["workers"].values())
+    assert {h["name"]: h["slots"] for h in st["hosts"]} == \
+        {"local": [0], "b": [1]}
+    # per-host run dirs own the announces; the scrape merges them
+    assert (tmp_path / "run" / "host-local" / "worker-0.json").exists()
+    assert (tmp_path / "run" / "host-b" / "worker-1.json").exists()
+    assert sorted(worker_mod.read_workers(fl.run_dir)) == [0, 1]
+    # traffic flows through both placements
+    cl = loadgen.KeepAliveClient(fl.url)
+    x = np.random.RandomState(0).randn(1, 16).astype(np.float32)
+    for _ in range(30):
+        status, _ = _predict(cl, "model0", x)
+        assert status == 200
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        m = worker_metrics(fl.run_dir)
+        if sorted(m) == [0, 1] and all(
+                (m[s].get("rps") or 0) >= 0 for s in m):
+            break
+        time.sleep(0.2)
+    assert sorted(worker_metrics(fl.run_dir)) == [0, 1]
 
 
 # ----------------------------------------------------------- loadgen ------

@@ -400,7 +400,10 @@ def test_chaos_smoke_recovers(tmp_path):
     continued service; subprocess SIGTERM under load -> all admitted
     requests answered, exit 75), and the phase-8 gang drill recovers a
     supervised 2-worker run from a mid-epoch SIGKILL (generation bump,
-    resharded resume, loss parity) — exit code 0."""
+    resharded resume, loss parity) — exit code 0. The phase-17 planet-
+    scale drill (four fleets' worth of subprocess workers) is skipped
+    here to hold the tier-1 budget; test_chaos_smoke_hedging_drill
+    runs it in the slow tier."""
     import chaos_smoke
 
     from mxnet_tpu import faults, preempt
@@ -408,6 +411,7 @@ def test_chaos_smoke_recovers(tmp_path):
     faults.reset()
     try:
         rc = chaos_smoke.main(["--epochs", "2", "--steps", "4",
+                               "--skip-hedging-drill",
                                "--dir", str(tmp_path)])
     finally:
         faults.reset()
@@ -440,3 +444,31 @@ def test_chaos_smoke_recovers(tmp_path):
         world = json.load(f)
     assert world["incarnation"] == 2
     assert any(a["kind"] == "adopt" for a in world["actions"])
+
+
+@pytest.mark.slow
+def test_chaos_smoke_hedging_drill(tmp_path):
+    """tools/chaos_smoke.py --phases 17: the planet-scale serving
+    drill on its own — the 2-host straggler fleet where hedging must
+    cut p99 >=3x with zero errors, the full host loss under one
+    cluster.json with zero client-visible errors, and the QoS
+    starvation order (batch starves before interactive; unmeetable
+    deadlines drop before a batch slot) — exit code 0."""
+    import chaos_smoke
+
+    from mxnet_tpu import faults, preempt
+
+    faults.reset()
+    try:
+        rc = chaos_smoke.main(["--phases", "17",
+                               "--dir", str(tmp_path)])
+    finally:
+        faults.reset()
+        preempt.uninstall()
+    assert rc == 0
+    # drill A left both fleets' per-host run dirs behind — the merged-
+    # scrape topology the router placed workers across
+    for label in ("hedge-off", "hedge-on"):
+        run = tmp_path / "hedge" / label / "run"
+        assert (run / "host-local").is_dir()
+        assert (run / "host-slow").is_dir()

@@ -98,6 +98,14 @@ Proves the fault-tolerance stack end to end on one machine, fast:
     by pid + start-ticks: zero healthy-worker restarts, zero dropped
     admitted requests, then a SIGTERM drains the whole topology through
     the exit ladder (``--skip-cluster-drill`` skips it),
+  * the HEDGING drill (phase 17): planet-scale serving resilience — a
+    2-host fleet (two localhost pseudo-hosts, distinct per-host run
+    dirs) with one persistently-straggling host: hedged requests must
+    cut the client p99 >=3x vs hedging-off; the same topology under one
+    ``cluster.json`` then loses a FULL host under load with zero
+    client-visible errors; and an in-process saturating burst proves
+    batch starves before interactive degrades + unmeetable deadlines
+    drop before a batch slot (``--skip-hedging-drill`` skips it),
   * a final integrity pass (all params finite, manifest verifies).
 
 Run it on a dev box or in CI::
@@ -130,7 +138,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # selection always runs with its prerequisites in place.
 PHASE_DEPS = {1: (), 2: (1,), 3: (2,), 4: (2,), 5: (4,), 6: (5,),
               7: (3, 6), 8: (), 9: (5,), 10: (), 11: (3,), 12: (6,),
-              13: (), 14: (), 15: (), 16: ()}
+              13: (), 14: (), 15: (), 16: (), 17: ()}
 
 
 def parse_phases(spec):
@@ -165,7 +173,7 @@ class _PhaseClock:
     ``enter(n)`` closes the previous phase's span and answers whether
     phase ``n`` is selected; ``report()`` prints one budget line per
     phase that ran plus the total — the receipt CI reads to keep all
-    16 phases under the tier-1 timeout and to spot the phase that eats
+    17 phases under the tier-1 timeout and to spot the phase that eats
     the budget when they drift."""
 
     def __init__(self, selected):
@@ -736,6 +744,288 @@ def fleet_drill(root=None):
           f"old generation exits {sorted(rec['drained'].values())}, "
           f"{completed[0]} requests completed / 0 dropped "
           f"({stats['router']['retries']} router retries total)")
+    return 0
+
+
+def hedging_drill(root=None):
+    """Phase 17: planet-scale serving resilience — a 2-host fleet under
+    a persistent straggler, a full host loss, and the QoS starvation
+    order.
+
+    Drill A places a 2-worker fleet on two localhost pseudo-hosts, one
+    of which stalls every serving batch 250 ms via the ``serving.batch``
+    fault point, and drives the router closed-loop twice with the same
+    topology: hedging OFF then ON. The straggler detector must flag the
+    slow host's slot, hedged requests must fire and win (the canary
+    probes that keep supplying the flagged slot are rescued at the
+    hedge floor), and the client-visible p99 must drop by >=3x — with
+    zero errors either way.
+
+    Drill B runs the same 2-host shape as a serving-fleet role under
+    ONE ``cluster.json`` — per-host run dirs (``host-<name>/``) whose
+    announce shards merge at scrape — then SIGKILLs every worker of one
+    host under load: a full host loss. The router must retry onto the
+    surviving host with ZERO client-visible errors (no admitted request
+    dropped) while the reconciler charges the restart and respawns the
+    slot in place.
+
+    Drill C proves the QoS contract in-process: a saturating burst
+    submitted batch-FIRST must still drain interactive first (batch
+    starves before interactive degrades — median interactive latency
+    strictly under median batch latency), and a provably-unmeetable
+    deadline must be dropped with :class:`DeadlineExceeded` BEFORE
+    consuming a batch slot while the backlog around it completes."""
+    import json as _json
+    import signal
+    import threading
+
+    import numpy as np
+
+    import loadgen
+    import mxnet_tpu as mx
+    from mxnet_tpu import cluster as cluster_mod
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import worker as worker_mod
+
+    root = root or tempfile.mkdtemp(prefix="chaos_hedge_")
+
+    # ---- drill A: injected straggler, hedging off vs on ----------------
+    hosts = ["local",
+             {"name": "slow", "locality": "local",
+              "env": {"MXNET_TPU_FAULTS": "serving.batch:delay@*:0.25"}}]
+    cfg = {"beat": 0.2, "grace": 20, "interval": 0.3,
+           "hedge_min_ms": 20.0}
+    reps = {}
+    for label, hedge in (("off", 0), ("on", 1)):
+        reps[label] = loadgen.run_fleet(
+            workers=2, duration=6.0, concurrency=8, models=1,
+            run_dir=os.path.join(root, f"hedge-{label}"),
+            hosts=[h if isinstance(h, str) else dict(h) for h in hosts],
+            config=dict(cfg, hedge=hedge))
+    for label, rep in reps.items():
+        if rep.get("errors"):
+            print(f"FAIL: hedge-{label} run leaked {rep['errors']} "
+                  f"client error(s): {rep.get('first_errors')}")
+            return 1
+        placed = sorted(set((w or {}).get("host")
+                            for w in rep["per_worker"].values()))
+        if placed != ["local", "slow"]:
+            print(f"FAIL: hedge-{label} workers not placed across both "
+                  f"hosts: {rep['per_worker']}")
+            return 1
+    p99_off = reps["off"].get("p99_ms") or 0.0
+    p99_on = reps["on"].get("p99_ms") or 0.0
+    hedges = reps["on"].get("hedges") or {}
+    if not p99_on or p99_off / p99_on < 3.0:
+        print(f"FAIL: hedging did not cut p99 >=3x under the injected "
+              f"straggler: off {p99_off}ms -> on {p99_on}ms "
+              f"(hedges {hedges}, "
+              f"stragglers {reps['on'].get('stragglers')})")
+        return 1
+    if hedges.get("fired", 0) < 1 or hedges.get("won", 0) < 1:
+        print(f"FAIL: no hedge ever fired/won under a persistent "
+              f"straggler: {hedges}")
+        return 1
+    if 1 not in [int(s) for s in reps["on"].get("stragglers") or []]:
+        print(f"FAIL: the slow host's slot was never flagged: "
+              f"stragglers={reps['on'].get('stragglers')}")
+        return 1
+    print(f"  hedging drill: straggler host flagged "
+          f"{reps['on']['stragglers']}, hedges {hedges['fired']} fired /"
+          f" {hedges['won']} won -> p99 {p99_off:.1f}ms unhedged vs "
+          f"{p99_on:.1f}ms hedged ({p99_off / p99_on:.1f}x cut, "
+          f"0 errors)")
+
+    # ---- drill B: full host loss under one cluster.json ----------------
+    v1 = os.path.join(root, "v1")
+    worker_mod.write_spec(v1, worker_mod.demo_spec(models=1, seed=170))
+    sup = cluster_mod.ClusterSupervisor(
+        {"cluster": "chaos-hedge", "roles": {"serve": {
+            "kind": "serving-fleet", "model_dir": v1, "workers": 2,
+            "min": 2, "max": 2, "restarts": 3, "backoff": 0.05,
+            "grace": 20, "dead_after": 10,
+            "hosts": ["local", {"name": "b", "locality": "local"}]}}},
+        run_dir=os.path.join(root, "cluster"), poll=0.05)
+    serve = sup.roles["serve"]
+    try:
+        sup.wait_ready(timeout=120)
+    except cluster_mod.ClusterError as e:
+        sup.stop(graceful=False)
+        print(f"FAIL: 2-host cluster fleet never became ready: {e}")
+        return 1
+    hostdirs = sorted(d for d in os.listdir(serve.dir)
+                      if d.startswith("host-"))
+    anns = worker_mod.read_workers(serve.dir)
+    if hostdirs != ["host-b", "host-local"] or len(anns) != 2:
+        sup.stop(graceful=False)
+        print(f"FAIL: per-host run dirs / merged announce scrape wrong: "
+              f"dirs={hostdirs} announces={sorted(anns)}")
+        return 1
+
+    lock = threading.Lock()
+    stop = threading.Event()
+    errors = []
+    completed = [0]
+    pool = [np.random.RandomState(i).randn(1, 16).astype(np.float32)
+            for i in range(8)]
+
+    def load_worker(tid):
+        cl = loadgen.KeepAliveClient(serve._router.url)
+        i = 0
+        while not stop.is_set():
+            body = _json.dumps(
+                {"data": pool[(tid + i) % len(pool)].tolist()}).encode()
+            try:
+                status, _, _ = cl.request(
+                    "POST", "/v1/models/model0:predict", body=body,
+                    headers={"Content-Type": "application/json"})
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            else:
+                if status == 200:
+                    with lock:
+                        completed[0] += 1
+                elif status not in (429, 503):
+                    with lock:
+                        errors.append(f"HTTP {status}")
+            i += 1
+            time.sleep(0.002)
+
+    tick_stop = threading.Event()
+
+    def ticker():
+        while not tick_stop.is_set():
+            sup.tick()
+            tick_stop.wait(0.05)
+
+    tick_thread = threading.Thread(target=ticker, daemon=True)
+    tick_thread.start()
+    threads = [threading.Thread(target=load_worker, args=(t,),
+                                daemon=True) for t in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)  # a steady admitted stream before the host loss
+
+    # host "b" owns every odd slot (hosts[slot % len(hosts)]); killing
+    # them all IS the full host loss
+    victims = {s: serve.slots[s].pid for s in serve.slots
+               if serve._host_of(s)["name"] == "b"}
+    if not victims:
+        stop.set()
+        tick_stop.set()
+        sup.stop(graceful=False)
+        print("FAIL: no slot placed on host 'b'")
+        return 1
+    for pid in victims.values():
+        os.kill(pid, signal.SIGKILL)
+    deadline = time.monotonic() + 60.0
+    recovered = False
+    while time.monotonic() < deadline:
+        live = all(
+            (s := serve.slots.get(v)) is not None and s.restarts >= 1
+            and s.pid != pid and s.alive() and v in serve._routable
+            for v, pid in victims.items())
+        if live:
+            recovered = True
+            break
+        time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    tick_stop.set()
+    tick_thread.join(timeout=10.0)
+    retries = serve._counters["retries"]
+    ledger = dict(sup.world.ledger.get("serve") or {})
+    sup.stop()
+    if not recovered:
+        print(f"FAIL: host-b slots {sorted(victims)} never respawned "
+              f"after the host loss")
+        return 1
+    if errors:
+        print(f"FAIL: full host loss leaked {len(errors)} client "
+              f"error(s): {errors[:3]}")
+        return 1
+    if ledger.get("restarts_total", 0) < len(victims):
+        print(f"FAIL: world record never charged the host-loss "
+              f"restart(s): {ledger}")
+        return 1
+    print(f"  host-loss drill: host b (slots {sorted(victims)}) killed "
+          f"under load -> {completed[0]} requests completed, 0 client "
+          f"errors ({retries} router retries), reconciler respawned "
+          f"the host's slots in place")
+
+    # ---- drill C: batch starves before interactive degrades ------------
+    mx.random.seed(17)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    container = serving.ModelContainer()
+    container.add_block("qos", net, example_shape=(8,), buckets=(2, 4, 8))
+    server = serving.ModelServer(container, max_wait_ms=1.0).start()
+    server.warmup()
+    from mxnet_tpu import faults as faults_mod
+    try:
+        rng = np.random.RandomState(17)
+        futs = {"batch": [], "interactive": []}
+        # stall the FIRST batch execution 80 ms so the whole burst is
+        # queued before the collector drains anything — the class
+        # medians then reflect the starvation order, not seeding speed
+        faults_mod.configure({"serving.batch": "delay@1:0.08"})
+        # batch submitted FIRST — and twice as much of it, so the class
+        # medians separate even if a few batch rows drain while the
+        # burst is still being seeded
+        for klass, count in (("batch", 64), ("interactive", 32)):
+            for _ in range(count):
+                futs[klass].append(server.submit(
+                    "qos", rng.randn(1, 8).astype(np.float32),
+                    priority=klass))
+        for flist in futs.values():
+            for f in flist:
+                f.result(timeout=60.0)
+        med = {}
+        for klass, flist in futs.items():
+            lats = sorted(f.latency_ms() for f in flist)
+            med[klass] = lats[len(lats) // 2]
+        if med["interactive"] >= med["batch"]:
+            print(f"FAIL: batch did not starve before interactive: "
+                  f"median interactive {med['interactive']:.2f}ms vs "
+                  f"batch {med['batch']:.2f}ms")
+            return 1
+        # a provably-unmeetable deadline dies BEFORE a batch slot while
+        # the backlog around it completes untouched
+        backlog = [server.submit("qos",
+                                 rng.randn(1, 8).astype(np.float32),
+                                 priority="batch") for _ in range(32)]
+        dropped = False
+        try:
+            doomed = server.submit(
+                "qos", rng.randn(1, 8).astype(np.float32),
+                priority="interactive", deadline_ms=0.01)
+        except serving.DeadlineExceeded:
+            dropped = True       # submit-time estimate said unmeetable
+        else:
+            try:
+                doomed.result(timeout=30.0)
+            except serving.DeadlineExceeded:
+                dropped = True   # queue-time doom check caught it
+        for f in backlog:
+            f.result(timeout=60.0)
+        stats = server.stats()["models"]["qos"]
+        drops = stats.get("deadline_dropped") or {}
+        if not dropped or not sum(drops.values()):
+            print(f"FAIL: unmeetable deadline was not dropped before a "
+                  f"batch slot: dropped={dropped} counters={drops}")
+            return 1
+    finally:
+        faults_mod.reset()
+        server.drain(timeout=10.0)
+    print(f"  qos drill: interactive median {med['interactive']:.2f}ms "
+          f"vs batch {med['batch']:.2f}ms under a saturating burst "
+          f"(batch starved first), unmeetable deadline dropped before a "
+          f"slot ({drops})")
     return 0
 
 
@@ -1384,6 +1674,12 @@ def main(argv=None):
                         help="skip the phase-16 cluster control-plane "
                              "drill (supervisor SIGKILL mid-load + "
                              "re-adoption; spawns a worker topology)")
+    parser.add_argument("--skip-hedging-drill", action="store_true",
+                        help="skip the phase-17 planet-scale serving "
+                             "drills (2-host straggler hedging + full "
+                             "host loss + QoS starvation order; spawns "
+                             "four short-lived fleets' worth of worker "
+                             "subprocesses)")
     parser.add_argument("--phases", default=None, metavar="N,M",
                         help="run only these phases (comma list and/or "
                              "ranges, e.g. '13,16' or '1-7'); "
@@ -2062,6 +2358,18 @@ def main(argv=None):
         if not args.skip_cluster_drill:
             rc = cluster_drill(root=os.path.join(ckpt_dir, "cluster"),
                                seed=args.seed)
+            if rc:
+                return rc
+
+    # phase 17: planet-scale serving resilience — a 2-host fleet with a
+    # persistently-straggling host (hedging must cut p99 >=3x, zero
+    # errors), a full host loss under one cluster.json (zero client
+    # errors, reconciler respawns the host's slots), and the QoS
+    # starvation order (batch starves before interactive; unmeetable
+    # deadlines drop before a batch slot)
+    if clock.enter(17):
+        if not args.skip_hedging_drill:
+            rc = hedging_drill(root=os.path.join(ckpt_dir, "hedge"))
             if rc:
                 return rc
 

@@ -359,11 +359,14 @@ def check_serving():
 
 
 def check_fleet():
-    """Serving fleet (docs/SERVING.md "Fleet"): autoscaler knobs, the
-    live fleet in this process (if any), and the last run's fleet.json —
-    worker census with per-worker rps/queue/p99 from the telemetry
-    shards, autoscaler state + last decision, rollout generation
-    history, router retry/reject counters."""
+    """Serving fleet (docs/SERVING.md "Fleet" / "Planet scale"):
+    autoscaler knobs, the live fleet in this process (if any), and the
+    last run's fleet.json — worker census with per-worker rps/queue/p99
+    from the telemetry shards, autoscaler state + last decision, rollout
+    generation history, router retry/reject counters, hedge
+    counters/outcomes + straggler flags, per-host placement, and the
+    QoS aggregates the merged shards carry (per-class latency, deadline
+    drops/outcomes, prediction-cache census)."""
     _p("---------Serving Fleet---------")
     out = {"MXNET_TPU_FLEET": os.environ.get("MXNET_TPU_FLEET"),
            "MXTPU_FLEET_DIR": os.environ.get("MXTPU_FLEET_DIR")}
@@ -411,6 +414,18 @@ def check_fleet():
            f"{router.get('retries', 0)} retries, "
            f"{router.get('rejects', 0)} rejects, "
            f"{router.get('errors', 0)} errors")
+        hedges = summary.get("hedges")
+        if hedges is not None:
+            rl = summary.get("router_latency") or {}
+            _p(f"  hedges      : {hedges.get('fired', 0)} fired / "
+               f"{hedges.get('won', 0)} won / {hedges.get('lost', 0)} "
+               f"lost / {hedges.get('failed', 0)} failed  stragglers "
+               f"{summary.get('stragglers')}  router p50/p99 "
+               f"{rl.get('p50_ms')}/{rl.get('p99_ms')} ms")
+        for h in summary.get("hosts") or []:
+            _p(f"  host        : {str(h.get('name')):<10s} "
+               f"{str(h.get('ssh') or 'local'):<18s} locality "
+               f"{str(h.get('locality')):<7s} slots {h.get('slots')}")
         auto = summary.get("autoscaler") or {}
         last = auto.get("last_action") or auto.get("last")
         _p(f"  autoscaler  : {'on' if auto.get('enabled') else 'off'}  "
@@ -421,20 +436,68 @@ def check_fleet():
                f"({r.get('state')}) <- {r.get('model_dir')} "
                f"drained {r.get('drained')}")
         _p(f"  {'slot':<5s} {'gen':>3s} {'state':<9s} {'ready':<5s} "
-           f"{'rps':>8s} {'queue':>6s} {'p99ms':>8s} {'restarts':>8s}")
+           f"{'rps':>8s} {'queue':>6s} {'p99ms':>8s} {'restarts':>8s} "
+           f"{'host':<10s}")
         workers = summary.get("workers") or {}
-        from mxnet_tpu.serving.fleet import worker_metrics
+        from mxnet_tpu.serving.fleet import _series_values, worker_metrics
 
         live_m = worker_metrics(run_dir)
         out["worker_metrics"] = live_m
         for slot, w in sorted(workers.items(), key=lambda kv: int(kv[0])):
             m = live_m.get(int(slot)) or {}
+            place = str(w.get("host") or "-") \
+                + (" STRAGGLER" if w.get("straggler") else "")
             _p(f"  {slot:<5s} {w.get('generation', '?'):>3} "
                f"{str(w.get('state')):<9s} {str(w.get('ready')):<5s} "
                f"{str(m.get('rps') if m.get('rps') is not None else w.get('rps')):>8s} "
                f"{str(m.get('queue_depth')):>6s} "
                f"{str(m.get('p99_ms')):>8s} "
-               f"{str(w.get('restarts')):>8s}")
+               f"{str(w.get('restarts')):>8s} {place:<10s}")
+        # QoS aggregates from the merged per-host telemetry shards:
+        # per-class latency, deadline admission outcomes, cache census
+        from mxnet_tpu.telemetry import fleet as tfleet
+
+        agg = {"submit": 0.0, "queue": 0.0, "met": 0.0, "missed": 0.0,
+               "hit": 0.0, "miss": 0.0}
+        classes = {}
+        for shard in tfleet.read_shards(run_dir).values():
+            for where in ("submit", "queue"):
+                agg[where] += sum(_series_values(
+                    shard, "mxtpu_serving_deadline_dropped_total",
+                    where=where))
+            for outcome in ("met", "missed"):
+                agg[outcome] += sum(_series_values(
+                    shard, "mxtpu_serving_deadline_outcomes_total",
+                    outcome=outcome))
+            for outcome in ("hit", "miss"):
+                agg[outcome] += sum(_series_values(
+                    shard, "mxtpu_serving_cache_requests_total",
+                    outcome=outcome))
+            for klass in ("interactive", "batch"):
+                for q in ("p50", "p99"):
+                    vals = _series_values(
+                        shard, "mxtpu_serving_class_latency_ms",
+                        quantile=q, **{"class": klass})
+                    if vals:
+                        cur = classes.setdefault(klass, {})
+                        cur[q] = max(cur.get(q, 0.0), max(vals))
+        out["qos"] = {"deadline": {k: agg[k] for k in
+                                   ("submit", "queue", "met", "missed")},
+                      "cache_hits": agg["hit"],
+                      "cache_misses": agg["miss"],
+                      "by_class": classes}
+        if any(agg.values()) or classes:
+            _p(f"  deadlines   : dropped {int(agg['submit'])} at "
+               f"submit / {int(agg['queue'])} in queue, "
+               f"{int(agg['met'])} met / {int(agg['missed'])} missed")
+            lookups = agg["hit"] + agg["miss"]
+            _p(f"  pred. cache : {int(agg['hit'])} hits / "
+               f"{int(agg['miss'])} misses"
+               + (f" (hit ratio {agg['hit'] / lookups:.4f})"
+                  if lookups else ""))
+            for klass, cur in sorted(classes.items()):
+                _p(f"  class       : {klass:<12s} p50 "
+                   f"{cur.get('p50')} ms  p99 {cur.get('p99')} ms")
     except ImportError as e:
         out["error"] = str(e)
         _p("fleet import failed:", e)

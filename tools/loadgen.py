@@ -47,12 +47,23 @@ understate rps. Connect time is measured separately from request time
 and reported as ``connects`` / ``reconnects`` / ``connect_ms_mean``
 alongside the request-latency percentiles.
 
+QoS knobs (every target): ``--priority-mix 4:1`` stamps
+interactive/batch priority classes in that ratio and splits the report
+per class; ``--deadline-ms`` stamps per-request deadlines (admission
+drops are counted per class, never as errors); ``--hot-key-frac``
+re-sends ONE hot (model, input) pair for that fraction of requests,
+driving the prediction cache (``cache_hit_ratio`` in the report). Fleet
+mode additionally reports hedge outcomes + straggler flags from the
+router.
+
 Examples::
 
     JAX_PLATFORMS=cpu python tools/loadgen.py --duration 30
     python tools/loadgen.py --mode open --rate 2000 --duration 10
     python tools/loadgen.py --via-http --duration 5
     python tools/loadgen.py --workers 4 --duration 10
+    python tools/loadgen.py --workers 2 --priority-mix 4:1 \
+        --deadline-ms 50 --hot-key-frac 0.3 --duration 10
 
 The last stdout line is one JSON report (bench.py --serve embeds it into
 the BENCH_r06+ metric series).
@@ -278,6 +289,100 @@ def run_pair(duration=20.0, concurrency=16, vocab=50_000, embed_dim=512,
     return report
 
 
+# ----------------------------------------------------------- QoS harness --
+
+def parse_priority_mix(spec):
+    """``'4:1'`` -> 0.8, the interactive fraction of an
+    interactive:batch traffic mix (None passes through: single-class
+    traffic, no per-class report)."""
+    if spec is None:
+        return None
+    try:
+        i, b = (float(t) for t in str(spec).split(":"))
+    except ValueError:
+        raise ValueError(f"bad --priority-mix {spec!r}: expected "
+                         "interactive:batch weights, e.g. 4:1")
+    if i < 0 or b < 0 or i + b <= 0:
+        raise ValueError(f"bad --priority-mix {spec!r}: weights must be "
+                         ">= 0 and not both zero")
+    return i / (i + b)
+
+
+class _QoSPlan:
+    """Per-request deterministic QoS decisions for a load worker: which
+    priority class (from the interactive fraction), whether to reuse the
+    ONE hot input (driving prediction-cache hits), and the deadline to
+    stamp. Pure arithmetic on (tid, i) so runs reproduce."""
+
+    def __init__(self, priority_mix=None, hot_key_frac=0.0,
+                 deadline_ms=None):
+        self.frac = parse_priority_mix(priority_mix)
+        self.hot = min(max(float(hot_key_frac or 0.0), 0.0), 1.0)
+        self.deadline_ms = deadline_ms
+        self.active = (self.frac is not None or self.hot > 0.0
+                       or deadline_ms is not None)
+
+    def klass(self, tid, i):
+        if self.frac is None:
+            return "interactive"
+        return "interactive" \
+            if ((tid * 7919 + i) % 1000) < self.frac * 1000 else "batch"
+
+    def hot_key(self, tid, i):
+        return self.hot > 0.0 \
+            and ((tid * 104729 + i * 31) % 1000) < self.hot * 1000
+
+    def body_fields(self, tid, i):
+        """The extra JSON request fields for this (tid, i) request:
+        ``{}`` when every knob is off (byte-identical legacy bodies)."""
+        out = {}
+        if self.frac is not None:
+            out["priority"] = self.klass(tid, i)
+        if self.deadline_ms is not None:
+            out["deadline_ms"] = self.deadline_ms
+        return out
+
+
+class _QoSAgg:
+    """Per-class latency/drop/cache accounting folded into the report:
+    ``by_class`` per-class p50/p99 + deadline drops, plus the flat
+    cache-hit and deadline-miss counters."""
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.lats = {}        # class -> [ms]
+        self.dropped = {}     # class -> deadline drops (504 dropped)
+        self.cache_hits = 0
+
+    def record(self, klass, ms=None, dropped=False, cache_hit=False):
+        with self._lock:
+            if dropped:
+                self.dropped[klass] = self.dropped.get(klass, 0) + 1
+            elif ms is not None:
+                self.lats.setdefault(klass, []).append(ms)
+            if cache_hit:
+                self.cache_hits += 1
+
+    def fold(self, report, plan):
+        with self._lock:
+            completed = sum(len(v) for v in self.lats.values())
+            report["deadline_dropped"] = sum(self.dropped.values())
+            report["cache_hits"] = self.cache_hits
+            report["cache_hit_ratio"] = (
+                round(self.cache_hits / completed, 4) if completed
+                else None)
+            if plan.frac is not None:
+                report["by_class"] = {
+                    k: dict(_percentiles(sorted(v)), completed=len(v),
+                            deadline_dropped=self.dropped.get(k, 0))
+                    for k, v in sorted(self.lats.items())}
+                for k, n in sorted(self.dropped.items()):
+                    if k not in report["by_class"]:
+                        report["by_class"][k] = {
+                            "completed": 0, "deadline_dropped": n}
+        return report
+
+
 # ------------------------------------------------- keep-alive HTTP client --
 
 class KeepAliveClient:
@@ -417,10 +522,13 @@ class _PhaseAgg:
 
 def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                models=2, dim=16, warmup=True, server=None, via_http=False,
-               max_wait_ms=None):
+               max_wait_ms=None, priority_mix=None, hot_key_frac=0.0,
+               deadline_ms=None):
     """Drive a ModelServer (built here unless `server` is passed) and
     return the report dict. With ``via_http`` the same traffic goes
-    through the JSON front end on a loopback socket."""
+    through the JSON front end on a loopback socket. The QoS knobs
+    behave as in :func:`run_http` (per-class report, hot-key cache
+    traffic, per-request deadlines — drops counted, not errors)."""
     import numpy as np
 
     from mxnet_tpu import compile as _compile
@@ -429,12 +537,16 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
     own_server = server is None
     if own_server:
         container = build_demo_container(models=models, dim=dim)
-        server = serving.ModelServer(container).start()
+        # hot-key traffic implies the prediction-cache scenario: turn
+        # the (default-off) cache on so hits are measurable
+        cache = True if float(hot_key_frac or 0.0) > 0.0 else None
+        server = serving.ModelServer(container, cache=cache).start()
     names = server.models()
     if warmup:
         server.warmup()
     pre = _compile.stats().get("serving", {})
     pre_misses = pre.get("misses", 0)
+    plan = _QoSPlan(priority_mix, hot_key_frac, deadline_ms)
 
     front = None
     clients, tl = [], threading.local()
@@ -442,7 +554,7 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
     if via_http:
         front = serving.HttpFrontEnd(server).start()
 
-        def do_request(name, x):
+        def do_request(name, x, tid, i):
             # one keep-alive connection per worker thread: connect time
             # is measured inside the client and subtracted from the
             # request latency sample by the caller
@@ -451,22 +563,33 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                 cl = tl.client = KeepAliveClient(front.url)
                 with client_lock:
                     clients.append(cl)
-            body = json.dumps({"data": x.tolist()}).encode()
+            req = {"data": x.tolist()}
+            req.update(plan.body_fields(tid, i))
+            body = json.dumps(req).encode()
             status, payload, connect_ms = cl.request(
                 "POST", f"/v1/models/{name}:predict", body=body,
                 headers={"Content-Type": "application/json"})
             if status in (429, 503):
                 raise serving.ServerBusyError(name, 0, 0)
             if status != 200:
+                try:
+                    data = json.loads(payload)
+                except ValueError:
+                    data = {}
+                if status == 504 and data.get("dropped"):
+                    raise serving.DeadlineExceeded(
+                        name, plan.deadline_ms)
                 raise RuntimeError(f"HTTP {status}: {payload[:120]!r}")
             data = json.loads(payload)
             return data.get("phases"), connect_ms, \
-                data.get("model_version")
+                data.get("model_version"), bool(data.get("cache_hit"))
     else:
-        def do_request(name, x):
-            fut = server.submit(name, x)
+        def do_request(name, x, tid, i):
+            fut = server.submit(name, x, priority=plan.klass(tid, i),
+                                deadline_ms=plan.deadline_ms)
             fut.result(10.0)
-            return fut.breakdown(), 0.0, fut.model_version
+            return fut.breakdown(), 0.0, fut.model_version, \
+                bool(fut.cache_hit)
 
     pool = [np.random.RandomState(i).randn(1, dim).astype(np.float32)
             for i in range(64)]
@@ -474,6 +597,7 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
     lats, completed, rejected, errors = [], [0], [0], []
     versions = set()   # distinct model-bus versions seen in responses
     phases = _PhaseAgg(lock)
+    qos = _QoSAgg(lock)
     stop_at = time.perf_counter() + duration
 
     def record(ms, ver=None):
@@ -486,14 +610,22 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
     def closed_worker(tid):
         i = 0
         while time.perf_counter() < stop_at:
-            name = names[(tid + i) % len(names)]
-            x = pool[(tid * 7 + i) % len(pool)]
+            if plan.hot_key(tid, i):
+                name, x = names[0], pool[0]
+            else:
+                name = names[(tid + i) % len(names)]
+                x = pool[(tid * 7 + i) % len(pool)]
+            klass = plan.klass(tid, i)
             t0 = time.perf_counter()
             try:
-                bd, connect_ms, ver = do_request(name, x)
-                record((time.perf_counter() - t0) * 1e3 - connect_ms,
-                       ver)
+                bd, connect_ms, ver, cache_hit = do_request(
+                    name, x, tid, i)
+                ms = (time.perf_counter() - t0) * 1e3 - connect_ms
+                record(ms, ver)
+                qos.record(klass, ms, cache_hit=cache_hit)
                 phases.record(bd)
+            except serving.DeadlineExceeded:
+                qos.record(klass, dropped=True)
             except serving.ServerBusyError:
                 with lock:
                     rejected[0] += 1
@@ -520,12 +652,16 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                     if done.is_set():
                         return
                     continue
-                t0, fut = item
+                t0, klass, fut = item
                 try:
                     fut.result(10.0)
-                    record((time.perf_counter() - t0) * 1e3,
-                           fut.model_version)
+                    ms = (time.perf_counter() - t0) * 1e3
+                    record(ms, fut.model_version)
+                    qos.record(klass, ms,
+                               cache_hit=bool(fut.cache_hit))
                     phases.record(fut.breakdown())
+                except serving.DeadlineExceeded:
+                    qos.record(klass, dropped=True)
                 except serving.ServerBusyError:
                     with lock:
                         rejected[0] += 1
@@ -546,12 +682,19 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
                 time.sleep(min(nxt - now, 0.002))
                 continue
             nxt += period
-            name = names[i % len(names)]
-            x = pool[i % len(pool)]
+            if plan.hot_key(0, i):
+                name, x = names[0], pool[0]
+            else:
+                name = names[i % len(names)]
+                x = pool[i % len(pool)]
+            klass = plan.klass(0, i)
             t0 = time.perf_counter()
             try:
-                fut = server.submit(name, x)
-                inflight.put((t0, fut))
+                fut = server.submit(name, x, priority=klass,
+                                    deadline_ms=plan.deadline_ms)
+                inflight.put((t0, klass, fut))
+            except serving.DeadlineExceeded:
+                qos.record(klass, dropped=True)
             except serving.ServerBusyError:
                 with lock:
                     rejected[0] += 1
@@ -604,6 +747,7 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
         "traced_requests": phases.traced,
     }
     report.update(_percentiles(sorted(lats)))
+    qos.fold(report, plan)
     if via_http:
         _connect_fields(report, clients, concurrency)
         for cl in clients:
@@ -617,10 +761,18 @@ def run_inproc(duration=30.0, mode="closed", concurrency=8, rate=2000.0,
 
 # --------------------------------------------------------------- over HTTP --
 
-def run_http(url, duration=30.0, concurrency=8, dim=16):
+def run_http(url, duration=30.0, concurrency=8, dim=16,
+             priority_mix=None, hot_key_frac=0.0, deadline_ms=None):
     """Closed-loop drive of an EXTERNAL front end at `url` (model list
     discovered via GET /v1/models) over per-thread keep-alive
-    connections; connect time reported separately from request time."""
+    connections; connect time reported separately from request time.
+
+    QoS knobs: ``priority_mix`` ('4:1' interactive:batch weights) stamps
+    a priority class per request and splits the latency report per
+    class; ``hot_key_frac`` re-sends ONE hot (model, input) pair for
+    that fraction of requests (driving prediction-cache hits);
+    ``deadline_ms`` stamps a deadline on every request — deadline drops
+    (504 + ``dropped``) are counted per class, NOT as errors."""
     import urllib.request
 
     import numpy as np
@@ -635,6 +787,8 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
     versions = set()
     clients = []
     phases = _PhaseAgg(lock)
+    plan = _QoSPlan(priority_mix, hot_key_frac, deadline_ms)
+    qos = _QoSAgg(lock)
     stop_at = time.perf_counter() + duration
 
     def worker(tid):
@@ -643,9 +797,16 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
             clients.append(cl)
         i = 0
         while time.perf_counter() < stop_at:
-            name = names[(tid + i) % len(names)]
-            body = json.dumps(
-                {"data": pool[(tid * 7 + i) % len(pool)].tolist()}).encode()
+            if plan.hot_key(tid, i):
+                # the hot pair: ONE model x ONE input -> one cache key
+                name, x = names[0], pool[0]
+            else:
+                name = names[(tid + i) % len(names)]
+                x = pool[(tid * 7 + i) % len(pool)]
+            klass = plan.klass(tid, i)
+            req = {"data": x.tolist()}
+            req.update(plan.body_fields(tid, i))
+            body = json.dumps(req).encode()
             t0 = time.perf_counter()
             try:
                 status, payload, connect_ms = cl.request(
@@ -656,24 +817,30 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
                     errors.append(f"{type(e).__name__}: {e}")
                 i += 1
                 continue
+            try:
+                data = json.loads(payload)
+            except ValueError:
+                data = {}
             if status in (429, 503):
                 with lock:
                     rejected[0] += 1
                 time.sleep(0.001)
+            elif status == 504 and data.get("dropped"):
+                # admission refused a provably-unmeetable deadline
+                # BEFORE compute: QoS working as designed, not an error
+                qos.record(klass, dropped=True)
             elif status != 200:
                 with lock:
                     errors.append(f"HTTP {status}")
             else:
-                try:
-                    data = json.loads(payload)
-                except ValueError:
-                    data = {}
+                ms = (time.perf_counter() - t0) * 1e3 - connect_ms
                 with lock:
-                    lats.append((time.perf_counter() - t0) * 1e3
-                                - connect_ms)
+                    lats.append(ms)
                     completed[0] += 1
                     if data.get("model_version") is not None:
                         versions.add(data["model_version"])
+                qos.record(klass, ms,
+                           cache_hit=bool(data.get("cache_hit")))
                 phases.record(data.get("phases"))
             i += 1
 
@@ -697,6 +864,7 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
         "traced_requests": phases.traced,
     }
     report.update(_percentiles(sorted(lats)))
+    qos.fold(report, plan)
     _connect_fields(report, clients, concurrency)
     for cl in clients:
         cl.close()
@@ -706,14 +874,19 @@ def run_http(url, duration=30.0, concurrency=8, dim=16):
 # ------------------------------------------------- multi-process (fleet) --
 
 def run_fleet(workers=2, duration=10.0, concurrency=8, models=2, dim=16,
-              policy=None, run_dir=None, beat=0.25):
+              policy=None, run_dir=None, beat=0.25, hosts=None,
+              config=None, priority_mix=None, hot_key_frac=0.0,
+              deadline_ms=None):
     """Multi-process mode: an N-worker :class:`ServingFleet` (one
     ModelServer process per worker behind the router) driven by the
     same keep-alive closed loop as ``--url``. The report carries the
-    fleet's router counters (retries/rejects) and per-worker census so
-    the 1→N scaling number is auditable. Autoscaling is pinned off
-    (min == max == workers): this harness measures the router path at a
-    fixed census."""
+    fleet's router counters (retries/rejects), hedge outcomes +
+    straggler flags, and per-worker census so the 1→N scaling number is
+    auditable. Autoscaling is pinned off (min == max == workers): this
+    harness measures the router path at a fixed census. ``hosts``
+    places workers multi-host (the fleet grammar); ``config`` overlays
+    extra fleet options; the QoS knobs pass through to
+    :func:`run_http`."""
     import tempfile
 
     from mxnet_tpu.serving import fleet as fleet_mod
@@ -723,17 +896,28 @@ def run_fleet(workers=2, duration=10.0, concurrency=8, models=2, dim=16,
     model_dir = os.path.join(root, "models")
     worker_mod.write_spec(model_dir,
                           worker_mod.demo_spec(models=models, dim=dim))
+    cfg = {"min": workers, "max": workers, "beat": beat}
+    cfg.update(config or {})
+    env = None
+    if float(hot_key_frac or 0.0) > 0.0:
+        # hot-key traffic implies the prediction cache: enable it in
+        # every worker (the env grammar composes with any ambient one)
+        spec = os.environ.get("MXNET_TPU_SERVING", "")
+        env = {"MXNET_TPU_SERVING":
+               (spec + ",cache:1").lstrip(",")}
     fl = fleet_mod.ServingFleet(
         model_dir, workers=workers, run_dir=os.path.join(root, "run"),
-        policy=policy,
-        config={"min": workers, "max": workers, "beat": beat},
+        policy=policy, hosts=hosts, config=cfg, env=env,
         name=f"loadgen-{workers}w")
     t0 = time.perf_counter()
     fl.start()
     startup_s = time.perf_counter() - t0
     try:
         report = run_http(fl.url, duration=duration,
-                          concurrency=concurrency, dim=dim)
+                          concurrency=concurrency, dim=dim,
+                          priority_mix=priority_mix,
+                          hot_key_frac=hot_key_frac,
+                          deadline_ms=deadline_ms)
         stats = fl.stats()
     finally:
         fl.stop()
@@ -743,9 +927,12 @@ def run_fleet(workers=2, duration=10.0, concurrency=8, models=2, dim=16,
         "policy": stats["policy"],
         "fleet_startup_s": round(startup_s, 2),
         "router": stats["router"],
+        "hedges": stats.get("hedges"),
+        "stragglers": stats.get("stragglers"),
+        "hosts": stats.get("hosts"),
         "per_worker": {
             slot: {k: w.get(k) for k in ("rps", "queue_depth", "p99_ms",
-                                         "restarts")}
+                                         "restarts", "host", "locality")}
             for slot, w in stats["workers"].items()},
         "run_dir": fl.run_dir,
     })
@@ -782,6 +969,18 @@ def main(argv=None):
                     choices=("least_loaded", "hash", "round_robin"),
                     help="fleet routing policy (--workers mode; default "
                          "least_loaded)")
+    ap.add_argument("--priority-mix", default=None, metavar="I:B",
+                    help="interactive:batch traffic weights (e.g. 4:1); "
+                         "the report then splits p50/p99 and deadline "
+                         "drops per class")
+    ap.add_argument("--hot-key-frac", type=float, default=0.0,
+                    help="fraction of requests re-sending ONE hot "
+                         "(model, input) pair — drives prediction-cache "
+                         "hits (reported as cache_hit_ratio)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="stamp this deadline on every request; "
+                         "admission drops (504 dropped) are counted per "
+                         "class, not as errors")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the pre-traffic bucket warmup (recompiles "
                          "will then land inside the measured window)")
@@ -822,15 +1021,24 @@ def main(argv=None):
         errs = sum(s["errors"] for s in report["variants"].values())
         return 0 if errs == 0 else 1
 
+    qos_kw = {"priority_mix": args.priority_mix,
+              "hot_key_frac": args.hot_key_frac,
+              "deadline_ms": args.deadline_ms}
+
     if args.workers:
         report = run_fleet(workers=args.workers, duration=args.duration,
                            concurrency=args.concurrency,
                            models=args.models, dim=args.dim,
-                           policy=args.policy)
+                           policy=args.policy, **qos_kw)
+        hedges = report.get("hedges") or {}
         print(f"loadgen fleet: {args.workers} worker(s) -> "
               f"{report['rps']} req/s, p50 {report.get('p50_ms')}ms "
               f"p99 {report.get('p99_ms')}ms, "
               f"{report['router'].get('retries', 0)} router retries, "
+              f"{hedges.get('fired', 0)} hedges "
+              f"({hedges.get('won', 0)} won), "
+              f"{report.get('deadline_dropped', 0)} deadline drops, "
+              f"cache hit ratio {report.get('cache_hit_ratio')}, "
               f"{report['reconnects']} reconnects "
               f"(connect {report.get('connect_ms_mean')}ms mean)",
               file=sys.stderr, flush=True)
@@ -839,13 +1047,14 @@ def main(argv=None):
 
     if args.url:
         report = run_http(args.url, duration=args.duration,
-                          concurrency=args.concurrency, dim=args.dim)
+                          concurrency=args.concurrency, dim=args.dim,
+                          **qos_kw)
     else:
         report = run_inproc(
             duration=args.duration, mode=args.mode,
             concurrency=args.concurrency, rate=args.rate,
             models=args.models, dim=args.dim, warmup=not args.no_warmup,
-            via_http=args.via_http)
+            via_http=args.via_http, **qos_kw)
     print(f"loadgen: {report['completed']} completed in "
           f"{report['duration_s']}s -> {report['rps']} req/s, "
           f"p50 {report.get('p50_ms')}ms p99 {report.get('p99_ms')}ms, "
